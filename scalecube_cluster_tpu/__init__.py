@@ -1,0 +1,56 @@
+"""scalecube_cluster_tpu — a TPU-native cluster-membership framework.
+
+A brand-new implementation of the capabilities of scalecube-cluster
+(SWIM-based decentralized membership, random-probe failure detection with
+suspicion / incarnation refutation, infection-style gossip dissemination,
+SYNC anti-entropy, per-member metadata) designed JAX-first:
+
+- ``cluster_api``   — public data model: Member, MembershipRecord,
+  MembershipEvent, config beans with LAN/WAN/LOCAL presets
+  (reference: cluster-api/, e.g. Cluster.java:10-151).
+- ``transport``     — Transport SPI + Message model + asyncio TCP backend
+  (reference: transport-parent/, TransportImpl.java:45-398).
+- ``cluster``       — host-side protocol engines: failure detector, gossip,
+  membership, metadata, and the ClusterImpl-equivalent facade
+  (reference: cluster/, ClusterImpl.java:39-515).
+- ``sim``           — the TPU-native simulation backend: N cluster nodes as
+  one pytree of arrays, whole protocol rounds advanced as single
+  XLA message-passing steps under ``jax.lax.scan``.
+- ``ops``           — array kernels used by the sim (scatter delivery,
+  vectorized membership-merge lattice, fanout selection).
+- ``parallel``      — device-mesh sharding of the member axis
+  (``jax.sharding`` / ``shard_map``) for 10k-100k member simulations.
+- ``testlib``       — NetworkEmulator fault injection (host decorator and
+  per-edge sim masks) (reference: cluster-testlib/NetworkEmulator.java:25-411).
+- ``utils``         — Address value type, id generation.
+"""
+
+from scalecube_cluster_tpu import cluster_math
+from scalecube_cluster_tpu.cluster_api.config import (
+    ClusterConfig,
+    FailureDetectorConfig,
+    GossipConfig,
+    MembershipConfig,
+    TransportConfig,
+)
+from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
+from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.cluster_api.membership_record import MembershipRecord
+from scalecube_cluster_tpu.utils.address import Address
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Address",
+    "ClusterConfig",
+    "FailureDetectorConfig",
+    "GossipConfig",
+    "Member",
+    "MemberStatus",
+    "MembershipConfig",
+    "MembershipEvent",
+    "MembershipRecord",
+    "TransportConfig",
+    "cluster_math",
+    "__version__",
+]

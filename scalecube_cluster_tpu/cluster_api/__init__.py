@@ -1,0 +1,28 @@
+"""Public cluster API data model (reference: cluster-api/ module)."""
+
+from scalecube_cluster_tpu.cluster_api.config import (
+    ClusterConfig,
+    FailureDetectorConfig,
+    GossipConfig,
+    MembershipConfig,
+    TransportConfig,
+)
+from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
+from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.cluster_api.membership_record import (
+    MembershipRecord,
+    is_overrides,
+)
+
+__all__ = [
+    "ClusterConfig",
+    "FailureDetectorConfig",
+    "GossipConfig",
+    "Member",
+    "MemberStatus",
+    "MembershipConfig",
+    "MembershipEvent",
+    "MembershipRecord",
+    "TransportConfig",
+    "is_overrides",
+]

@@ -1,0 +1,205 @@
+"""Configuration beans with LAN / WAN / LOCAL presets.
+
+Reference: ClusterConfig.java:21-296, MembershipConfig.java:10-184,
+FailureDetectorConfig.java:5-133, GossipConfig.java:5-127,
+TransportConfig.java:5-159. The reference uses cloneable fluent beans; here
+each is a frozen dataclass with ``replace``-style ``with_*`` helpers and the
+three presets as classmethods. All durations are **milliseconds** to match the
+reference defaults table (SURVEY.md §5):
+
+| param                       | LAN (default) | WAN   | LOCAL |
+|-----------------------------|---------------|-------|-------|
+| ping_interval / ping_timeout| 1000 / 500    | 5000/3000 | 1000/200 |
+| ping_req_members            | 3             | 3     | 1     |
+| gossip interval/fanout/mult | 200 / 3 / 3   | 200/4/3 | 100/3/2 |
+| sync_interval / sync_timeout| 30000 / 3000  | 60000/3000 | 15000/3000 |
+| suspicion_mult              | 5             | 6     | 3     |
+| metadata_timeout            | 3000          | 10000 | 1000  |
+| connect_timeout             | 3000          | 10000 | 1000  |
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from scalecube_cluster_tpu.utils.address import Address
+
+
+class _WithMixin:
+    """Copy-on-write ``with_(...)`` helper mirroring the fluent withers."""
+
+    def with_(self, **changes: Any):
+        return dataclasses.replace(self, **changes)
+
+
+@dataclass(frozen=True)
+class FailureDetectorConfig(_WithMixin):
+    """SWIM probe settings (FailureDetectorConfig.java:5-133)."""
+
+    ping_interval: int = 1000
+    ping_timeout: int = 500
+    ping_req_members: int = 3
+
+    @classmethod
+    def default_lan(cls) -> "FailureDetectorConfig":
+        return cls()
+
+    @classmethod
+    def default_wan(cls) -> "FailureDetectorConfig":
+        return cls(ping_interval=5000, ping_timeout=3000, ping_req_members=3)
+
+    @classmethod
+    def default_local(cls) -> "FailureDetectorConfig":
+        return cls(ping_interval=1000, ping_timeout=200, ping_req_members=1)
+
+
+@dataclass(frozen=True)
+class GossipConfig(_WithMixin):
+    """Infection-dissemination settings (GossipConfig.java:5-127)."""
+
+    gossip_interval: int = 200
+    gossip_fanout: int = 3
+    gossip_repeat_mult: int = 3
+    #: Cap on gossips per message batch (newer reference knob; 0 = unlimited).
+    gossip_segmentation_threshold: int = 1000
+
+    @classmethod
+    def default_lan(cls) -> "GossipConfig":
+        return cls()
+
+    @classmethod
+    def default_wan(cls) -> "GossipConfig":
+        return cls(gossip_interval=200, gossip_fanout=4, gossip_repeat_mult=3)
+
+    @classmethod
+    def default_local(cls) -> "GossipConfig":
+        return cls(gossip_interval=100, gossip_fanout=3, gossip_repeat_mult=2)
+
+
+@dataclass(frozen=True)
+class MembershipConfig(_WithMixin):
+    """SYNC anti-entropy + suspicion settings (MembershipConfig.java:10-184)."""
+
+    seed_members: tuple[Address, ...] = ()
+    sync_interval: int = 30_000
+    sync_timeout: int = 3_000
+    suspicion_mult: int = 5
+    #: Cluster partition tag: SYNCs across different groups are ignored
+    #: (MembershipProtocolImpl.java:442-448).
+    sync_group: str = "default"
+    #: Remove-history ring size for the JMX-equivalent monitor
+    #: (MembershipProtocolImpl.java:732-791 keeps 42).
+    removed_members_history_size: int = 42
+
+    @classmethod
+    def default_lan(cls) -> "MembershipConfig":
+        return cls()
+
+    @classmethod
+    def default_wan(cls) -> "MembershipConfig":
+        return cls(sync_interval=60_000, suspicion_mult=6)
+
+    @classmethod
+    def default_local(cls) -> "MembershipConfig":
+        return cls(sync_interval=15_000, suspicion_mult=3)
+
+
+@dataclass(frozen=True)
+class TransportConfig(_WithMixin):
+    """Wire transport settings (TransportConfig.java:5-159)."""
+
+    host: str | None = None
+    port: int = 0  # 0 = ephemeral
+    connect_timeout: int = 3_000
+    max_frame_length: int = 2 * 1024 * 1024
+    #: Dotted path or registered name of the MessageCodec (None = default JSON).
+    message_codec: str | None = None
+
+    @classmethod
+    def default_lan(cls) -> "TransportConfig":
+        return cls()
+
+    @classmethod
+    def default_wan(cls) -> "TransportConfig":
+        return cls(connect_timeout=10_000)
+
+    @classmethod
+    def default_local(cls) -> "TransportConfig":
+        return cls(connect_timeout=1_000)
+
+
+@dataclass(frozen=True)
+class ClusterConfig(_WithMixin):
+    """Top-level config composing the four sub-configs (ClusterConfig.java:21-296).
+
+    Nested updates mirror the reference's ``UnaryOperator`` composition
+    (ClusterConfig.java:191-247)::
+
+        cfg = ClusterConfig.default_local().membership(
+            lambda m: m.with_(seed_members=(seed,)))
+    """
+
+    member_alias: str | None = None
+    #: Override the address advertised in the local Member
+    #: (ClusterImpl.java:277-288 memberHost/memberPort).
+    external_host: str | None = None
+    external_port: int | None = None
+    metadata: Any = None
+    metadata_timeout: int = 3_000
+    transport_config: TransportConfig = field(default_factory=TransportConfig)
+    failure_detector_config: FailureDetectorConfig = field(
+        default_factory=FailureDetectorConfig
+    )
+    gossip_config: GossipConfig = field(default_factory=GossipConfig)
+    membership_config: MembershipConfig = field(default_factory=MembershipConfig)
+
+    # -- presets (ClusterConfig.defaultConfig/defaultWanConfig/defaultLocalConfig)
+
+    @classmethod
+    def default_lan(cls) -> "ClusterConfig":
+        return cls()
+
+    @classmethod
+    def default_wan(cls) -> "ClusterConfig":
+        return cls(
+            metadata_timeout=10_000,
+            transport_config=TransportConfig.default_wan(),
+            failure_detector_config=FailureDetectorConfig.default_wan(),
+            gossip_config=GossipConfig.default_wan(),
+            membership_config=MembershipConfig.default_wan(),
+        )
+
+    @classmethod
+    def default_local(cls) -> "ClusterConfig":
+        return cls(
+            metadata_timeout=1_000,
+            transport_config=TransportConfig.default_local(),
+            failure_detector_config=FailureDetectorConfig.default_local(),
+            gossip_config=GossipConfig.default_local(),
+            membership_config=MembershipConfig.default_local(),
+        )
+
+    # -- nested composition (ClusterConfig.java:191-247)
+
+    def transport(
+        self, op: Callable[[TransportConfig], TransportConfig]
+    ) -> "ClusterConfig":
+        return self.with_(transport_config=op(self.transport_config))
+
+    def failure_detector(
+        self, op: Callable[[FailureDetectorConfig], FailureDetectorConfig]
+    ) -> "ClusterConfig":
+        return self.with_(failure_detector_config=op(self.failure_detector_config))
+
+    def gossip(self, op: Callable[[GossipConfig], GossipConfig]) -> "ClusterConfig":
+        return self.with_(gossip_config=op(self.gossip_config))
+
+    def membership(
+        self, op: Callable[[MembershipConfig], MembershipConfig]
+    ) -> "ClusterConfig":
+        return self.with_(membership_config=op(self.membership_config))
+
+    def with_seed_members(self, *seeds: Address) -> "ClusterConfig":
+        return self.membership(lambda m: m.with_(seed_members=tuple(seeds)))

@@ -1,0 +1,54 @@
+"""Cluster member identity.
+
+Reference: Member.java:11-73 — a member is (id, alias, address, namespace);
+the id is a random hex string minted at node start, so a restarted process at
+the same address gets a NEW identity (this is what lets the failure detector
+report DEST_GONE, PingData.java:17-22).
+
+``MemberStatus`` (reference: membership/MemberStatus.java:3-16) is an IntEnum
+whose values double as the array encoding used by the TPU sim engine
+(``sim/``): views are int8 arrays over these codes, with the extra UNKNOWN
+code meaning "not in my membership table".
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import IntEnum
+
+from scalecube_cluster_tpu.utils.address import Address
+from scalecube_cluster_tpu.utils.ids import generate_id
+
+
+class MemberStatus(IntEnum):
+    """SWIM member state lattice; int values are the sim array encoding."""
+
+    ALIVE = 0
+    SUSPECT = 1
+    DEAD = 2
+    #: Sim-only: subject not present in the viewing node's membership table.
+    UNKNOWN = 3
+
+
+@dataclass(frozen=True)
+class Member:
+    """Immutable cluster-member identity (Member.java:11-73)."""
+
+    id: str
+    address: Address
+    alias: str | None = None
+    namespace: str = "default"
+
+    @classmethod
+    def create(
+        cls,
+        address: Address,
+        alias: str | None = None,
+        namespace: str = "default",
+    ) -> "Member":
+        """Mint a member with a fresh random id (Member.java:48-50)."""
+        return cls(id=generate_id(), address=address, alias=alias, namespace=namespace)
+
+    def __str__(self) -> str:
+        name = self.alias if self.alias else self.id[:8]
+        return f"{name}@{self.address}"

@@ -1,0 +1,61 @@
+"""Membership change events delivered to user code.
+
+Reference: MembershipEvent.java:11-117 — ADDED / REMOVED / UPDATED (plus
+LEAVING in newer APIs; the reference surface is the three). ADDED carries the
+new metadata, REMOVED the last-known metadata, UPDATED both old and new.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any
+
+from scalecube_cluster_tpu.cluster_api.member import Member
+
+
+class MembershipEventType(Enum):
+    ADDED = "ADDED"
+    REMOVED = "REMOVED"
+    UPDATED = "UPDATED"
+
+
+@dataclass(frozen=True)
+class MembershipEvent:
+    """A membership change observed by one node (MembershipEvent.java:11-117)."""
+
+    type: MembershipEventType
+    member: Member
+    old_metadata: Any = None
+    new_metadata: Any = None
+    #: Sim backends stamp the tick at which the event fired (host backend: None).
+    tick: int | None = None
+
+    @classmethod
+    def added(cls, member: Member, metadata: Any = None) -> "MembershipEvent":
+        return cls(MembershipEventType.ADDED, member, None, metadata)
+
+    @classmethod
+    def removed(cls, member: Member, metadata: Any = None) -> "MembershipEvent":
+        return cls(MembershipEventType.REMOVED, member, metadata, None)
+
+    @classmethod
+    def updated(
+        cls, member: Member, old_metadata: Any, new_metadata: Any
+    ) -> "MembershipEvent":
+        return cls(MembershipEventType.UPDATED, member, old_metadata, new_metadata)
+
+    @property
+    def is_added(self) -> bool:
+        return self.type is MembershipEventType.ADDED
+
+    @property
+    def is_removed(self) -> bool:
+        return self.type is MembershipEventType.REMOVED
+
+    @property
+    def is_updated(self) -> bool:
+        return self.type is MembershipEventType.UPDATED
+
+    def __str__(self) -> str:
+        return f"MembershipEvent({self.type.value}, {self.member})"

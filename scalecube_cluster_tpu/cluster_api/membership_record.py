@@ -1,0 +1,80 @@
+"""Membership records and the SWIM merge rule.
+
+Reference: membership/MembershipRecord.java:12-109. A record is
+(member, status, incarnation); the merge rule ``isOverrides``
+(MembershipRecord.java:66-84) is the single source of truth for how two nodes'
+views of the same member reconcile:
+
+- DEAD is sticky: an existing DEAD record is never overridden, and an
+  incoming DEAD record overrides any non-dead record.
+- Otherwise the higher incarnation wins.
+- At equal incarnation, only SUSPECT overrides ALIVE (never the reverse —
+  a suspected member must *refute* by bumping its incarnation,
+  MembershipProtocolImpl.java:549-569).
+
+The same rule appears twice in this codebase on purpose: here as scalar
+Python driving the host backend, and in ``ops/merge.py`` as a branchless
+``jnp.where`` lattice over whole [N, N] view matrices for the TPU sim.
+``tests/test_membership_record.py`` pins both to the reference truth table
+(MembershipRecordTest.java:34-109).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, replace
+
+from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
+
+
+@dataclass(frozen=True)
+class MembershipRecord:
+    """One node's belief about one member (MembershipRecord.java:12-109)."""
+
+    member: Member
+    status: MemberStatus
+    incarnation: int = 0
+
+    @property
+    def is_alive(self) -> bool:
+        return self.status is MemberStatus.ALIVE
+
+    @property
+    def is_suspect(self) -> bool:
+        return self.status is MemberStatus.SUSPECT
+
+    @property
+    def is_dead(self) -> bool:
+        return self.status is MemberStatus.DEAD
+
+    def with_status(self, status: MemberStatus) -> "MembershipRecord":
+        return replace(self, status=status)
+
+    def with_incarnation(self, incarnation: int) -> "MembershipRecord":
+        return replace(self, incarnation=incarnation)
+
+    def __str__(self) -> str:
+        return f"{self.member}:{self.status.name}:inc={self.incarnation}"
+
+
+def is_overrides(r1: MembershipRecord, r0: MembershipRecord | None) -> bool:
+    """Whether incoming record ``r1`` overrides existing record ``r0``.
+
+    Mirrors MembershipRecord.isOverrides (MembershipRecord.java:66-84); the
+    truth table is pinned by MembershipRecordTest.java:34-109.
+    """
+    if r0 is None:
+        # Only a live record may introduce a previously-unknown member;
+        # stray SUSPECT/DEAD rumors about unknown members are dropped.
+        return r1.is_alive
+    if r0.member.id != r1.member.id:
+        raise ValueError(
+            f"records describe different members: {r0.member.id} vs {r1.member.id}"
+        )
+    if r0.is_dead:
+        return False  # DEAD is sticky
+    if r1.is_dead:
+        return True  # DEAD overrides any non-dead
+    if r1.incarnation == r0.incarnation:
+        # Equal incarnation: only SUSPECT may override ALIVE.
+        return r1.status != r0.status and r1.is_suspect
+    return r1.incarnation > r0.incarnation

@@ -1,0 +1,96 @@
+"""Closed-form SWIM / gossip analytics.
+
+Reference: cluster/ClusterMath.java:8-136. These formulas are the reference's
+only published performance model (BASELINE.md); the sim engines' measured
+convergence curves are validated against them in tests.
+
+All interval arguments are milliseconds, matching the config beans.
+"""
+
+from __future__ import annotations
+
+import math
+
+
+def ceil_log2(n: int) -> int:
+    """``32 - numberOfLeadingZeros(n)`` (ClusterMath.java:133-135).
+
+    For n >= 1 this equals ``floor(log2(n)) + 1``; for n <= 0 it is 0.
+    """
+    if n <= 0:
+        return 0
+    return int(n).bit_length()
+
+
+def gossip_periods_to_spread(repeat_mult: int, cluster_size: int) -> int:
+    """Periods a gossip stays actively spread: ``repeatMult * ceilLog2(n)``
+    (ClusterMath.java:110-113; note ceilLog2(n) itself is ceil(log2(n + 1)))."""
+    return repeat_mult * ceil_log2(cluster_size)
+
+
+def gossip_periods_to_sweep(repeat_mult: int, cluster_size: int) -> int:
+    """Periods until a gossip is garbage-collected: ``2 * (spread + 1)``
+    (ClusterMath.java:99-102)."""
+    return 2 * (gossip_periods_to_spread(repeat_mult, cluster_size) + 1)
+
+
+def gossip_dissemination_time(
+    repeat_mult: int, cluster_size: int, gossip_interval: int
+) -> int:
+    """Expected full-dissemination time in ms (ClusterMath.java:77-79)."""
+    return gossip_periods_to_spread(repeat_mult, cluster_size) * gossip_interval
+
+
+def gossip_timeout_to_sweep(
+    repeat_mult: int, cluster_size: int, gossip_interval: int
+) -> int:
+    """Time until sweep in ms (ClusterMath.java:88-90)."""
+    return gossip_periods_to_sweep(repeat_mult, cluster_size) * gossip_interval
+
+
+def max_messages_per_gossip_per_node(
+    fanout: int, repeat_mult: int, cluster_size: int
+) -> int:
+    """Upper bound on sends per node per gossip (ClusterMath.java:65-67)."""
+    return fanout * gossip_periods_to_spread(repeat_mult, cluster_size)
+
+
+def max_messages_per_gossip_total(
+    fanout: int, repeat_mult: int, cluster_size: int
+) -> int:
+    """Cluster-wide send bound per gossip (ClusterMath.java:53-55)."""
+    return cluster_size * max_messages_per_gossip_per_node(
+        fanout, repeat_mult, cluster_size
+    )
+
+
+def gossip_convergence_probability(
+    fanout: int, repeat_mult: int, cluster_size: int, loss_percent: float
+) -> float:
+    """P(all members infected) under uniform loss (ClusterMath.java:33-43).
+
+    ``(n - n^-(fanout*(1-loss)*repeatMult - 2)) / n`` — the classic
+    epidemic-dissemination estimate.
+    """
+    n = cluster_size
+    if n <= 0:
+        return 1.0
+    spread = fanout * (1.0 - loss_percent / 100.0) * repeat_mult
+    return (n - math.pow(n, -(spread - 2.0))) / n
+
+
+def gossip_convergence_percent(
+    fanout: int, repeat_mult: int, cluster_size: int, loss_percent: float
+) -> float:
+    """Convergence probability as a percentage (ClusterMath.java:23-31)."""
+    return 100.0 * gossip_convergence_probability(
+        fanout, repeat_mult, cluster_size, loss_percent
+    )
+
+
+def suspicion_timeout(
+    suspicion_mult: int, cluster_size: int, ping_interval: int
+) -> int:
+    """SUSPECT -> DEAD deadline in ms: ``mult * ceilLog2(n) * pingInterval``
+    (ClusterMath.java:122-125)."""
+    return suspicion_mult * ceil_log2(cluster_size) * ping_interval

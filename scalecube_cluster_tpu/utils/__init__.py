@@ -1,0 +1,6 @@
+"""Utility value types and helpers (reference: io.scalecube:scalecube-commons)."""
+
+from scalecube_cluster_tpu.utils.address import Address
+from scalecube_cluster_tpu.utils.ids import CorrelationIdGenerator, generate_id
+
+__all__ = ["Address", "CorrelationIdGenerator", "generate_id"]

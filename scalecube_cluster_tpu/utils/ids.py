@@ -1,0 +1,28 @@
+"""Id generation.
+
+Reference: member ids are random UUID-derived hex strings (Member.java:48-50);
+correlation ids are ``<memberId>-<counter>`` with the counter seeded from wall
+time (CorrelationIdGenerator.java:6-17).
+"""
+
+from __future__ import annotations
+
+import itertools
+import secrets
+import time
+
+
+def generate_id(bits: int = 64) -> str:
+    """Random hex id for a cluster member (Member.generateId analog)."""
+    return secrets.token_hex(bits // 8)
+
+
+class CorrelationIdGenerator:
+    """Monotonic correlation-id source, unique per member and per process run."""
+
+    def __init__(self, member_id: str):
+        self._member_id = member_id
+        self._counter = itertools.count(int(time.time() * 1000))
+
+    def next_cid(self) -> str:
+        return f"{self._member_id}-{next(self._counter)}"

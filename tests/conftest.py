@@ -1,0 +1,25 @@
+"""Test harness configuration.
+
+All tests run on CPU with 8 virtual XLA devices so that multi-chip sharding
+(`parallel/`) is exercised without TPU hardware. These env vars must be set
+before the first `import jax` anywhere in the test process, which is why they
+live at the top of the root conftest.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import asyncio  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def event_loop_policy():
+    return asyncio.DefaultEventLoopPolicy()

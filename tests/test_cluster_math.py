@@ -1,0 +1,74 @@
+"""ClusterMath formulas pinned to the BASELINE.md evaluation table."""
+
+import pytest
+
+from scalecube_cluster_tpu import cluster_math as cm
+
+LAN_GOSSIP_INTERVAL = 200
+LAN_FANOUT = 3
+LAN_REPEAT_MULT = 3
+LAN_PING_INTERVAL = 1000
+LAN_SUSPICION_MULT = 5
+
+
+def test_ceil_log2():
+    assert cm.ceil_log2(0) == 0
+    assert cm.ceil_log2(1) == 1
+    assert cm.ceil_log2(2) == 2
+    assert cm.ceil_log2(8) == 4  # 32 - nlz(8) = 4
+    assert cm.ceil_log2(11) == 4
+    assert cm.ceil_log2(101) == 7
+
+
+# Columns from BASELINE.md: n -> (periods, dissemination ms, sweep ms,
+# per-node msgs, total msgs, suspicion ms)
+BASELINE_TABLE = {
+    10: (12, 2_400, 5_200, 36, 360, 20_000),
+    100: (21, 4_200, 8_800, 63, 6_300, 35_000),
+    1_000: (30, 6_000, 12_400, 90, 90_000, 50_000),
+    10_000: (42, 8_400, 17_200, 126, 1_260_000, 70_000),
+    100_000: (51, 10_200, 20_800, 153, 15_300_000, 85_000),
+}
+
+
+@pytest.mark.parametrize("n", sorted(BASELINE_TABLE))
+def test_baseline_table(n):
+    periods, dissemination, sweep, per_node, total, suspicion = BASELINE_TABLE[n]
+    assert cm.gossip_periods_to_spread(LAN_REPEAT_MULT, n) == periods
+    assert (
+        cm.gossip_dissemination_time(LAN_REPEAT_MULT, n, LAN_GOSSIP_INTERVAL)
+        == dissemination
+    )
+    assert cm.gossip_timeout_to_sweep(LAN_REPEAT_MULT, n, LAN_GOSSIP_INTERVAL) == sweep
+    assert (
+        cm.max_messages_per_gossip_per_node(LAN_FANOUT, LAN_REPEAT_MULT, n) == per_node
+    )
+    assert cm.max_messages_per_gossip_total(LAN_FANOUT, LAN_REPEAT_MULT, n) == total
+    assert (
+        cm.suspicion_timeout(LAN_SUSPICION_MULT, n, LAN_PING_INTERVAL) == suspicion
+    )
+
+
+def test_no_double_plus_one_at_power_of_two_boundaries():
+    # ceilLog2 is applied to n directly (ClusterMath.java:111-113); for n = 7
+    # the reference yields 3*bit_length(7) = 9 periods, not 12.
+    assert cm.gossip_periods_to_spread(3, 7) == 9
+    assert cm.suspicion_timeout(5, 7, 1000) == 15_000
+    assert cm.gossip_periods_to_spread(3, 8) == 12
+
+
+def test_convergence_probability_high_at_low_loss():
+    for n in (10, 100, 1_000, 100_000):
+        for loss in (0.0, 10.0, 25.0):
+            p = cm.gossip_convergence_probability(
+                LAN_FANOUT, LAN_REPEAT_MULT, n, loss
+            )
+            assert p > 0.999, (n, loss, p)
+    pct = cm.gossip_convergence_percent(LAN_FANOUT, LAN_REPEAT_MULT, 50, 0.0)
+    assert 99.9 < pct <= 100.0
+
+
+def test_convergence_probability_degrades_with_loss():
+    p_low = cm.gossip_convergence_probability(3, 3, 100, 0.0)
+    p_high = cm.gossip_convergence_probability(3, 3, 100, 80.0)
+    assert p_high < p_low

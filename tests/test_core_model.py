@@ -1,0 +1,85 @@
+"""Member / Address / config beans."""
+
+import pytest
+
+from scalecube_cluster_tpu import (
+    Address,
+    ClusterConfig,
+    FailureDetectorConfig,
+    GossipConfig,
+    Member,
+    MembershipConfig,
+    MemberStatus,
+    TransportConfig,
+)
+
+
+def test_address_parse_roundtrip():
+    a = Address.from_string("10.0.0.1:4801")
+    assert a == Address("10.0.0.1", 4801)
+    assert str(a) == "10.0.0.1:4801"
+    assert Address.from_string("[::1]:80") == Address("::1", 80)
+
+
+def test_address_validation():
+    with pytest.raises(ValueError):
+        Address("h", 70000)
+    with pytest.raises(ValueError):
+        Address("", 1)
+    with pytest.raises(ValueError):
+        Address.from_string("no-port")
+
+
+def test_member_create_random_ids():
+    addr = Address("127.0.0.1", 4801)
+    a, b = Member.create(addr), Member.create(addr)
+    assert a.id != b.id  # restarted process at same address = new identity
+    assert a.address == addr
+    assert MemberStatus.ALIVE == 0 and MemberStatus.DEAD == 2
+
+
+def test_config_presets_match_reference_defaults():
+    lan = ClusterConfig.default_lan()
+    assert lan.failure_detector_config == FailureDetectorConfig(1000, 500, 3)
+    assert lan.gossip_config.gossip_interval == 200
+    assert lan.gossip_config.gossip_fanout == 3
+    assert lan.membership_config.sync_interval == 30_000
+    assert lan.membership_config.suspicion_mult == 5
+    assert lan.metadata_timeout == 3_000
+
+    wan = ClusterConfig.default_wan()
+    assert wan.failure_detector_config.ping_interval == 5_000
+    assert wan.gossip_config.gossip_fanout == 4
+    assert wan.membership_config.sync_interval == 60_000
+    assert wan.membership_config.suspicion_mult == 6
+    assert wan.metadata_timeout == 10_000
+
+    local = ClusterConfig.default_local()
+    assert local.failure_detector_config.ping_timeout == 200
+    assert local.failure_detector_config.ping_req_members == 1
+    assert local.gossip_config == GossipConfig(100, 3, 2)
+    assert local.membership_config.sync_interval == 15_000
+    assert local.transport_config.connect_timeout == 1_000
+
+
+def test_config_nested_composition():
+    seed = Address("127.0.0.1", 4801)
+    cfg = (
+        ClusterConfig.default_local()
+        .with_seed_members(seed)
+        .transport(lambda t: t.with_(port=4802))
+        .gossip(lambda g: g.with_(gossip_fanout=5))
+    )
+    assert cfg.membership_config.seed_members == (seed,)
+    assert cfg.transport_config.port == 4802
+    assert cfg.gossip_config.gossip_fanout == 5
+    # original untouched (copy-on-write)
+    assert ClusterConfig.default_local().gossip_config.gossip_fanout == 3
+
+
+def test_membership_config_defaults():
+    m = MembershipConfig()
+    assert m.sync_group == "default"
+    assert m.removed_members_history_size == 42
+    t = TransportConfig()
+    assert t.port == 0 and t.max_frame_length == 2 * 1024 * 1024

@@ -1,0 +1,56 @@
+"""is_overrides truth table, pinned to MembershipRecordTest.java:34-109."""
+
+import pytest
+
+from scalecube_cluster_tpu import Address, Member, MemberStatus, MembershipRecord
+from scalecube_cluster_tpu.cluster_api.membership_record import is_overrides
+
+MEMBER = Member(id="m0", address=Address("127.0.0.1", 4801))
+
+
+def rec(status: MemberStatus, incarnation: int = 0) -> MembershipRecord:
+    return MembershipRecord(MEMBER, status, incarnation)
+
+
+ALIVE, SUSPECT, DEAD = MemberStatus.ALIVE, MemberStatus.SUSPECT, MemberStatus.DEAD
+
+
+def test_overrides_null_record():
+    # Only ALIVE may introduce an unknown member (MembershipRecordTest:
+    # r1Dead/r1Suspect do NOT override a null record).
+    assert is_overrides(rec(ALIVE), None)
+    assert not is_overrides(rec(SUSPECT), None)
+    assert not is_overrides(rec(DEAD), None)
+
+
+def test_dead_is_sticky():
+    # An existing DEAD record is never overridden...
+    for status in (ALIVE, SUSPECT, DEAD):
+        for inc in (0, 1, 100):
+            assert not is_overrides(rec(status, inc), rec(DEAD, 0))
+    # ...and an incoming DEAD record overrides any non-dead record.
+    for status in (ALIVE, SUSPECT):
+        for inc in (0, 1, 100):
+            assert is_overrides(rec(DEAD, 0), rec(status, inc))
+
+
+@pytest.mark.parametrize("incoming", [ALIVE, SUSPECT])
+@pytest.mark.parametrize("existing", [ALIVE, SUSPECT])
+def test_higher_incarnation_wins(incoming, existing):
+    assert is_overrides(rec(incoming, 1), rec(existing, 0))
+    assert not is_overrides(rec(incoming, 0), rec(existing, 1))
+
+
+def test_equal_incarnation_only_suspect_overrides_alive():
+    assert is_overrides(rec(SUSPECT, 5), rec(ALIVE, 5))
+    assert not is_overrides(rec(ALIVE, 5), rec(SUSPECT, 5))
+    assert not is_overrides(rec(ALIVE, 5), rec(ALIVE, 5))
+    assert not is_overrides(rec(SUSPECT, 5), rec(SUSPECT, 5))
+
+
+def test_different_member_raises():
+    other = MembershipRecord(
+        Member(id="other", address=Address("127.0.0.1", 4802)), MemberStatus.ALIVE
+    )
+    with pytest.raises(ValueError):
+        is_overrides(rec(ALIVE), other)
