@@ -1,0 +1,17 @@
+"""Test library: fault injection + cluster factories (reference: cluster-testlib/)."""
+
+from scalecube_cluster_tpu.testlib.network_emulator import (
+    InboundSettings,
+    NetworkEmulator,
+    NetworkEmulatorException,
+    NetworkEmulatorTransport,
+    OutboundSettings,
+)
+
+__all__ = [
+    "InboundSettings",
+    "NetworkEmulator",
+    "NetworkEmulatorException",
+    "NetworkEmulatorTransport",
+    "OutboundSettings",
+]

@@ -1,0 +1,203 @@
+"""Network fault injection at the transport seam.
+
+Reference: cluster-testlib/NetworkEmulator.java:25-411 and
+NetworkEmulatorTransport.java:9-83. Faults are injected in the transport
+decorator, not the OS:
+
+- outbound, per destination: loss percentage and exponentially-distributed
+  delay with a configured mean (NetworkEmulator.java:358-368);
+- inbound, per source: a boolean pass/drop filter on ``listen()``
+  (NetworkEmulatorTransport.java:73-78);
+- directional block/unblock per link or for all links at once;
+- counters for sent / outbound-lost / inbound-lost messages.
+
+Loss surfaces to senders as ``NetworkEmulatorException`` (stack-trace-free in
+the reference, NetworkEmulatorException.java:14-17).
+
+The same fault model exists in the sim backend as per-edge loss/delay/block
+arrays (``sim/faults.py``), so scenarios written against this emulator have a
+1:1 TPU translation.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+from dataclasses import dataclass
+
+from scalecube_cluster_tpu.transport.api import MessageStream, Transport
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.utils.address import Address
+
+logger = logging.getLogger(__name__)
+
+
+class NetworkEmulatorException(ConnectionError):
+    """Signals an emulated outbound loss (NetworkEmulatorException.java:4-18)."""
+
+
+@dataclass(frozen=True)
+class OutboundSettings:
+    """Per-destination outbound link settings (NetworkEmulator.java:309-374)."""
+
+    loss_percent: float = 0.0
+    mean_delay_ms: float = 0.0
+
+    def evaluate_loss(self, rng: random.Random) -> bool:
+        """True if this send should be dropped."""
+        return self.loss_percent > 0 and rng.uniform(0, 100) < self.loss_percent
+
+    def evaluate_delay(self, rng: random.Random) -> float:
+        """Sampled delay in ms, exponentially distributed around the mean
+        (NetworkEmulator.java:358-368)."""
+        if self.mean_delay_ms <= 0:
+            return 0.0
+        return rng.expovariate(1.0 / self.mean_delay_ms)
+
+
+@dataclass(frozen=True)
+class InboundSettings:
+    """Per-source inbound filter (NetworkEmulator inboundSettings)."""
+
+    shall_pass: bool = True
+
+
+class NetworkEmulator:
+    """Mutable fault plan + counters for one node's links."""
+
+    def __init__(self, local: Address, seed: int | None = None):
+        self._local = local
+        self._rng = random.Random(seed)
+        self._outbound: dict[Address, OutboundSettings] = {}
+        self._inbound: dict[Address, InboundSettings] = {}
+        self._default_outbound = OutboundSettings()
+        self._default_inbound = InboundSettings()
+        self.total_message_sent_count = 0
+        self.total_outbound_lost_count = 0
+        self.total_inbound_lost_count = 0
+
+    # -- settings resolution (NetworkEmulator.java:60-85)
+
+    def outbound_settings_of(self, destination: Address) -> OutboundSettings:
+        return self._outbound.get(destination, self._default_outbound)
+
+    def inbound_settings_of(self, source: Address) -> InboundSettings:
+        return self._inbound.get(source, self._default_inbound)
+
+    def set_outbound_settings(
+        self, destination: Address, loss_percent: float, mean_delay_ms: float = 0.0
+    ) -> None:
+        self._outbound[destination] = OutboundSettings(loss_percent, mean_delay_ms)
+
+    def set_default_outbound_settings(
+        self, loss_percent: float, mean_delay_ms: float = 0.0
+    ) -> None:
+        self._default_outbound = OutboundSettings(loss_percent, mean_delay_ms)
+
+    # -- directional blocks (NetworkEmulator.java:87-138, 236-288)
+
+    def block_outbound(self, *destinations: Address) -> None:
+        for d in destinations:
+            self._outbound[d] = OutboundSettings(loss_percent=100.0)
+        logger.debug("%s: blocked outbound to %s", self._local, destinations)
+
+    def unblock_outbound(self, *destinations: Address) -> None:
+        for d in destinations:
+            self._outbound.pop(d, None)
+
+    def block_all_outbound(self) -> None:
+        self._outbound.clear()
+        self._default_outbound = OutboundSettings(loss_percent=100.0)
+
+    def unblock_all_outbound(self) -> None:
+        self._outbound.clear()
+        self._default_outbound = OutboundSettings()
+
+    def block_inbound(self, *sources: Address) -> None:
+        for s in sources:
+            self._inbound[s] = InboundSettings(shall_pass=False)
+
+    def unblock_inbound(self, *sources: Address) -> None:
+        for s in sources:
+            self._inbound.pop(s, None)
+
+    def block_all_inbound(self) -> None:
+        self._inbound.clear()
+        self._default_inbound = InboundSettings(shall_pass=False)
+
+    def unblock_all_inbound(self) -> None:
+        self._inbound.clear()
+        self._default_inbound = InboundSettings()
+
+    def unblock_all(self) -> None:
+        self.unblock_all_outbound()
+        self.unblock_all_inbound()
+
+    # -- fault application (NetworkEmulatorTransport.java:44-51)
+
+    def try_fail_outbound(self, destination: Address) -> None:
+        self.total_message_sent_count += 1
+        if self.outbound_settings_of(destination).evaluate_loss(self._rng):
+            self.total_outbound_lost_count += 1
+            raise NetworkEmulatorException(
+                f"emulated loss {self._local} -> {destination}"
+            )
+
+    async def try_delay_outbound(self, destination: Address) -> None:
+        delay_ms = self.outbound_settings_of(destination).evaluate_delay(self._rng)
+        if delay_ms > 0:
+            await asyncio.sleep(delay_ms / 1000.0)
+
+    def shall_pass_inbound(self, source: Address | None) -> bool:
+        if source is None:
+            return True
+        if self.inbound_settings_of(source).shall_pass:
+            return True
+        self.total_inbound_lost_count += 1
+        return False
+
+
+class NetworkEmulatorTransport(Transport):
+    """Transport decorator applying a NetworkEmulator's fault plan
+    (NetworkEmulatorTransport.java:9-83).
+
+    ``request_response`` is inherited from the SPI base (send + filter
+    listen), so request faults and response-drop faults both apply.
+    """
+
+    def __init__(self, inner: Transport, seed: int | None = None):
+        self._inner = inner
+        self.network_emulator = NetworkEmulator(inner.address, seed=seed)
+
+    @property
+    def address(self) -> Address:
+        return self._inner.address
+
+    async def send(self, to: Address, message: Message) -> None:
+        self.network_emulator.try_fail_outbound(to)
+        await self.network_emulator.try_delay_outbound(to)
+        await self._inner.send(to, message)
+
+    def listen(self) -> MessageStream:
+        inner_stream = self._inner.listen()
+        filtered = MessageStream(on_close=lambda s: inner_stream.close())
+        emulator = self.network_emulator
+
+        async def pump() -> None:
+            try:
+                async for msg in inner_stream:
+                    if emulator.shall_pass_inbound(msg.sender):
+                        filtered._publish(msg)
+            except Exception:
+                logger.exception("inbound fault-filter pump failed")
+            finally:
+                filtered.close()
+
+        # Keep a strong reference: the event loop holds tasks weakly, and a
+        # swallowed pump failure must be logged, not dropped at GC time.
+        filtered._pump_task = asyncio.ensure_future(pump())
+        return filtered
+
+    async def stop(self) -> None:
+        await self._inner.stop()

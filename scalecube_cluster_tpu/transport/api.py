@@ -1,0 +1,137 @@
+"""Transport SPI.
+
+Reference: transport-api/Transport.java:11-72 — the contract every backend
+implements: ``address()``, fire-and-forget ``send``, correlation-id-matched
+``requestResponse``, a multicast inbound ``listen()`` stream, and ``stop()``.
+
+Two backends ship in this framework, exactly mirroring the reference's
+transport-api / transport-netty split:
+
+- ``transport.tcp.TcpTransport`` — asyncio TCP with 4-byte length framing
+  (the reactor-netty equivalent, TransportImpl.java:45-398);
+- the sim engine's in-array delivery (``sim/``), where N co-hosted nodes'
+  messages are batched into one adjacency per tick (SURVEY.md §2.11).
+
+``request_response`` is implemented here once, as send + filter-listen on the
+correlation id — byte-for-byte the reference's strategy
+(TransportImpl.java:228-252) — so decorators such as the NetworkEmulator get
+correct request/response fault semantics by only wrapping ``send``/``listen``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+from abc import ABC, abstractmethod
+from typing import AsyncIterator, Callable
+
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.utils.address import Address
+
+
+class TransportStoppedError(ConnectionError):
+    """Raised when using a transport after ``stop()``."""
+
+
+class MessageStream:
+    """One subscription to a transport's inbound stream.
+
+    Async-iterable; terminates cleanly when the transport stops (reference:
+    ``listen()`` completes on stop, TransportTest.java:242-265). An exception
+    raised by one subscriber must never affect other subscribers
+    (TransportTest.java:268-313), which queue-per-subscriber gives for free.
+    """
+
+    _CLOSED = object()
+
+    def __init__(self, on_close: Callable[["MessageStream"], None]):
+        self._queue: asyncio.Queue = asyncio.Queue()
+        self._on_close = on_close
+        self._closed = False
+
+    def _publish(self, message: Message) -> None:
+        if not self._closed:
+            self._queue.put_nowait(message)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._queue.put_nowait(self._CLOSED)
+            self._on_close(self)
+
+    def __aiter__(self) -> AsyncIterator[Message]:
+        return self
+
+    async def __anext__(self) -> Message:
+        item = await self._queue.get()
+        if item is self._CLOSED:
+            raise StopAsyncIteration
+        return item
+
+
+class Transport(ABC):
+    """Abstract transport (Transport.java:11-72)."""
+
+    @property
+    @abstractmethod
+    def address(self) -> Address:
+        """The address this transport is listening on."""
+
+    @abstractmethod
+    async def send(self, to: Address, message: Message) -> None:
+        """Fire-and-forget send; raises on connect/write failure."""
+
+    @abstractmethod
+    def listen(self) -> MessageStream:
+        """Subscribe to all inbound messages (multicast)."""
+
+    @abstractmethod
+    async def stop(self) -> None:
+        """Close server + connections; completes all listen() streams."""
+
+    async def request_response(
+        self, to: Address, request: Message, timeout: float | None = None
+    ) -> Message:
+        """Send ``request`` and await the first inbound message with the same
+        correlation id (TransportImpl.java:228-252).
+
+        ``timeout`` is seconds (None = wait forever); raises
+        ``asyncio.TimeoutError`` on expiry and propagates send failures.
+        """
+        cid = request.correlation_id
+        if not cid:
+            raise ValueError("request_response requires a correlation id")
+        stream = self.listen()
+        try:
+            await self.send(to, request)
+
+            async def first_match() -> Message:
+                async for msg in stream:
+                    if msg.correlation_id == cid:
+                        return msg
+                raise TransportStoppedError("transport stopped awaiting response")
+
+            return await asyncio.wait_for(first_match(), timeout)
+        finally:
+            stream.close()
+
+
+class _ListenMixin:
+    """Shared multicast-subscriber bookkeeping for concrete transports."""
+
+    def __init__(self) -> None:
+        self._streams: set[MessageStream] = set()
+
+    def listen(self) -> MessageStream:
+        stream = MessageStream(on_close=self._streams.discard)
+        self._streams.add(stream)
+        return stream
+
+    def _dispatch(self, message: Message) -> None:
+        for stream in list(self._streams):
+            stream._publish(message)
+
+    def _complete_streams(self) -> None:
+        for stream in list(self._streams):
+            with contextlib.suppress(Exception):
+                stream.close()

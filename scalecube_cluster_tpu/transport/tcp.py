@@ -1,0 +1,230 @@
+"""Asyncio TCP transport — the reactor-netty equivalent.
+
+Reference: transport-netty/TransportImpl.java:45-398. Matches its observable
+semantics:
+
+- 4-byte big-endian length-prefixed frames with a max-frame guard
+  (LengthFieldPrepender/LengthFieldBasedFrameDecoder, TransportImpl.java:383-397);
+- one lazily-created cached outbound connection per destination, evicted on
+  disconnect or connect error (TransportImpl.java:56, 299-322) — which also
+  yields the reference's per-connection FIFO ordering
+  (TransportSendOrderTest.java:41-207);
+- flush (drain) per message send (TransportImpl.java:280);
+- a single multicast inbound stream fed by all accepted connections
+  (TransportImpl.java:53-54), completed on ``stop()``;
+- send to an unresolvable/unreachable destination fails the returned
+  awaitable (TransportTest.java:43-85).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import struct
+
+from scalecube_cluster_tpu.cluster_api.config import TransportConfig
+from scalecube_cluster_tpu.transport.api import (
+    Transport,
+    TransportStoppedError,
+    _ListenMixin,
+)
+from scalecube_cluster_tpu.transport.codec import DEFAULT_CODEC, MessageCodec
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.utils.address import Address
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+
+
+class _Connection:
+    """One cached outbound TCP connection (TransportImpl.getOrConnect analog)."""
+
+    def __init__(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        self.reader = reader
+        self.writer = writer
+        self.reader_task: asyncio.Task | None = None
+
+    def close(self) -> None:
+        if self.reader_task is not None:
+            self.reader_task.cancel()
+        with contextlib.suppress(Exception):
+            self.writer.close()
+
+
+class TcpTransport(_ListenMixin, Transport):
+    """TCP transport bound to one listen socket (TransportImpl.java:45-398)."""
+
+    def __init__(self, config: TransportConfig, codec: MessageCodec | None = None):
+        _ListenMixin.__init__(self)
+        self._config = config
+        self._codec = codec or DEFAULT_CODEC
+        self._server: asyncio.AbstractServer | None = None
+        self._address: Address | None = None
+        # Address -> future resolving to an established _Connection; a future
+        # (not the connection) is cached so concurrent senders share one dial
+        # (TransportImpl.java:299-322).
+        self._connections: dict[Address, asyncio.Future[_Connection]] = {}
+        self._accepted: set[asyncio.Task] = set()
+        self._stopped = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    @classmethod
+    async def bind(
+        cls, config: TransportConfig | None = None, codec: MessageCodec | None = None
+    ) -> "TcpTransport":
+        """Bind a listen socket (TransportImpl.bind, :160-183). Port 0 picks an
+        ephemeral port, reported via ``transport.address``."""
+        self = cls(config or TransportConfig(), codec)
+        host = self._config.host or "127.0.0.1"
+        self._server = await asyncio.start_server(
+            self._on_accept, host=host, port=self._config.port
+        )
+        port = self._server.sockets[0].getsockname()[1]
+        self._address = Address(host, port)
+        logger.debug("transport bound on %s", self._address)
+        return self
+
+    @property
+    def address(self) -> Address:
+        if self._address is None:
+            raise TransportStoppedError("transport is not bound")
+        return self._address
+
+    async def stop(self) -> None:
+        """Close the server and all connections; completes listen() streams
+        (TransportImpl.java:196-215)."""
+        if self._stopped:
+            return
+        self._stopped = True
+        if self._server is not None:
+            self._server.close()
+        for fut in list(self._connections.values()):
+            if fut.done() and not fut.cancelled() and fut.exception() is None:
+                fut.result().close()
+            else:
+                fut.cancel()
+        self._connections.clear()
+        # Cancel accepted-connection handlers BEFORE wait_closed(): since
+        # Python 3.12 Server.wait_closed() blocks until every handler
+        # completes, so the order matters or stop() deadlocks while a peer
+        # holds its outbound connection open.
+        for task in list(self._accepted):
+            task.cancel()
+        await asyncio.sleep(0)  # let cancelled handlers unwind
+        if self._server is not None:
+            with contextlib.suppress(Exception):
+                await self._server.wait_closed()
+        self._complete_streams()
+
+    # -- outbound ------------------------------------------------------------
+
+    async def send(self, to: Address, message: Message) -> None:
+        if self._stopped:
+            raise TransportStoppedError("transport is stopped")
+        # Serialize + frame-length check before dialing so an oversized
+        # message neither wastes a dial nor masks its ValueError behind a
+        # connect error when the peer is unreachable.
+        payload = self._codec.serialize(message)
+        if len(payload) > self._config.max_frame_length:
+            raise ValueError(
+                f"frame of {len(payload)} bytes exceeds max_frame_length "
+                f"{self._config.max_frame_length}"
+            )
+        conn = await self._get_or_connect(to)
+        try:
+            conn.writer.write(_LEN.pack(len(payload)) + payload)
+            await conn.writer.drain()  # flush per send (TransportImpl.java:280)
+        except (ConnectionError, OSError):
+            self._evict(to)
+            raise
+
+    async def _get_or_connect(self, to: Address) -> _Connection:
+        fut = self._connections.get(to)
+        if fut is None:
+            fut = asyncio.get_running_loop().create_future()
+            self._connections[to] = fut
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_connection(to.host, to.port),
+                    timeout=self._config.connect_timeout / 1000.0,
+                )
+                conn = _Connection(reader, writer)
+                if fut.cancelled() or self._stopped:
+                    # stop() cancelled the cached future while we dialed.
+                    conn.close()
+                    raise TransportStoppedError("transport is stopped")
+                # Responses may ride back on the outbound socket too; feed
+                # them into the same inbound stream.
+                conn.reader_task = asyncio.create_task(
+                    self._read_loop(reader, evict=to)
+                )
+                fut.set_result(conn)
+            except BaseException as exc:
+                self._evict(to)
+                if not fut.done():
+                    if isinstance(exc, asyncio.CancelledError):
+                        # The dialing sender was cancelled; fail waiters with
+                        # a connect error rather than poisoning them with a
+                        # CancelledError they didn't cause (shield() doesn't
+                        # protect against the shared future itself failing
+                        # with cancellation).
+                        fut.set_exception(
+                            ConnectionError(f"connect to {to} aborted")
+                        )
+                    else:
+                        fut.set_exception(exc)
+                    # The exception is re-raised below for this caller;
+                    # mark it retrieved so no 'never retrieved' warning fires.
+                    fut.exception()
+                raise
+        return await asyncio.shield(fut)
+
+    def _evict(self, to: Address) -> None:
+        fut = self._connections.pop(to, None)
+        if fut is not None and fut.done() and not fut.cancelled():
+            if fut.exception() is None:
+                fut.result().close()
+
+    # -- inbound -------------------------------------------------------------
+
+    async def _on_accept(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        assert task is not None
+        self._accepted.add(task)
+        try:
+            await self._read_loop(reader)
+        finally:
+            self._accepted.discard(task)
+            with contextlib.suppress(Exception):
+                writer.close()
+
+    async def _read_loop(
+        self, reader: asyncio.StreamReader, evict: Address | None = None
+    ) -> None:
+        """Frame-decode loop: 4-byte length prefix, then codec bytes."""
+        try:
+            while True:
+                header = await reader.readexactly(_LEN.size)
+                (length,) = _LEN.unpack(header)
+                if length > self._config.max_frame_length:
+                    logger.warning("dropping oversized frame of %d bytes", length)
+                    break
+                payload = await reader.readexactly(length)
+                try:
+                    message = self._codec.deserialize(payload)
+                except Exception:
+                    logger.exception("undecodable frame; closing connection")
+                    break
+                self._dispatch(message)
+        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+            pass
+        finally:
+            if evict is not None:
+                self._evict(evict)

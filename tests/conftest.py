@@ -16,10 +16,26 @@ if "xla_force_host_platform_device_count" not in xla_flags:
     ).strip()
 
 import asyncio  # noqa: E402
+import inspect  # noqa: E402
 
-import pytest  # noqa: E402
+
+def pytest_configure(config):
+    # The marker is documentation-only: the runner below executes EVERY
+    # coroutine test on a fresh loop, marked or not (pytest-asyncio is not
+    # in the image; registration just silences unknown-marker warnings).
+    config.addinivalue_line(
+        "markers", "asyncio: run the (async) test function on a fresh event loop"
+    )
 
 
-@pytest.fixture
-def event_loop_policy():
-    return asyncio.DefaultEventLoopPolicy()
+def pytest_pyfunc_call(pyfuncitem):
+    """Minimal asyncio test runner (pytest-asyncio is not in the image)."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(func(**kwargs))
+        return True
+    return None

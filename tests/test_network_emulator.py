@@ -1,0 +1,131 @@
+"""NetworkEmulator fault-injection tests.
+
+Ports NetworkEmulatorTest.java:10+ (settings resolution) and the emulation
+parts of TransportTest.java:112-134 (loss statistics), :318-340 (block /
+unblock).
+"""
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu import Address
+from scalecube_cluster_tpu.cluster_api.config import TransportConfig
+from scalecube_cluster_tpu.testlib import (
+    NetworkEmulator,
+    NetworkEmulatorException,
+    NetworkEmulatorTransport,
+    OutboundSettings,
+)
+from scalecube_cluster_tpu.transport import Message, TcpTransport
+
+
+async def bind_emulated(seed: int = 1) -> NetworkEmulatorTransport:
+    inner = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    return NetworkEmulatorTransport(inner, seed=seed)
+
+
+def test_settings_resolution():
+    em = NetworkEmulator(Address("127.0.0.1", 1))
+    dst = Address("127.0.0.1", 2)
+    assert em.outbound_settings_of(dst) == OutboundSettings(0.0, 0.0)
+    em.set_outbound_settings(dst, 25.0, 10.0)
+    assert em.outbound_settings_of(dst) == OutboundSettings(25.0, 10.0)
+    em.set_default_outbound_settings(50.0)
+    other = Address("127.0.0.1", 3)
+    assert em.outbound_settings_of(other).loss_percent == 50.0
+    assert em.outbound_settings_of(dst).loss_percent == 25.0
+    em.unblock_all()
+    assert em.outbound_settings_of(other).loss_percent == 0.0
+
+
+@pytest.mark.asyncio
+async def test_loss_statistics():
+    """~25% loss yields roughly 25% NetworkEmulatorExceptions (TransportTest:112-134)."""
+    a, b = await bind_emulated(seed=42), await bind_emulated(seed=43)
+    try:
+        a.network_emulator.set_outbound_settings(b.address, 25.0)
+        total, lost = 400, 0
+        for i in range(total):
+            try:
+                await a.send(
+                    b.address,
+                    Message.create(qualifier="q", data=i, sender=a.address),
+                )
+            except NetworkEmulatorException:
+                lost += 1
+        assert 0.15 < lost / total < 0.35
+        assert a.network_emulator.total_message_sent_count == total
+        assert a.network_emulator.total_outbound_lost_count == lost
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_block_and_unblock_outbound():
+    a, b = await bind_emulated(), await bind_emulated()
+    try:
+        a.network_emulator.block_outbound(b.address)
+        with pytest.raises(NetworkEmulatorException):
+            await a.send(b.address, Message.create(qualifier="q", sender=a.address))
+        a.network_emulator.unblock_outbound(b.address)
+        await a.send(b.address, Message.create(qualifier="q", sender=a.address))
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_inbound_block_filters_listen():
+    a, b = await bind_emulated(), await bind_emulated()
+    try:
+        stream = b.listen()
+        b.network_emulator.block_inbound(a.address)
+        await a.send(
+            b.address, Message.create(qualifier="q", data="dropped", sender=a.address)
+        )
+        await asyncio.sleep(0.1)  # let the message arrive (and be dropped)
+        b.network_emulator.unblock_inbound(a.address)
+        await a.send(
+            b.address, Message.create(qualifier="q", data="passes", sender=a.address)
+        )
+
+        async def first():
+            async for m in stream:
+                return m.data
+
+        assert await asyncio.wait_for(first(), timeout=2) == "passes"
+        assert b.network_emulator.total_inbound_lost_count == 1
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_request_response_with_response_loss():
+    """Emulated loss of the response leaves the requester timing out."""
+    a, b = await bind_emulated(), await bind_emulated()
+    try:
+        async def responder():
+            async for msg in b.listen():
+                try:
+                    await b.send(
+                        msg.sender, msg.with_data("pong").with_sender(b.address)
+                    )
+                except NetworkEmulatorException:
+                    pass
+
+        task = asyncio.create_task(responder())
+        b.network_emulator.block_outbound(a.address)
+        req = Message.create(qualifier="q", correlation_id="c1", sender=a.address)
+        with pytest.raises(asyncio.TimeoutError):
+            await a.request_response(b.address, req, timeout=0.3)
+        b.network_emulator.unblock_outbound(a.address)
+        req2 = Message.create(qualifier="q", correlation_id="c2", sender=a.address)
+        resp = await a.request_response(b.address, req2, timeout=2)
+        assert resp.data == "pong"
+        task.cancel()
+    finally:
+        await a.stop()
+        await b.stop()

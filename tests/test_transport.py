@@ -1,0 +1,192 @@
+"""TCP transport integration tests.
+
+Ports the observable semantics of TransportTest.java:42-341 and
+TransportSendOrderTest.java:41-207 onto the asyncio backend: loopback
+ping-pong request/response, connect-failure propagation, per-connection FIFO
+ordering, listen() completion on stop, and subscriber isolation.
+"""
+
+import asyncio
+import dataclasses
+
+import pytest
+
+from scalecube_cluster_tpu import Address
+from scalecube_cluster_tpu.cluster_api.config import TransportConfig
+from scalecube_cluster_tpu.transport import (
+    JsonMessageCodec,
+    Message,
+    TcpTransport,
+    register_data_type,
+)
+
+
+async def bind() -> TcpTransport:
+    return await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+
+
+async def echo_server(transport: TcpTransport) -> asyncio.Task:
+    """Reply to every inbound message over the wire, echoing cid."""
+
+    async def serve():
+        async for msg in transport.listen():
+            reply = msg.with_data(("echo", msg.data)).with_sender(transport.address)
+            await transport.send(msg.sender, reply)
+
+    return asyncio.create_task(serve())
+
+
+@pytest.mark.asyncio
+async def test_ping_pong_request_response():
+    a, b = await bind(), await bind()
+    server = await echo_server(b)
+    try:
+        req = Message.create(
+            qualifier="hi", data="ping", correlation_id="cid-1", sender=a.address
+        )
+        resp = await a.request_response(b.address, req, timeout=2)
+        # Tuples round-trip as tuples over the wire (tagged in the codec).
+        assert resp.data == ("echo", "ping")
+        assert resp.correlation_id == "cid-1"
+    finally:
+        server.cancel()
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_send_to_unreachable_fails():
+    a = await bind()
+    try:
+        dead = Address("127.0.0.1", 1)  # nothing listens on port 1
+        with pytest.raises((ConnectionError, OSError, asyncio.TimeoutError)):
+            await a.send(dead, Message.create(qualifier="x", sender=a.address))
+    finally:
+        await a.stop()
+
+
+@pytest.mark.asyncio
+async def test_request_response_timeout():
+    a, b = await bind(), await bind()  # b never answers
+    try:
+        req = Message.create(qualifier="q", correlation_id="c-1", sender=a.address)
+        with pytest.raises(asyncio.TimeoutError):
+            await a.request_response(b.address, req, timeout=0.2)
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_per_connection_fifo_order():
+    """TransportSendOrderTest.java:41-207 — single cached connection keeps FIFO."""
+    a, b = await bind(), await bind()
+    try:
+        n = 200
+        stream = b.listen()
+        for i in range(n):
+            await a.send(
+                b.address, Message.create(qualifier="seq", data=i, sender=a.address)
+            )
+        received = []
+        async def collect():
+            async for msg in stream:
+                received.append(msg.data)
+                if len(received) == n:
+                    return
+        await asyncio.wait_for(collect(), timeout=5)
+        assert received == list(range(n))
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_listen_completes_on_stop():
+    """TransportTest.java:242-265 — listen() streams end when transport stops."""
+    a = await bind()
+    stream = a.listen()
+
+    async def drain():
+        return [m async for m in stream]
+
+    task = asyncio.create_task(drain())
+    await asyncio.sleep(0.05)
+    await a.stop()
+    assert await asyncio.wait_for(task, timeout=2) == []
+
+
+@pytest.mark.asyncio
+async def test_subscriber_isolation():
+    """TransportTest.java:268-313 — a failing subscriber doesn't affect others."""
+    a, b = await bind(), await bind()
+    try:
+        good = b.listen()
+        bad = b.listen()
+
+        async def bad_consumer():
+            async for _ in bad:
+                raise RuntimeError("subscriber blew up")
+
+        bad_task = asyncio.create_task(bad_consumer())
+        for i in range(3):
+            await a.send(
+                b.address, Message.create(qualifier="x", data=i, sender=a.address)
+            )
+        got = []
+        async def collect():
+            async for m in good:
+                got.append(m.data)
+                if len(got) == 3:
+                    return
+        await asyncio.wait_for(collect(), timeout=2)
+        assert got == [0, 1, 2]
+        with pytest.raises(RuntimeError):
+            await bad_task
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_oversized_frame_rejected_on_send():
+    a = await bind()
+    small = await TcpTransport.bind(TransportConfig(max_frame_length=64))
+    try:
+        big = Message.create(qualifier="big", data="x" * 1000, sender=small.address)
+        with pytest.raises(ValueError):
+            await small.send(a.address, big)
+    finally:
+        await a.stop()
+        await small.stop()
+
+
+@register_data_type("test/payload")
+@dataclasses.dataclass(frozen=True)
+class _Payload:
+    name: str
+    count: int
+    nested: dict
+
+
+def test_codec_roundtrip_registered_dataclass():
+    codec = JsonMessageCodec()
+    msg = Message.create(
+        qualifier="q/x",
+        data=_Payload("n", 7, {"k": [1, 2, {"d": None}]}),
+        correlation_id="cid-9",
+        sender=Address("10.0.0.1", 4801),
+    )
+    out = codec.deserialize(codec.serialize(msg))
+    assert out.data == _Payload("n", 7, {"k": [1, 2, {"d": None}]})
+    assert out.qualifier == "q/x" and out.correlation_id == "cid-9"
+    assert out.sender == Address("10.0.0.1", 4801)
+
+
+def test_codec_rejects_unregistered_type():
+    class NotRegistered:
+        pass
+
+    codec = JsonMessageCodec()
+    with pytest.raises(TypeError):
+        codec.serialize(Message.create(qualifier="q", data=NotRegistered()))
