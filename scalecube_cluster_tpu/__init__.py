@@ -26,6 +26,11 @@ SYNC anti-entropy, per-member metadata) designed JAX-first:
 """
 
 from scalecube_cluster_tpu import cluster_math
+from scalecube_cluster_tpu.cluster.cluster import (
+    Cluster,
+    ClusterMessageHandler,
+    ClusterMonitor,
+)
 from scalecube_cluster_tpu.cluster_api.config import (
     ClusterConfig,
     FailureDetectorConfig,
@@ -42,7 +47,10 @@ __version__ = "0.1.0"
 
 __all__ = [
     "Address",
+    "Cluster",
     "ClusterConfig",
+    "ClusterMessageHandler",
+    "ClusterMonitor",
     "FailureDetectorConfig",
     "GossipConfig",
     "Member",

@@ -1,0 +1,72 @@
+"""Host-side protocol engines (reference: cluster/ module).
+
+The four SWIM components and the facade that wires them
+(ClusterImpl.java:39-515): failure detector (fdetector/), gossip
+dissemination (gossip/), membership + anti-entropy (membership/), metadata
+store (metadata/).
+"""
+
+from scalecube_cluster_tpu.cluster.cluster import (
+    Cluster,
+    ClusterMessageHandler,
+    ClusterMonitor,
+    SenderAwareTransport,
+)
+from scalecube_cluster_tpu.cluster.fdetector import (
+    FailureDetector,
+    FailureDetectorEvent,
+)
+from scalecube_cluster_tpu.cluster.gossip import GossipProtocol
+from scalecube_cluster_tpu.cluster.membership import MembershipProtocol, UpdateReason
+from scalecube_cluster_tpu.cluster.metadata import MetadataStore
+from scalecube_cluster_tpu.cluster.payloads import (
+    GOSSIP_REQ,
+    MEMBERSHIP_GOSSIP,
+    METADATA_REQ,
+    METADATA_RESP,
+    PING,
+    PING_ACK,
+    PING_REQ,
+    SYNC,
+    SYNC_ACK,
+    SYSTEM_GOSSIPS,
+    SYSTEM_MESSAGES,
+    AckType,
+    GetMetadataRequest,
+    GetMetadataResponse,
+    Gossip,
+    GossipRequest,
+    PingData,
+    SyncData,
+)
+
+__all__ = [
+    "AckType",
+    "Cluster",
+    "ClusterMessageHandler",
+    "ClusterMonitor",
+    "FailureDetector",
+    "FailureDetectorEvent",
+    "GetMetadataRequest",
+    "GetMetadataResponse",
+    "Gossip",
+    "GossipProtocol",
+    "GossipRequest",
+    "MEMBERSHIP_GOSSIP",
+    "METADATA_REQ",
+    "METADATA_RESP",
+    "MembershipProtocol",
+    "MetadataStore",
+    "PING",
+    "PING_ACK",
+    "PING_REQ",
+    "PingData",
+    "SenderAwareTransport",
+    "SYNC",
+    "SYNC_ACK",
+    "SYSTEM_GOSSIPS",
+    "SYSTEM_MESSAGES",
+    "SyncData",
+    "UpdateReason",
+    "GOSSIP_REQ",
+]

@@ -1,0 +1,317 @@
+"""Cluster facade + runtime wiring.
+
+Reference: cluster-api/Cluster.java:10-151 (the user API),
+ClusterMessageHandler.java:6-19 (callbacks), and ClusterImpl.java:39-515 (the
+wiring): bind transport -> mint local member (with optional external
+host/port override, :277-288) -> construct failure detector, gossip,
+metadata store, membership -> start them in that order (:219-224).
+
+Replicated details:
+
+- ``SenderAwareTransport``: every outgoing message is stamped with the local
+  address as ``sender`` (ClusterImpl.java:471-514).
+- System qualifiers are filtered out of the user-facing message and gossip
+  streams (ClusterImpl.java:43-57, 255-263).
+- Shutdown: spread the leave rumor (best effort, bounded), stop components
+  in reverse, stop the transport (:376-422).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import random
+from dataclasses import dataclass
+from typing import Any, Awaitable, Callable
+
+from scalecube_cluster_tpu.cluster.fdetector import FailureDetector
+from scalecube_cluster_tpu.cluster.gossip import GossipProtocol
+from scalecube_cluster_tpu.cluster.membership import MembershipProtocol
+from scalecube_cluster_tpu.cluster.metadata import MetadataStore
+from scalecube_cluster_tpu.cluster.payloads import SYSTEM_GOSSIPS, SYSTEM_MESSAGES
+from scalecube_cluster_tpu.cluster_api.config import ClusterConfig
+from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
+from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.transport.api import MessageStream, Transport
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.transport.tcp import TcpTransport
+from scalecube_cluster_tpu.utils.address import Address
+from scalecube_cluster_tpu.utils.ids import CorrelationIdGenerator
+from scalecube_cluster_tpu.utils.streams import Stream, filtered
+
+logger = logging.getLogger(__name__)
+
+#: Builds the underlying transport; tests inject NetworkEmulator-wrapped ones
+#: (the reference testlib does the same at BaseTest.createTransport).
+TransportFactory = Callable[[ClusterConfig], Awaitable[Transport]]
+
+
+async def _default_transport_factory(config: ClusterConfig) -> Transport:
+    return await TcpTransport.bind(config.transport_config)
+
+
+class ClusterMessageHandler:
+    """Override any of these callbacks (ClusterMessageHandler.java:6-19)."""
+
+    def on_message(self, message: Message) -> None:
+        """A point-to-point message addressed to this node."""
+
+    def on_gossip(self, gossip: Message) -> None:
+        """A user gossip that reached this node."""
+
+    def on_membership_event(self, event: MembershipEvent) -> None:
+        """The cluster view changed."""
+
+
+class SenderAwareTransport(Transport):
+    """Stamps the local address on every outgoing message
+    (ClusterImpl.java:471-514)."""
+
+    def __init__(self, inner: Transport, sender: Address):
+        self._inner = inner
+        self._sender = sender
+
+    @property
+    def address(self) -> Address:
+        return self._inner.address
+
+    async def send(self, to: Address, message: Message) -> None:
+        await self._inner.send(to, message.with_sender(self._sender))
+
+    def listen(self) -> MessageStream:
+        return self._inner.listen()
+
+    async def stop(self) -> None:
+        await self._inner.stop()
+
+
+@dataclass(frozen=True)
+class ClusterMonitor:
+    """Snapshot of one node's introspection state — the JMX MBean equivalent
+    (ClusterImpl.java:441-469, MembershipProtocolImpl.java:732-791)."""
+
+    member: Member
+    incarnation: int
+    alive_members: tuple[Member, ...]
+    suspected_members: tuple[Member, ...]
+    removed_members: tuple[Member, ...]
+    metadata: Any
+
+
+class Cluster:
+    """A running cluster node (Cluster.java:10-151 + ClusterImpl.java:39-515).
+
+    Create with ``await Cluster.start(config, handler)``; stop with
+    ``await cluster.shutdown()``.
+    """
+
+    def __init__(
+        self,
+        config: ClusterConfig,
+        transport: Transport,
+        local_member: Member,
+        failure_detector: FailureDetector,
+        gossip: GossipProtocol,
+        metadata_store: MetadataStore,
+        membership: MembershipProtocol,
+    ):
+        self._config = config
+        self._transport = transport
+        self._member = local_member
+        self._fd = failure_detector
+        self._gossip = gossip
+        self._metadata = metadata_store
+        self._membership = membership
+        self._handler_tasks: list[asyncio.Task] = []
+        self._shutdown_event = asyncio.Event()
+        self._stopped = False
+
+    # -- bootstrap (ClusterImpl.doStart0, :170-227) ---------------------------
+
+    @classmethod
+    async def start(
+        cls,
+        config: ClusterConfig | None = None,
+        handler: ClusterMessageHandler | None = None,
+        transport_factory: TransportFactory | None = None,
+        seed: int | None = None,
+    ) -> "Cluster":
+        config = config or ClusterConfig()
+        factory = transport_factory or _default_transport_factory
+        transport = await factory(config)
+        local_member = cls._create_local_member(config, transport.address)
+        transport = SenderAwareTransport(transport, local_member.address)
+        rng = random.Random(seed)
+        cid = CorrelationIdGenerator(local_member.id)
+        fd = FailureDetector(
+            transport,
+            local_member,
+            config.failure_detector_config,
+            cid,
+            rng=random.Random(rng.random()),
+        )
+        gossip = GossipProtocol(
+            transport,
+            local_member,
+            config.gossip_config,
+            rng=random.Random(rng.random()),
+        )
+        metadata = MetadataStore(
+            transport, local_member, config.metadata, config.metadata_timeout, cid
+        )
+        membership = MembershipProtocol(
+            transport,
+            local_member,
+            config,
+            fd,
+            gossip,
+            metadata,
+            cid,
+            rng=random.Random(rng.random()),
+        )
+        self = cls(config, transport, local_member, fd, gossip, metadata, membership)
+        # Start order mirrors ClusterImpl.java:219-224: FD, gossip, metadata,
+        # user handler streams, membership (join) last.
+        fd.start()
+        gossip.start()
+        metadata.start()
+        if handler is not None:
+            self._start_handler(handler)
+        await membership.start()
+        logger.info("%s: started (seeds=%s)", local_member, membership._seeds)
+        return self
+
+    @staticmethod
+    def _create_local_member(config: ClusterConfig, bound: Address) -> Member:
+        """Mint the local identity; external host/port may override the
+        advertised address (ClusterImpl.createLocalMember, :277-288)."""
+        host = config.external_host or bound.host
+        port = config.external_port or bound.port
+        return Member.create(Address(host, port), alias=config.member_alias)
+
+    def _start_handler(self, handler: ClusterMessageHandler) -> None:
+        async def pump(stream, callback) -> None:
+            async for item in stream:
+                try:
+                    callback(item)
+                except Exception:
+                    logger.exception("%s: user handler failed", self._member)
+
+        self._handler_tasks = [
+            asyncio.create_task(pump(self.listen(), handler.on_message)),
+            asyncio.create_task(pump(self.listen_gossip(), handler.on_gossip)),
+            asyncio.create_task(
+                pump(self.listen_membership(), handler.on_membership_event)
+            ),
+        ]
+
+    # -- identity & views (Cluster.java:22-77) --------------------------------
+
+    @property
+    def address(self) -> Address:
+        return self._member.address
+
+    def member(self) -> Member:
+        return self._member
+
+    def members(self) -> list[Member]:
+        return self._membership.members()
+
+    def other_members(self) -> list[Member]:
+        return self._membership.other_members()
+
+    def member_by_id(self, member_id: str) -> Member | None:
+        return self._membership.member_by_id(member_id)
+
+    def member_by_address(self, address: Address) -> Member | None:
+        return self._membership.member_by_address(address)
+
+    # -- messaging (Cluster.java:79-108) --------------------------------------
+
+    async def send(self, target: Member | Address, message: Message) -> None:
+        address = target.address if isinstance(target, Member) else target
+        await self._transport.send(address, message)
+
+    async def request_response(
+        self, target: Member | Address, request: Message, timeout: float | None = None
+    ) -> Message:
+        address = target.address if isinstance(target, Member) else target
+        return await self._transport.request_response(address, request, timeout)
+
+    def listen(self) -> Stream[Message]:
+        """User-level point-to-point messages: system traffic filtered out
+        (ClusterImpl.java:255-258)."""
+        return _filtered(self._transport.listen(), SYSTEM_MESSAGES)
+
+    # -- gossip (Cluster.java:110-118) ----------------------------------------
+
+    def spread_gossip(self, message: Message) -> asyncio.Future[str]:
+        return self._gossip.spread(message.with_sender(self._member.address))
+
+    def listen_gossip(self) -> Stream[Message]:
+        """User-level gossips (membership rumors filtered out,
+        ClusterImpl.java:260-263)."""
+        return _filtered(self._gossip.listen(), SYSTEM_GOSSIPS)
+
+    # -- membership events ----------------------------------------------------
+
+    def listen_membership(self) -> Stream[MembershipEvent]:
+        return self._membership.listen()
+
+    # -- metadata (Cluster.java:120-139) --------------------------------------
+
+    def metadata(self, member: Member | None = None) -> Any:
+        return self._metadata.metadata(member)
+
+    async def update_metadata(self, metadata: Any) -> None:
+        """Replace local metadata and bump incarnation so peers re-fetch and
+        emit UPDATED (ClusterImpl.java:360-369)."""
+        self._metadata.update_metadata(metadata)
+        self._membership.update_incarnation()
+
+    # -- introspection --------------------------------------------------------
+
+    def monitor(self) -> ClusterMonitor:
+        return ClusterMonitor(
+            member=self._member,
+            incarnation=self._membership.incarnation,
+            alive_members=tuple(self._membership.aliveness(MemberStatus.ALIVE)),
+            suspected_members=tuple(self._membership.aliveness(MemberStatus.SUSPECT)),
+            removed_members=tuple(self._membership.removed_history()),
+            metadata=self._metadata.metadata(),
+        )
+
+    # -- shutdown (ClusterImpl.java:372-422) ----------------------------------
+
+    async def shutdown(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        logger.info("%s: shutting down", self._member)
+        # Best-effort leave rumor, bounded like the reference's 3s leave await.
+        with contextlib.suppress(asyncio.TimeoutError, asyncio.CancelledError):
+            leave = self._membership.leave()
+            await asyncio.wait_for(asyncio.shield(leave), timeout=3.0)
+        for task in self._handler_tasks:
+            task.cancel()
+        self._handler_tasks.clear()
+        self._membership.stop()
+        self._metadata.stop()
+        self._gossip.stop()
+        self._fd.stop()
+        await self._transport.stop()
+        self._shutdown_event.set()
+
+    async def on_shutdown(self) -> None:
+        """Resolves once the node has fully shut down (Cluster.onShutdown)."""
+        await self._shutdown_event.wait()
+
+    @property
+    def is_shutdown(self) -> bool:
+        return self._shutdown_event.is_set()
+
+
+def _filtered(stream: Stream, excluded_qualifiers: frozenset[str]) -> Stream:
+    """User stream = source minus system qualifiers (ClusterImpl.java:255-263)."""
+    return filtered(stream, lambda msg: msg.qualifier not in excluded_qualifiers)

@@ -1,0 +1,272 @@
+"""SWIM probe failure detector.
+
+Reference: fdetector/FailureDetectorImpl.java:29-414. Behavior replicated:
+
+- Every ``ping_interval`` pick the next member from a shuffled round-robin
+  list (new members are inserted at a random position, :323-333; the cursor
+  reshuffles at wrap, :340-349) and direct-probe it with a correlation-id
+  PING, deadline ``ping_timeout`` (:126-170).
+- On direct timeout, probe indirectly through ``ping_req_members`` random
+  relays within the remaining ``ping_interval - ping_timeout`` budget
+  (:160-208). A relay transits the PING to the target (:255-277); the target
+  acks to the relay, which forwards the ack to the origin (:283-305).
+- An ack tells whether the address answered as the probed member
+  (``DEST_OK``) or as a different/restarted process (``DEST_GONE``,
+  PingData.java:8-23); GONE maps to DEAD, OK to ALIVE, and silence to
+  SUSPECT (:370-391).
+- Each round emits one ``FailureDetectorEvent`` consumed by the membership
+  protocol (MembershipProtocolImpl.java:376-404).
+
+Single-writer discipline: all state mutation happens on this node's asyncio
+tasks (the analog of the reference's per-node scheduler, ClusterImpl.java:178).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import random
+from dataclasses import dataclass, replace
+
+from scalecube_cluster_tpu.cluster.payloads import (
+    PING,
+    PING_ACK,
+    PING_REQ,
+    AckType,
+    PingData,
+)
+from scalecube_cluster_tpu.cluster_api.config import FailureDetectorConfig
+from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
+from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.transport.api import Transport
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.utils.ids import CorrelationIdGenerator
+from scalecube_cluster_tpu.utils.streams import Multicast, Stream
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class FailureDetectorEvent:
+    """Per-round probe verdict (FailureDetectorEvent.java:7-29)."""
+
+    member: Member
+    status: MemberStatus
+
+
+class FailureDetector:
+    """One node's probe engine (FailureDetectorImpl.java:29-414)."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        local_member: Member,
+        config: FailureDetectorConfig,
+        cid_generator: CorrelationIdGenerator,
+        rng: random.Random | None = None,
+    ):
+        self._transport = transport
+        self._local = local_member
+        self._config = config
+        self._cid = cid_generator
+        self._rng = rng or random.Random()
+        self._events: Multicast[FailureDetectorEvent] = Multicast()
+        # Shuffled round-robin probe list (FailureDetectorImpl.java:55, 323-349).
+        self._ping_members: list[Member] = []
+        self._cursor = 0
+        self._period = 0
+        self._tasks: list[asyncio.Task] = []
+        self._probes: set[asyncio.Task] = set()
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self._tasks.append(asyncio.create_task(self._handler_loop()))
+        self._tasks.append(asyncio.create_task(self._ping_loop()))
+
+    def stop(self) -> None:
+        for task in self._tasks + list(self._probes):
+            task.cancel()
+        self._tasks.clear()
+        self._probes.clear()
+        self._events.complete()
+
+    def listen(self) -> Stream[FailureDetectorEvent]:
+        return self._events.subscribe()
+
+    @property
+    def period(self) -> int:
+        return self._period
+
+    # -- membership-driven probe list (FailureDetectorImpl.java:307-338) ------
+
+    def on_membership_event(self, event: MembershipEvent) -> None:
+        if event.member.id == self._local.id:
+            return
+        if event.is_added:
+            # Random-position insert keeps probe order uncorrelated across
+            # nodes (FailureDetectorImpl.java:323-333).
+            pos = self._rng.randint(0, len(self._ping_members))
+            self._ping_members.insert(pos, event.member)
+        elif event.is_removed:
+            self._ping_members = [
+                m for m in self._ping_members if m.id != event.member.id
+            ]
+
+    # -- probe rounds ---------------------------------------------------------
+
+    async def _ping_loop(self) -> None:
+        interval = self._config.ping_interval / 1000.0
+        while True:
+            await asyncio.sleep(interval)
+            # Each round runs concurrently with the next sleep so a slow
+            # indirect probe can use its full budget (the reference schedules
+            # doPing periodically regardless of the previous round's fate).
+            probe = asyncio.create_task(self._do_ping())
+            self._probes.add(probe)
+            probe.add_done_callback(self._probes.discard)
+
+    async def _do_ping(self) -> None:
+        self._period += 1
+        target = self._select_ping_member()
+        if target is None:
+            return
+        cid = self._cid.next_cid()
+        ping = Message.create(
+            qualifier=PING,
+            correlation_id=cid,
+            data=PingData(issuer=self._local, target=target),
+        )
+        logger.debug("%s: ping[%d] -> %s", self._local, self._period, target)
+        try:
+            ack = await self._transport.request_response(
+                target.address, ping, timeout=self._config.ping_timeout / 1000.0
+            )
+            self._publish(target, _status_of_ack(ack))
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            await self._do_ping_req(target, cid)
+
+    async def _do_ping_req(self, target: Member, cid: str) -> None:
+        """Indirect probe through random relays (FailureDetectorImpl.java:172-209)."""
+        relays = self._select_ping_req_members(target)
+        if not relays:
+            self._publish(target, MemberStatus.SUSPECT)
+            return
+        budget = (self._config.ping_interval - self._config.ping_timeout) / 1000.0
+        ping_req = Message.create(
+            qualifier=PING_REQ,
+            correlation_id=cid,
+            data=PingData(issuer=self._local, target=target),
+        )
+        stream = self._transport.listen()
+        try:
+            for relay in relays:
+                with contextlib.suppress(ConnectionError, OSError):
+                    await self._transport.send(relay.address, ping_req)
+
+            async def first_ack() -> Message:
+                async for msg in stream:
+                    if (
+                        msg.qualifier == PING_ACK
+                        and msg.correlation_id == cid
+                    ):
+                        return msg
+                raise asyncio.TimeoutError
+
+            ack = await asyncio.wait_for(first_ack(), budget)
+            self._publish(target, _status_of_ack(ack))
+        except (asyncio.TimeoutError, ConnectionError, OSError):
+            self._publish(target, MemberStatus.SUSPECT)
+        finally:
+            stream.close()
+
+    def _publish(self, member: Member, status: MemberStatus) -> None:
+        logger.debug("%s: probe[%d] %s -> %s", self._local, self._period, member, status.name)
+        self._events.publish(FailureDetectorEvent(member, status))
+
+    # -- selection (FailureDetectorImpl.java:340-363) -------------------------
+
+    def _select_ping_member(self) -> Member | None:
+        if not self._ping_members:
+            return None
+        if self._cursor >= len(self._ping_members):
+            self._rng.shuffle(self._ping_members)
+            self._cursor = 0
+        member = self._ping_members[self._cursor]
+        self._cursor += 1
+        return member
+
+    def _select_ping_req_members(self, target: Member) -> list[Member]:
+        candidates = [m for m in self._ping_members if m.id != target.id]
+        k = min(self._config.ping_req_members, len(candidates))
+        return self._rng.sample(candidates, k) if k > 0 else []
+
+    # -- inbound protocol messages (FailureDetectorImpl.java:211-305) ---------
+
+    async def _handler_loop(self) -> None:
+        stream = self._transport.listen()
+        try:
+            async for msg in stream:
+                try:
+                    if msg.qualifier == PING:
+                        await self._on_ping(msg)
+                    elif msg.qualifier == PING_REQ:
+                        await self._on_ping_req(msg)
+                    elif msg.qualifier == PING_ACK:
+                        await self._on_transit_ack(msg)
+                except (ConnectionError, OSError) as exc:
+                    logger.debug("%s: fd reply failed: %s", self._local, exc)
+                except Exception:
+                    # One malformed payload must not kill probe answering —
+                    # the node would be falsely suspected cluster-wide.
+                    logger.exception("%s: bad fd message %s", self._local, msg)
+        finally:
+            stream.close()
+
+    async def _on_ping(self, msg: Message) -> None:
+        """Answer a direct or transit probe (FailureDetectorImpl.java:226-252)."""
+        data: PingData = msg.data
+        ack_type = (
+            AckType.DEST_OK
+            if data.target.id == self._local.id
+            else AckType.DEST_GONE  # same address, different identity
+        )
+        ack = Message.create(
+            qualifier=PING_ACK,
+            correlation_id=msg.correlation_id,
+            data=replace(data, ack_type=ack_type),
+        )
+        reply_to = msg.sender or data.issuer.address
+        await self._transport.send(reply_to, ack)
+
+    async def _on_ping_req(self, msg: Message) -> None:
+        """Relay: transit the PING to the target (FailureDetectorImpl.java:255-277)."""
+        data: PingData = msg.data
+        transit = Message.create(
+            qualifier=PING,
+            correlation_id=msg.correlation_id,
+            data=PingData(
+                issuer=self._local,
+                target=data.target,
+                original_issuer=data.issuer,
+            ),
+        )
+        await self._transport.send(data.target.address, transit)
+
+    async def _on_transit_ack(self, msg: Message) -> None:
+        """Relay: forward the target's ack to the origin
+        (FailureDetectorImpl.java:283-305)."""
+        data: PingData = msg.data
+        origin = data.original_issuer
+        if origin is None or origin.id == self._local.id:
+            return  # direct ack, or our own forwarded ack: cid matching handles it
+        await self._transport.send(origin.address, msg)
+
+
+def _status_of_ack(ack: Message) -> MemberStatus:
+    """DEST_OK -> ALIVE, DEST_GONE -> DEAD (FailureDetectorImpl.java:370-391)."""
+    data: PingData = ack.data
+    if data.ack_type is AckType.DEST_GONE:
+        return MemberStatus.DEAD
+    return MemberStatus.ALIVE

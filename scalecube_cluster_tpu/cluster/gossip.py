@@ -1,0 +1,214 @@
+"""Infection-style gossip dissemination.
+
+Reference: gossip/GossipProtocolImpl.java:31-323. Behavior replicated:
+
+- ``spread(message)`` assigns a globally-unique gossip id
+  ``<memberId>-<sequence>`` and enqueues it (:163-169, 211-213); the returned
+  future completes with the gossip id when the gossip is swept (:299-302).
+- Every ``gossip_interval``: pick ``gossip_fanout`` random peers (:253-274)
+  and push each gossip that is younger than
+  ``periods_to_spread = repeat_mult * ceil_log2(n+1)`` periods and not known
+  to be infected at that peer (:242-251, ClusterMath.java:111-113).
+- Receivers dedup by gossip id, emit each rumor to listeners exactly once,
+  and record the sender as infected (:171-183).
+- Gossips are garbage-collected after ``2 * (periods_to_spread + 1)`` periods
+  (:281-304, ClusterMath.java:99-102).
+
+The peer list is maintained from membership events (:185-197).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import itertools
+import logging
+import random
+from dataclasses import dataclass, field
+
+from scalecube_cluster_tpu import cluster_math
+from scalecube_cluster_tpu.cluster.payloads import GOSSIP_REQ, Gossip, GossipRequest
+from scalecube_cluster_tpu.cluster_api.config import GossipConfig
+from scalecube_cluster_tpu.cluster_api.member import Member
+from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.transport.api import Transport
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.utils.streams import Multicast, Stream
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class GossipState:
+    """Local bookkeeping for one rumor (GossipState.java:8-50)."""
+
+    gossip: Gossip
+    period_added: int
+    #: Member ids known to already have this rumor (so we stop pushing it to
+    #: them): ourselves, plus everyone who sent it to us.
+    infected: set[str] = field(default_factory=set)
+
+
+class GossipProtocol:
+    """One node's gossip engine (GossipProtocolImpl.java:31-323)."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        local_member: Member,
+        config: GossipConfig,
+        rng: random.Random | None = None,
+    ):
+        self._transport = transport
+        self._local = local_member
+        self._config = config
+        self._rng = rng or random.Random()
+        self._period = 0
+        self._sequence = itertools.count()
+        self._gossips: dict[str, GossipState] = {}
+        #: gossip id -> future resolved (with the id) at sweep time.
+        self._futures: dict[str, asyncio.Future[str]] = {}
+        self._members: list[Member] = []
+        self._messages: Multicast[Message] = Multicast()
+        self._tasks: list[asyncio.Task] = []
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self._tasks.append(asyncio.create_task(self._handler_loop()))
+        self._tasks.append(asyncio.create_task(self._spread_loop()))
+
+    def stop(self) -> None:
+        for task in self._tasks:
+            task.cancel()
+        self._tasks.clear()
+        for fut in self._futures.values():
+            if not fut.done():
+                fut.cancel()
+        self._futures.clear()
+        self._messages.complete()
+
+    def listen(self) -> Stream[Message]:
+        """Rumors received from peers, deduplicated (exactly-once per node)."""
+        return self._messages.subscribe()
+
+    @property
+    def period(self) -> int:
+        return self._period
+
+    # -- membership-driven peer list (GossipProtocolImpl.java:185-197) --------
+
+    def on_membership_event(self, event: MembershipEvent) -> None:
+        if event.member.id == self._local.id:
+            return
+        if event.is_added:
+            self._members.append(event.member)
+        elif event.is_removed:
+            self._members = [m for m in self._members if m.id != event.member.id]
+
+    # -- spreading ------------------------------------------------------------
+
+    def spread(self, message: Message) -> asyncio.Future[str]:
+        """Enqueue a rumor; the future resolves with its gossip id once the
+        rumor has been swept (fully disseminated + aged out,
+        GossipProtocolImpl.java:124-128, 299-302)."""
+        gossip_id = f"{self._local.id}-{next(self._sequence)}"
+        state = GossipState(
+            Gossip(gossip_id, message), self._period, infected={self._local.id}
+        )
+        self._gossips[gossip_id] = state
+        fut: asyncio.Future[str] = asyncio.get_running_loop().create_future()
+        self._futures[gossip_id] = fut
+        return fut
+
+    async def _spread_loop(self) -> None:
+        interval = self._config.gossip_interval / 1000.0
+        while True:
+            await asyncio.sleep(interval)
+            self._period += 1
+            await self._do_spread()
+            self._sweep()
+
+    async def _do_spread(self) -> None:
+        if not self._members or not self._gossips:
+            return
+        for peer in self._select_gossip_members():
+            batch = self._select_gossips_to_send(peer)
+            if not batch:
+                continue
+            limit = self._config.gossip_segmentation_threshold or len(batch)
+            for i in range(0, len(batch), limit):
+                request = GossipRequest(tuple(batch[i : i + limit]), self._local.id)
+                msg = Message.create(qualifier=GOSSIP_REQ, data=request)
+                with contextlib.suppress(ConnectionError, OSError, ValueError):
+                    await self._transport.send(peer.address, msg)
+
+    def _select_gossip_members(self) -> list[Member]:
+        """Random fanout-sized subset of peers (GossipProtocolImpl.java:253-274
+        uses a shuffled sliding window; a fresh random sample per period is
+        statistically equivalent for dissemination)."""
+        fanout = min(self._config.gossip_fanout, len(self._members))
+        return self._rng.sample(self._members, fanout)
+
+    def _select_gossips_to_send(self, peer: Member) -> list[Gossip]:
+        """Young, not-known-infected gossips (GossipProtocolImpl.java:242-251)."""
+        spread_for = cluster_math.gossip_periods_to_spread(
+            self._config.gossip_repeat_mult, self._cluster_size()
+        )
+        return [
+            s.gossip
+            for s in self._gossips.values()
+            if self._period - s.period_added < spread_for
+            and peer.id not in s.infected
+        ]
+
+    def _sweep(self) -> None:
+        """GC old gossips, resolving their spread() futures
+        (GossipProtocolImpl.java:281-304)."""
+        sweep_after = cluster_math.gossip_periods_to_sweep(
+            self._config.gossip_repeat_mult, self._cluster_size()
+        )
+        expired = [
+            gid
+            for gid, s in self._gossips.items()
+            if self._period - s.period_added > sweep_after
+        ]
+        for gid in expired:
+            del self._gossips[gid]
+            fut = self._futures.pop(gid, None)
+            if fut is not None and not fut.done():
+                fut.set_result(gid)
+            logger.debug("%s: swept gossip %s", self._local, gid)
+
+    def _cluster_size(self) -> int:
+        return len(self._members) + 1
+
+    # -- inbound (GossipProtocolImpl.java:171-183) ----------------------------
+
+    async def _handler_loop(self) -> None:
+        stream = self._transport.listen()
+        try:
+            async for msg in stream:
+                if msg.qualifier != GOSSIP_REQ:
+                    continue
+                try:
+                    self._on_gossip_req(msg.data)
+                except Exception:
+                    # One malformed batch must not kill dissemination.
+                    logger.exception("%s: bad gossip request %s", self._local, msg)
+        finally:
+            stream.close()
+
+    def _on_gossip_req(self, request: GossipRequest) -> None:
+        for gossip in request.gossips:
+            state = self._gossips.get(gossip.gossip_id)
+            if state is None:
+                state = GossipState(
+                    gossip,
+                    self._period,
+                    infected={self._local.id},
+                )
+                self._gossips[gossip.gossip_id] = state
+                # First sighting: deliver to listeners exactly once.
+                self._messages.publish(gossip.message)
+            state.infected.add(request.from_member_id)

@@ -1,0 +1,514 @@
+"""SWIM membership with SYNC anti-entropy.
+
+Reference: membership/MembershipProtocolImpl.java:52-792. Behavior replicated:
+
+- **State**: ``membership_table`` (id -> MembershipRecord) + ``members``
+  (id -> Member, the *visible* members incl. self) (:87-88).
+- **Join** (:222-257): initial SYNC (full table + sync group) to every seed;
+  the first valid SYNC_ACK within ``sync_timeout`` wins. No seeds (or no
+  answer) -> start standalone; periodic SYNC heals later.
+- **Anti-entropy** (:304-320, 352-373): every ``sync_interval`` SYNC with a
+  random address from seeds ∪ members; the receiver merges and answers
+  SYNC_ACK with its table.
+- **Merge rule**: ``is_overrides`` (MembershipRecord.java:66-84) decides; the
+  update paths are tagged by reason (:58-64) — updates learned from gossip or
+  the initial sync are NOT re-gossiped (:649-656).
+- **FD events** (:376-404): SUSPECT/DEAD update the table at the member's
+  current incarnation; ALIVE instead sends a direct SYNC (ALIVE cannot
+  override SUSPECT at equal incarnation — the member must refute itself).
+- **Suspicion** (:620-647): SUSPECT schedules a DEAD verdict after
+  ``suspicion_mult * ceil_log2(n) * ping_interval``; cancelled if refuted.
+- **Self-refutation** (:549-569): an overriding rumor about *us* bumps our
+  incarnation to ``max(ours, rumor) + 1`` and gossips the new ALIVE record.
+- **Metadata-gated visibility** (:518-543, 589-610): a newly-ALIVE member is
+  only emitted (ADDED/UPDATED) once its metadata has been fetched.
+- **Leave** (:203-212): spread a self-DEAD rumor at ``incarnation + 1``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import contextlib
+import logging
+import random
+from collections import deque
+from enum import Enum
+from typing import Awaitable, Callable
+
+from scalecube_cluster_tpu import cluster_math
+from scalecube_cluster_tpu.cluster.fdetector import FailureDetector
+from scalecube_cluster_tpu.cluster.gossip import GossipProtocol
+from scalecube_cluster_tpu.cluster.metadata import MetadataStore
+from scalecube_cluster_tpu.cluster.payloads import (
+    MEMBERSHIP_GOSSIP,
+    SYNC,
+    SYNC_ACK,
+    SyncData,
+)
+from scalecube_cluster_tpu.cluster_api.config import ClusterConfig
+from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
+from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.cluster_api.membership_record import (
+    MembershipRecord,
+    is_overrides,
+)
+from scalecube_cluster_tpu.transport.api import Transport
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.utils.address import Address
+from scalecube_cluster_tpu.utils.ids import CorrelationIdGenerator
+from scalecube_cluster_tpu.utils.streams import Multicast, Stream
+
+logger = logging.getLogger(__name__)
+#: Dedicated logger for merge decisions, mirroring the reference's isolated
+#: "io.scalecube.cluster.Membership" logger (MembershipProtocolImpl.java:55-56).
+merge_logger = logging.getLogger(__name__ + ".merge")
+
+
+class UpdateReason(Enum):
+    """Where a membership update was learned from (MembershipProtocolImpl.java:58-64)."""
+
+    FDETECTOR = "FDETECTOR"
+    GOSSIP = "GOSSIP"
+    SYNC = "SYNC"
+    INITIAL_SYNC = "INITIAL_SYNC"
+    SUSPICION_TIMEOUT = "SUSPICION_TIMEOUT"
+
+
+#: Reasons whose updates are NOT re-gossiped (they were already disseminated
+#: or will be carried by anti-entropy, MembershipProtocolImpl.java:649-656).
+_NO_REGOSSIP = frozenset({UpdateReason.GOSSIP, UpdateReason.INITIAL_SYNC})
+
+
+class MembershipProtocol:
+    """One node's membership engine (MembershipProtocolImpl.java:52-792)."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        local_member: Member,
+        config: ClusterConfig,
+        failure_detector: FailureDetector,
+        gossip: GossipProtocol,
+        metadata_store: MetadataStore,
+        cid_generator: CorrelationIdGenerator,
+        rng: random.Random | None = None,
+    ):
+        self._transport = transport
+        self._local = local_member
+        self._config = config
+        self._membership_config = config.membership_config
+        self._fd = failure_detector
+        self._gossip = gossip
+        self._metadata = metadata_store
+        self._cid = cid_generator
+        self._rng = rng or random.Random()
+
+        self._table: dict[str, MembershipRecord] = {}
+        self._members: dict[str, Member] = {}
+        self._suspicion_tasks: dict[str, asyncio.Task] = {}
+        self._fetch_tasks: dict[str, asyncio.Task] = {}
+        self._removed_history: deque[Member] = deque(
+            maxlen=self._membership_config.removed_members_history_size
+        )
+        self._events: Multicast[MembershipEvent] = Multicast()
+        self._tasks: list[asyncio.Task] = []
+        self._seeds = tuple(
+            a
+            for a in self._membership_config.seed_members
+            if a not in (local_member.address, transport.address)
+        )
+
+    # -- lifecycle ------------------------------------------------------------
+
+    async def start(self) -> None:
+        """Bootstrap: self record, handlers, initial sync, periodic sync
+        (MembershipProtocolImpl.start0, :215-257)."""
+        self._table[self._local.id] = MembershipRecord(
+            self._local, MemberStatus.ALIVE, 0
+        )
+        self._members[self._local.id] = self._local
+        self._tasks.append(asyncio.create_task(self._handler_loop()))
+        self._tasks.append(asyncio.create_task(self._fd_event_loop()))
+        self._tasks.append(asyncio.create_task(self._gossip_event_loop()))
+        if self._seeds:
+            await self._initial_sync()
+        self._tasks.append(asyncio.create_task(self._sync_loop()))
+
+    def stop(self) -> None:
+        for task in (
+            self._tasks
+            + list(self._suspicion_tasks.values())
+            + list(self._fetch_tasks.values())
+        ):
+            task.cancel()
+        self._tasks.clear()
+        self._suspicion_tasks.clear()
+        self._fetch_tasks.clear()
+        self._events.complete()
+
+    def listen(self) -> Stream[MembershipEvent]:
+        return self._events.subscribe()
+
+    # -- introspection (the JMX-MBean equivalents, :720-791) ------------------
+
+    @property
+    def incarnation(self) -> int:
+        return self._table[self._local.id].incarnation
+
+    def members(self) -> list[Member]:
+        return list(self._members.values())
+
+    def other_members(self) -> list[Member]:
+        return [m for m in self._members.values() if m.id != self._local.id]
+
+    def member_by_id(self, member_id: str) -> Member | None:
+        return self._members.get(member_id)
+
+    def member_by_address(self, address: Address) -> Member | None:
+        for m in self._members.values():
+            if m.address == address:
+                return m
+        return None
+
+    def aliveness(self, status: MemberStatus) -> list[Member]:
+        """Members currently recorded with ``status`` (alive/suspected lists
+        of the membership MBean)."""
+        return [r.member for r in self._table.values() if r.status is status]
+
+    def removed_history(self) -> list[Member]:
+        return list(self._removed_history)
+
+    # -- leave (MembershipProtocolImpl.java:203-212) --------------------------
+
+    def leave(self) -> asyncio.Future[str]:
+        """Spread a self-DEAD rumor at incarnation + 1; the future resolves
+        when the rumor has been fully disseminated (gossip sweep).
+
+        The DEAD record is written to our own table FIRST (the reference's
+        ``membershipTable.put`` in leaveCluster, :203-212): DEAD is sticky,
+        so our own rumor echoing back during the shutdown window can't
+        trigger self-refutation and resurrect us at the peers."""
+        record = MembershipRecord(
+            self._local, MemberStatus.DEAD, self.incarnation + 1
+        )
+        self._table[self._local.id] = record
+        return self._spread_membership_gossip(record)
+
+    # -- metadata-driven incarnation bump (ClusterImpl.java:365-369) ----------
+
+    def update_incarnation(self) -> None:
+        """Advance our incarnation and gossip the new self record so peers
+        re-fetch metadata (updateIncarnation, :184-196)."""
+        record = MembershipRecord(
+            self._local, MemberStatus.ALIVE, self.incarnation + 1
+        )
+        self._table[self._local.id] = record
+        self._spread_membership_gossip(record)
+
+    # -- sync (anti-entropy) --------------------------------------------------
+
+    async def _initial_sync(self) -> None:
+        """SYNC all seeds; first valid SYNC_ACK within sync_timeout wins
+        (:222-257). No answer is non-fatal: periodic sync heals later."""
+        sync = Message.create(
+            qualifier=SYNC,
+            correlation_id=self._cid.next_cid(),
+            data=self._sync_data(),
+        )
+
+        async def ask(seed: Address) -> Message:
+            return await self._transport.request_response(
+                seed, sync, timeout=self._membership_config.sync_timeout / 1000.0
+            )
+
+        pending = [asyncio.ensure_future(ask(seed)) for seed in self._seeds]
+        try:
+            for fut in asyncio.as_completed(pending):
+                try:
+                    response = await fut
+                except (asyncio.TimeoutError, ConnectionError, OSError):
+                    continue
+                data: SyncData = response.data
+                if self._check_sync_group(data):
+                    self._sync_membership(data, UpdateReason.INITIAL_SYNC)
+                    return
+            logger.warning(
+                "%s: no seed answered initial sync; starting standalone",
+                self._local,
+            )
+        finally:
+            for fut in pending:
+                fut.cancel()
+
+    async def _sync_loop(self) -> None:
+        interval = self._membership_config.sync_interval / 1000.0
+        while True:
+            await asyncio.sleep(interval)
+            address = self._select_sync_address()
+            if address is not None:
+                await self._send_sync(address)
+
+    def _select_sync_address(self) -> Address | None:
+        """Random address from seeds ∪ other members (:416-427)."""
+        candidates = {m.address for m in self.other_members()}
+        candidates.update(self._seeds)
+        candidates.discard(self._local.address)
+        candidates.discard(self._transport.address)
+        if not candidates:
+            return None
+        return self._rng.choice(sorted(candidates))
+
+    async def _send_sync(self, address: Address) -> None:
+        """Fire-and-forget periodic SYNC; the answer arrives as a plain
+        SYNC_ACK without a correlation id (:304-320). ValueError covers a
+        table grown past max_frame_length — it must not kill the sync loop."""
+        msg = Message.create(qualifier=SYNC, data=self._sync_data())
+        try:
+            await self._transport.send(address, msg)
+        except (ConnectionError, OSError):
+            pass
+        except ValueError as exc:
+            logger.warning("%s: sync to %s not sent: %s", self._local, address, exc)
+
+    def _sync_data(self) -> SyncData:
+        return SyncData(
+            tuple(self._table.values()), self._membership_config.sync_group
+        )
+
+    def _check_sync_group(self, data: SyncData) -> bool:
+        """SYNCs across different groups are ignored (:442-448)."""
+        return data.sync_group == self._membership_config.sync_group
+
+    async def _handler_loop(self) -> None:
+        stream = self._transport.listen()
+        try:
+            async for msg in stream:
+                try:
+                    if msg.qualifier == SYNC:
+                        await self._on_sync(msg)
+                    elif msg.qualifier == SYNC_ACK and msg.correlation_id is None:
+                        # cid-stamped acks answer an initial sync and are
+                        # consumed by its request/response matcher only
+                        # (:343-349).
+                        self._on_sync_ack(msg)
+                except Exception:
+                    # One malformed payload must not kill anti-entropy.
+                    logger.exception("%s: bad sync message %s", self._local, msg)
+        finally:
+            stream.close()
+
+    async def _on_sync(self, msg: Message) -> None:
+        """Merge the sender's table, reply with ours (:352-373)."""
+        data: SyncData = msg.data
+        if not self._check_sync_group(data):
+            return
+        self._sync_membership(data, UpdateReason.SYNC)
+        if msg.sender is None:
+            return
+        ack = Message.create(
+            qualifier=SYNC_ACK,
+            correlation_id=msg.correlation_id,
+            data=self._sync_data(),
+        )
+        with contextlib.suppress(ConnectionError, OSError):
+            await self._transport.send(msg.sender, ack)
+
+    def _on_sync_ack(self, msg: Message) -> None:
+        data: SyncData = msg.data
+        if self._check_sync_group(data):
+            self._sync_membership(data, UpdateReason.SYNC)
+
+    def _sync_membership(self, data: SyncData, reason: UpdateReason) -> None:
+        for record in data.membership:
+            self._update_membership(record, reason)
+
+    # -- failure-detector events (:376-404) -----------------------------------
+
+    async def _fd_event_loop(self) -> None:
+        stream = self._fd.listen()
+        try:
+            async for event in stream:
+                r0 = self._table.get(event.member.id)
+                if r0 is None:
+                    continue
+                if event.status is MemberStatus.ALIVE:
+                    # ALIVE can't override SUSPECT at equal incarnation; a
+                    # direct SYNC makes the member see itself suspected and
+                    # refute by bumping its incarnation (:385-397).
+                    await self._send_sync(event.member.address)
+                    continue
+                self._update_membership(
+                    MembershipRecord(event.member, event.status, r0.incarnation),
+                    UpdateReason.FDETECTOR,
+                )
+        finally:
+            stream.close()
+
+    # -- membership gossip (:407-414) -----------------------------------------
+
+    async def _gossip_event_loop(self) -> None:
+        stream = self._gossip.listen()
+        try:
+            async for msg in stream:
+                if msg.qualifier != MEMBERSHIP_GOSSIP:
+                    continue
+                try:
+                    self._update_membership(msg.data, UpdateReason.GOSSIP)
+                except Exception:
+                    # A junk membership rumor must not kill the merge loop.
+                    logger.exception(
+                        "%s: bad membership gossip %s", self._local, msg
+                    )
+        finally:
+            stream.close()
+
+    def _spread_membership_gossip(self, record: MembershipRecord) -> asyncio.Future:
+        return self._gossip.spread(
+            Message.create(qualifier=MEMBERSHIP_GOSSIP, data=record)
+        )
+
+    # -- THE merge kernel (updateMembership, :481-546) ------------------------
+
+    def _update_membership(self, r1: MembershipRecord, reason: UpdateReason) -> None:
+        r0 = self._table.get(r1.member.id)
+        if not is_overrides(r1, r0):
+            merge_logger.debug(
+                "%s: skip %s (no override of %s, reason=%s)",
+                self._local,
+                r1,
+                r0,
+                reason.value,
+            )
+            return
+        merge_logger.debug(
+            "%s: apply %s over %s (reason=%s)", self._local, r1, r0, reason.value
+        )
+        if r1.member.id == self._local.id:
+            self._on_self_member_detected(r0, r1)
+        elif r1.is_dead:
+            self._on_dead_member_detected(r1, reason)
+        elif r1.is_suspect:
+            self._on_suspected_member_detected(r1, reason)
+        else:
+            self._on_alive_member_detected(r1, reason)
+
+    def _on_self_member_detected(
+        self, r0: MembershipRecord | None, r1: MembershipRecord
+    ) -> None:
+        """Refute rumors about ourselves (:549-569)."""
+        incarnation = max(r0.incarnation if r0 else 0, r1.incarnation) + 1
+        record = MembershipRecord(self._local, MemberStatus.ALIVE, incarnation)
+        self._table[self._local.id] = record
+        logger.debug(
+            "%s: refuting %s rumor, incarnation -> %d",
+            self._local,
+            r1.status.name,
+            incarnation,
+        )
+        self._spread_membership_gossip(record)
+
+    def _on_dead_member_detected(
+        self, r1: MembershipRecord, reason: UpdateReason
+    ) -> None:
+        """Remove a dead member and emit REMOVED (:571-587)."""
+        self._cancel_suspicion(r1.member.id)
+        self._cancel_fetch(r1.member.id)
+        self._table.pop(r1.member.id, None)
+        if reason not in _NO_REGOSSIP:
+            self._spread_membership_gossip(r1)
+        member = self._members.pop(r1.member.id, None)
+        if member is None:
+            return  # never became visible (metadata fetch still pending)
+        self._removed_history.append(member)
+        old_metadata = self._metadata.remove_metadata(member)
+        self._emit(MembershipEvent.removed(member, old_metadata))
+
+    def _on_suspected_member_detected(
+        self, r1: MembershipRecord, reason: UpdateReason
+    ) -> None:
+        """Record the suspicion and arm its DEAD deadline (:620-635)."""
+        self._table[r1.member.id] = r1
+        if reason not in _NO_REGOSSIP:
+            self._spread_membership_gossip(r1)
+        if r1.member.id not in self._suspicion_tasks:
+            timeout_ms = cluster_math.suspicion_timeout(
+                self._membership_config.suspicion_mult,
+                max(len(self._members), 1),
+                self._config.failure_detector_config.ping_interval,
+            )
+            self._suspicion_tasks[r1.member.id] = asyncio.create_task(
+                self._suspicion_timeout(r1.member.id, timeout_ms / 1000.0)
+            )
+
+    async def _suspicion_timeout(self, member_id: str, delay: float) -> None:
+        """Declare a still-suspected member DEAD (:637-647)."""
+        await asyncio.sleep(delay)
+        self._suspicion_tasks.pop(member_id, None)
+        record = self._table.get(member_id)
+        if record is not None and record.is_suspect:
+            logger.debug(
+                "%s: suspicion timeout for %s, declaring DEAD",
+                self._local,
+                record.member,
+            )
+            self._update_membership(
+                record.with_status(MemberStatus.DEAD), UpdateReason.SUSPICION_TIMEOUT
+            )
+
+    def _on_alive_member_detected(
+        self, r1: MembershipRecord, reason: UpdateReason
+    ) -> None:
+        """An alive record overrode: cancel suspicion, gate visibility on a
+        metadata fetch, then emit ADDED or UPDATED (:518-543, 589-610)."""
+        self._cancel_suspicion(r1.member.id)
+        self._table[r1.member.id] = r1
+        if reason not in _NO_REGOSSIP:
+            self._spread_membership_gossip(r1)
+        self._cancel_fetch(r1.member.id)
+        self._fetch_tasks[r1.member.id] = asyncio.create_task(
+            self._fetch_then_emit(r1.member)
+        )
+
+    async def _fetch_then_emit(self, member: Member) -> None:
+        try:
+            metadata = await self._metadata.fetch_metadata(member)
+        except (asyncio.TimeoutError, ConnectionError, OSError) as exc:
+            # Member stays in the table but invisible; a later incarnation
+            # bump or sync retries the fetch (:534-541).
+            logger.debug("%s: metadata fetch from %s failed: %s", self._local, member, exc)
+            return
+        finally:
+            # Only deregister ourselves — a newer fetch may have replaced us.
+            if self._fetch_tasks.get(member.id) is asyncio.current_task():
+                del self._fetch_tasks[member.id]
+        if member.id not in self._table:
+            return  # declared dead while we fetched
+        if member.id not in self._members:
+            self._members[member.id] = member
+            self._metadata.put_metadata(member, metadata)
+            self._emit(MembershipEvent.added(member, metadata))
+        else:
+            old = self._metadata.put_metadata(member, metadata)
+            self._members[member.id] = member
+            self._emit(MembershipEvent.updated(member, old, metadata))
+
+    # -- helpers --------------------------------------------------------------
+
+    def _cancel_suspicion(self, member_id: str) -> None:
+        task = self._suspicion_tasks.pop(member_id, None)
+        if task is not None:
+            task.cancel()
+
+    def _cancel_fetch(self, member_id: str) -> None:
+        task = self._fetch_tasks.pop(member_id, None)
+        if task is not None:
+            task.cancel()
+
+    def _emit(self, event: MembershipEvent) -> None:
+        logger.debug("%s: %s", self._local, event)
+        # Keep the probe/gossip peer lists in lock-step with visibility
+        # (the reference wires these through the same event stream,
+        # ClusterImpl.java:180-210).
+        self._fd.on_membership_event(event)
+        self._gossip.on_membership_event(event)
+        self._events.publish(event)

@@ -1,0 +1,136 @@
+"""Per-member metadata store.
+
+Reference: metadata/MetadataStoreImpl.java:22-250. Behavior replicated:
+
+- Local metadata is an arbitrary (wire-serializable) object; remote members'
+  metadata is cached locally (:41) and refreshed by the membership protocol
+  whenever a member's incarnation advances.
+- ``fetch_metadata(member)`` is a request/response with ``metadata_timeout``
+  (:151-193); the server side only answers if the request targets its
+  *current* identity — a restarted process at the same address stays silent
+  for its predecessor's id, so the caller times out (:209-249).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any
+
+from scalecube_cluster_tpu.cluster.payloads import (
+    METADATA_REQ,
+    METADATA_RESP,
+    GetMetadataRequest,
+    GetMetadataResponse,
+)
+from scalecube_cluster_tpu.cluster_api.member import Member
+from scalecube_cluster_tpu.transport.api import Transport
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.utils.ids import CorrelationIdGenerator
+
+logger = logging.getLogger(__name__)
+
+
+class MetadataStore:
+    """One node's metadata cache + fetch protocol (MetadataStoreImpl.java:22-250)."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        local_member: Member,
+        local_metadata: Any,
+        metadata_timeout: int,
+        cid_generator: CorrelationIdGenerator,
+    ):
+        self._transport = transport
+        self._local = local_member
+        self._metadata_timeout = metadata_timeout
+        self._cid = cid_generator
+        self._local_metadata = local_metadata
+        self._cache: dict[str, Any] = {}
+        self._task: asyncio.Task | None = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        self._task = asyncio.create_task(self._handler_loop())
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self._cache.clear()
+
+    # -- local + cached metadata (MetadataStore.java:12-66) -------------------
+
+    def metadata(self, member: Member | None = None) -> Any:
+        if member is None or member.id == self._local.id:
+            return self._local_metadata
+        return self._cache.get(member.id)
+
+    def update_metadata(self, metadata: Any) -> Any:
+        """Replace local metadata; returns the previous value
+        (MetadataStoreImpl.updateMetadata)."""
+        old, self._local_metadata = self._local_metadata, metadata
+        return old
+
+    def put_metadata(self, member: Member, metadata: Any) -> Any:
+        """Cache a remote member's metadata; returns the previous value."""
+        old = self._cache.get(member.id)
+        self._cache[member.id] = metadata
+        return old
+
+    def remove_metadata(self, member: Member) -> Any:
+        """Drop a removed member's metadata; returns the last-known value."""
+        return self._cache.pop(member.id, None)
+
+    # -- fetch protocol (MetadataStoreImpl.java:151-249) ----------------------
+
+    async def fetch_metadata(self, member: Member) -> Any:
+        """Request ``member``'s current metadata over the wire; raises
+        ``asyncio.TimeoutError`` if it doesn't answer for that identity."""
+        request = Message.create(
+            qualifier=METADATA_REQ,
+            correlation_id=self._cid.next_cid(),
+            data=GetMetadataRequest(member),
+        )
+        response = await self._transport.request_response(
+            member.address, request, timeout=self._metadata_timeout / 1000.0
+        )
+        payload: GetMetadataResponse = response.data
+        return payload.metadata
+
+    async def _handler_loop(self) -> None:
+        stream = self._transport.listen()
+        try:
+            async for msg in stream:
+                if msg.qualifier != METADATA_REQ:
+                    continue
+                try:
+                    await self._on_metadata_request(msg)
+                except Exception:
+                    # One malformed request must not kill metadata serving.
+                    logger.exception(
+                        "%s: bad metadata request %s", self._local, msg
+                    )
+        finally:
+            stream.close()
+
+    async def _on_metadata_request(self, msg: Message) -> None:
+        request: GetMetadataRequest = msg.data
+        if request.member.id != self._local.id:
+            # Not our identity (e.g. predecessor at this address):
+            # stay silent, the caller times out (:216-227).
+            logger.debug(
+                "%s: ignoring metadata request for %s", self._local, request.member
+            )
+            return
+        response = Message.create(
+            qualifier=METADATA_RESP,
+            correlation_id=msg.correlation_id,
+            data=GetMetadataResponse(self._local, self._local_metadata),
+        )
+        try:
+            await self._transport.send(msg.sender or request.member.address, response)
+        except (ConnectionError, OSError) as exc:
+            logger.debug("%s: metadata reply failed: %s", self._local, exc)

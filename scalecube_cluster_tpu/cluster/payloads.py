@@ -1,0 +1,147 @@
+"""Protocol wire payloads and system qualifiers.
+
+Reference qualifiers (the 8 SYSTEM_MESSAGES of ClusterImpl.java:43-54 plus
+the membership-gossip qualifier, ClusterImpl.java:56-57):
+
+- ``sc/fdetector/ping|pingReq|pingAck``  (FailureDetectorImpl.java:35-37)
+- ``sc/gossip/req``                      (GossipProtocolImpl.java:37)
+- ``sc/membership/sync|syncAck|gossip``  (MembershipProtocolImpl.java:68-70)
+- ``sc/metadata/req|resp``               (MetadataStoreImpl.java:28-29)
+
+Payload shapes: PingData.java:6-93, GossipRequest.java:8-37 + Gossip.java:7-49,
+SyncData.java:11-41, GetMetadataRequest/Response (metadata/*.java).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+from typing import Any
+
+from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
+from scalecube_cluster_tpu.cluster_api.membership_record import MembershipRecord
+from scalecube_cluster_tpu.transport.codec import (
+    register_data_type,
+    register_enum_type,
+)
+from scalecube_cluster_tpu.transport.message import Message
+
+# -- system qualifiers --------------------------------------------------------
+
+PING = "sc/fdetector/ping"
+PING_REQ = "sc/fdetector/pingReq"
+PING_ACK = "sc/fdetector/pingAck"
+GOSSIP_REQ = "sc/gossip/req"
+SYNC = "sc/membership/sync"
+SYNC_ACK = "sc/membership/syncAck"
+MEMBERSHIP_GOSSIP = "sc/membership/gossip"
+METADATA_REQ = "sc/metadata/req"
+METADATA_RESP = "sc/metadata/resp"
+
+#: Direct (point-to-point) system messages hidden from user ``listen()``
+#: (ClusterImpl.java:43-54, filtered at :255-263).
+SYSTEM_MESSAGES = frozenset(
+    {PING, PING_REQ, PING_ACK, GOSSIP_REQ, SYNC, SYNC_ACK, METADATA_REQ, METADATA_RESP}
+)
+
+#: Gossip qualifiers hidden from the user gossip stream (ClusterImpl.java:56-57).
+SYSTEM_GOSSIPS = frozenset({MEMBERSHIP_GOSSIP})
+
+# -- wire registration of the public data model -------------------------------
+
+register_data_type("member")(Member)
+register_data_type("membership.record")(MembershipRecord)
+register_enum_type("member.status")(MemberStatus)
+
+
+# -- failure detector ---------------------------------------------------------
+
+
+@register_enum_type("fd.ack_type")
+class AckType(Enum):
+    """Result of a ping reaching a destination address (PingData.java:8-23):
+    the process answering may be a *different* member than the one probed
+    (same address, new id = restarted process) — that is ``DEST_GONE`` and
+    maps to DEAD (FailureDetectorImpl.java:231-235, 370-391)."""
+
+    DEST_OK = "DEST_OK"
+    DEST_GONE = "DEST_GONE"
+
+
+@register_data_type("fd.ping")
+@dataclass(frozen=True)
+class PingData:
+    """Probe payload (PingData.java:6-93).
+
+    ``issuer`` is the probing node; ``target`` the probed member.
+    ``original_issuer`` is set on transit pings relayed for an indirect
+    probe (ping-req), so the target's ack can be routed back to the origin
+    (FailureDetectorImpl.java:255-305).
+    """
+
+    issuer: Member
+    target: Member
+    original_issuer: Member | None = None
+    ack_type: AckType | None = None
+
+
+# -- gossip -------------------------------------------------------------------
+
+
+@register_data_type("gossip")
+@dataclass(frozen=True)
+class Gossip:
+    """One rumor: globally-unique id + the user message (Gossip.java:7-49).
+
+    The id is ``<originMemberId>-<perOriginSequence>`` (GossipProtocolImpl
+    .java:211-213), which receivers dedup on.
+    """
+
+    gossip_id: str
+    message: Message
+
+
+@register_data_type("gossip.req")
+@dataclass(frozen=True)
+class GossipRequest:
+    """A batch of gossips pushed to one peer (GossipRequest.java:8-37)."""
+
+    gossips: tuple[Gossip, ...]
+    from_member_id: str
+
+
+# -- membership ---------------------------------------------------------------
+
+
+@register_data_type("membership.sync")
+@dataclass(frozen=True)
+class SyncData:
+    """Full-table anti-entropy exchange (SyncData.java:11-41): every
+    membership record the sender holds, plus its sync-group tag (SYNCs
+    across groups are ignored, MembershipProtocolImpl.java:442-448)."""
+
+    membership: tuple[MembershipRecord, ...]
+    sync_group: str
+
+
+# -- metadata -----------------------------------------------------------------
+
+
+@register_data_type("metadata.req")
+@dataclass(frozen=True)
+class GetMetadataRequest:
+    """Asks a member for its current metadata (GetMetadataRequest.java:7-27).
+    Carries the *expected* member so a restarted process at the same address
+    (different id) won't answer for its predecessor
+    (MetadataStoreImpl.java:209-249)."""
+
+    member: Member
+
+
+@register_data_type("metadata.resp")
+@dataclass(frozen=True)
+class GetMetadataResponse:
+    """Metadata reply (GetMetadataResponse.java:10-38)."""
+
+    member: Member
+    metadata: Any

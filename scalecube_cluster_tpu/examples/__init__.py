@@ -1,0 +1,12 @@
+"""Runnable examples (reference: examples/ module, e.g.
+ClusterJoinExamples.java:20-90, GossipExample.java:108-179).
+
+Each module has a ``main()`` and runs standalone::
+
+    python -m scalecube_cluster_tpu.examples.cluster_join
+    python -m scalecube_cluster_tpu.examples.gossip_example
+    python -m scalecube_cluster_tpu.examples.messaging_example
+    python -m scalecube_cluster_tpu.examples.membership_events
+    python -m scalecube_cluster_tpu.examples.metadata_example
+    python -m scalecube_cluster_tpu.examples.soak_runner --nodes 20
+"""

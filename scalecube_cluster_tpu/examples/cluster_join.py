@@ -1,0 +1,31 @@
+"""Alice/Bob/Carol join a seed and list each other — the README example
+(reference README.md:21-35, ClusterJoinExamples.java:20-90)."""
+
+import asyncio
+
+from scalecube_cluster_tpu import Cluster, ClusterConfig
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local()
+    seed = await Cluster.start(cfg)
+    print(f"seed started: {seed.member()}")
+
+    join = cfg.with_seed_members(seed.address)
+    alice = await Cluster.start(join.with_(member_alias="alice"))
+    bob = await Cluster.start(join.with_(member_alias="bob"))
+    carol = await Cluster.start(join.with_(member_alias="carol"))
+    nodes = [seed, alice, bob, carol]
+
+    while not all(len(n.members()) == 4 for n in nodes):
+        await asyncio.sleep(0.1)
+
+    for node in nodes:
+        print(f"{node.member()} sees: {sorted(str(m) for m in node.other_members())}")
+
+    await asyncio.gather(*(n.shutdown() for n in nodes))
+    print("all nodes shut down")
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,36 @@
+"""Gossip pub/sub across a small cluster (reference GossipExample.java:108-179)."""
+
+import asyncio
+
+from scalecube_cluster_tpu import Cluster, ClusterConfig, ClusterMessageHandler
+from scalecube_cluster_tpu.transport import Message
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local()
+    seed = await Cluster.start(cfg)
+    join = cfg.with_seed_members(seed.address)
+
+    got = asyncio.Event()
+
+    class Listener(ClusterMessageHandler):
+        def __init__(self, name: str):
+            self.name = name
+
+        def on_gossip(self, gossip: Message) -> None:
+            print(f"{self.name} heard gossip: {gossip.data!r}")
+            got.set()
+
+    a = await Cluster.start(join.with_(member_alias="a"), handler=Listener("a"))
+    b = await Cluster.start(join.with_(member_alias="b"), handler=Listener("b"))
+    nodes = [seed, a, b]
+    while not all(len(n.members()) == 3 for n in nodes):
+        await asyncio.sleep(0.1)
+
+    seed.spread_gossip(Message.create(qualifier="announce", data="hello cluster"))
+    await asyncio.wait_for(got.wait(), timeout=10)
+    await asyncio.gather(*(n.shutdown() for n in nodes))
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,32 @@
+"""Watch membership events as nodes come and go (reference
+MembershipEventsExample.java)."""
+
+import asyncio
+
+from scalecube_cluster_tpu import Cluster, ClusterConfig, ClusterMessageHandler
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local()
+
+    class Watcher(ClusterMessageHandler):
+        def on_membership_event(self, event) -> None:
+            print(f"seed observed: {event}")
+
+    seed = await Cluster.start(cfg, handler=Watcher())
+    join = cfg.with_seed_members(seed.address)
+
+    a = await Cluster.start(join.with_(member_alias="transient-a"))
+    b = await Cluster.start(join.with_(member_alias="transient-b"))
+    while len(seed.members()) != 3:
+        await asyncio.sleep(0.1)
+
+    await a.shutdown()  # graceful leave -> REMOVED rumor
+    while len(seed.members()) != 2:
+        await asyncio.sleep(0.1)
+
+    await asyncio.gather(seed.shutdown(), b.shutdown())
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,48 @@
+"""Point-to-point ping/pong between two members (reference
+MessagingExample.java)."""
+
+import asyncio
+
+from scalecube_cluster_tpu import Cluster, ClusterConfig, ClusterMessageHandler
+from scalecube_cluster_tpu.transport import Message
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local()
+
+    class Ponger(ClusterMessageHandler):
+        def __init__(self):
+            self.cluster: Cluster | None = None
+
+        def on_message(self, message: Message) -> None:
+            print(f"ponger got: {message.data!r}")
+            asyncio.ensure_future(
+                self.cluster.send(
+                    message.sender,
+                    Message.create(
+                        qualifier="pong",
+                        data=f"pong({message.data})",
+                        correlation_id=message.correlation_id,
+                    ),
+                )
+            )
+
+    ponger = Ponger()
+    seed = await Cluster.start(cfg, handler=ponger)
+    ponger.cluster = seed
+
+    pinger = await Cluster.start(cfg.with_seed_members(seed.address))
+    while len(pinger.members()) != 2:
+        await asyncio.sleep(0.1)
+
+    reply = await pinger.request_response(
+        pinger.member_by_address(seed.address),
+        Message.create(qualifier="ping", data="hi", correlation_id="rr-1"),
+        timeout=5,
+    )
+    print(f"pinger got: {reply.data!r}")
+    await asyncio.gather(seed.shutdown(), pinger.shutdown())
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,38 @@
+"""Metadata propagation + live update (reference ClusterMetadataExample.java)."""
+
+import asyncio
+
+from scalecube_cluster_tpu import Cluster, ClusterConfig, ClusterMessageHandler
+
+
+async def main() -> None:
+    cfg = ClusterConfig.default_local()
+    seed = await Cluster.start(cfg.with_(metadata={"service": "registry", "v": 1}))
+
+    class Watcher(ClusterMessageHandler):
+        def on_membership_event(self, event) -> None:
+            if event.is_updated:
+                print(f"metadata changed: {event.old_metadata} -> {event.new_metadata}")
+
+    node = await Cluster.start(
+        cfg.with_seed_members(seed.address), handler=Watcher()
+    )
+    while len(node.members()) != 2:
+        await asyncio.sleep(0.1)
+
+    seed_member = node.member_by_address(seed.address)
+    print(f"node sees seed metadata: {node.metadata(seed_member)}")
+
+    await seed.update_metadata({"service": "registry", "v": 2})
+    while node.metadata(node.member_by_address(seed.address)) != {
+        "service": "registry",
+        "v": 2,
+    }:
+        await asyncio.sleep(0.1)
+    print("node observed the update")
+
+    await asyncio.gather(seed.shutdown(), node.shutdown())
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,42 @@
+"""Long-running churn soak: N nodes join one seed, then members leave and
+re-join continuously (reference: issue-187 SeedRunner/NodeRunner soak
+programs, examples/io/scalecube/issues/i187/SeedRunner.java:12-60)."""
+
+import argparse
+import asyncio
+import random
+
+from scalecube_cluster_tpu import Cluster, ClusterConfig
+
+
+async def main(n_nodes: int, churn_rounds: int) -> None:
+    cfg = ClusterConfig.default_local()
+    seed = await Cluster.start(cfg)
+    join = cfg.with_seed_members(seed.address)
+    nodes = [await Cluster.start(join) for _ in range(n_nodes)]
+    expected = n_nodes + 1
+    while not all(len(c.members()) == expected for c in [seed] + nodes):
+        await asyncio.sleep(0.2)
+    print(f"converged: {expected} members everywhere")
+
+    rng = random.Random(187)
+    for round_no in range(churn_rounds):
+        victim = nodes.pop(rng.randrange(len(nodes)))
+        await victim.shutdown()
+        while len(seed.members()) != len(nodes) + 1:
+            await asyncio.sleep(0.2)
+        nodes.append(await Cluster.start(join))
+        while len(seed.members()) != len(nodes) + 1:
+            await asyncio.sleep(0.2)
+        print(f"churn round {round_no + 1}: view stable at {len(nodes) + 1}")
+
+    await asyncio.gather(*(c.shutdown() for c in [seed] + nodes))
+    print("soak complete")
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--nodes", type=int, default=10)
+    parser.add_argument("--churn-rounds", type=int, default=3)
+    args = parser.parse_args()
+    asyncio.run(main(args.nodes, args.churn_rounds))

@@ -1,5 +1,12 @@
 """Test library: fault injection + cluster factories (reference: cluster-testlib/)."""
 
+from scalecube_cluster_tpu.testlib.fixtures import (
+    await_until,
+    fast_test_config,
+    shutdown_all,
+    start_node,
+    suspicion_settle_time,
+)
 from scalecube_cluster_tpu.testlib.network_emulator import (
     InboundSettings,
     NetworkEmulator,
@@ -10,6 +17,11 @@ from scalecube_cluster_tpu.testlib.network_emulator import (
 
 __all__ = [
     "InboundSettings",
+    "await_until",
+    "fast_test_config",
+    "shutdown_all",
+    "start_node",
+    "suspicion_settle_time",
     "NetworkEmulator",
     "NetworkEmulatorException",
     "NetworkEmulatorTransport",

@@ -29,6 +29,7 @@ from dataclasses import dataclass
 from scalecube_cluster_tpu.transport.api import MessageStream, Transport
 from scalecube_cluster_tpu.transport.message import Message
 from scalecube_cluster_tpu.utils.address import Address
+from scalecube_cluster_tpu.utils.streams import filtered
 
 logger = logging.getLogger(__name__)
 
@@ -180,24 +181,11 @@ class NetworkEmulatorTransport(Transport):
         await self._inner.send(to, message)
 
     def listen(self) -> MessageStream:
-        inner_stream = self._inner.listen()
-        filtered = MessageStream(on_close=lambda s: inner_stream.close())
-        emulator = self.network_emulator
-
-        async def pump() -> None:
-            try:
-                async for msg in inner_stream:
-                    if emulator.shall_pass_inbound(msg.sender):
-                        filtered._publish(msg)
-            except Exception:
-                logger.exception("inbound fault-filter pump failed")
-            finally:
-                filtered.close()
-
-        # Keep a strong reference: the event loop holds tasks weakly, and a
-        # swallowed pump failure must be logged, not dropped at GC time.
-        filtered._pump_task = asyncio.ensure_future(pump())
-        return filtered
+        return filtered(
+            self._inner.listen(),
+            lambda msg: self.network_emulator.shall_pass_inbound(msg.sender),
+            stream_cls=MessageStream,
+        )
 
     async def stop(self) -> None:
         await self._inner.stop()

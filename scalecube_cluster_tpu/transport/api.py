@@ -21,19 +21,18 @@ correct request/response fault semantics by only wrapping ``send``/``listen``.
 from __future__ import annotations
 
 import asyncio
-import contextlib
 from abc import ABC, abstractmethod
-from typing import AsyncIterator, Callable
 
 from scalecube_cluster_tpu.transport.message import Message
 from scalecube_cluster_tpu.utils.address import Address
+from scalecube_cluster_tpu.utils.streams import Multicast, Stream
 
 
 class TransportStoppedError(ConnectionError):
     """Raised when using a transport after ``stop()``."""
 
 
-class MessageStream:
+class MessageStream(Stream[Message]):
     """One subscription to a transport's inbound stream.
 
     Async-iterable; terminates cleanly when the transport stops (reference:
@@ -41,32 +40,6 @@ class MessageStream:
     raised by one subscriber must never affect other subscribers
     (TransportTest.java:268-313), which queue-per-subscriber gives for free.
     """
-
-    _CLOSED = object()
-
-    def __init__(self, on_close: Callable[["MessageStream"], None]):
-        self._queue: asyncio.Queue = asyncio.Queue()
-        self._on_close = on_close
-        self._closed = False
-
-    def _publish(self, message: Message) -> None:
-        if not self._closed:
-            self._queue.put_nowait(message)
-
-    def close(self) -> None:
-        if not self._closed:
-            self._closed = True
-            self._queue.put_nowait(self._CLOSED)
-            self._on_close(self)
-
-    def __aiter__(self) -> AsyncIterator[Message]:
-        return self
-
-    async def __anext__(self) -> Message:
-        item = await self._queue.get()
-        if item is self._CLOSED:
-            raise StopAsyncIteration
-        return item
 
 
 class Transport(ABC):
@@ -120,18 +93,13 @@ class _ListenMixin:
     """Shared multicast-subscriber bookkeeping for concrete transports."""
 
     def __init__(self) -> None:
-        self._streams: set[MessageStream] = set()
+        self._inbound: Multicast[Message] = Multicast(stream_cls=MessageStream)
 
     def listen(self) -> MessageStream:
-        stream = MessageStream(on_close=self._streams.discard)
-        self._streams.add(stream)
-        return stream
+        return self._inbound.subscribe()  # type: ignore[return-value]
 
     def _dispatch(self, message: Message) -> None:
-        for stream in list(self._streams):
-            stream._publish(message)
+        self._inbound.publish(message)
 
     def _complete_streams(self) -> None:
-        for stream in list(self._streams):
-            with contextlib.suppress(Exception):
-                stream.close()
+        self._inbound.complete()

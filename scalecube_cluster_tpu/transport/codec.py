@@ -16,6 +16,7 @@ from __future__ import annotations
 import dataclasses
 import json
 from abc import ABC, abstractmethod
+from enum import Enum
 from typing import Any, Callable, Type, TypeVar
 
 from scalecube_cluster_tpu.transport.message import Message
@@ -25,6 +26,8 @@ _TYPE_KEY = "@type"
 
 _TAG_TO_TYPE: dict[str, type] = {}
 _TYPE_TO_TAG: dict[type, str] = {}
+_TAG_TO_ENUM: dict[str, type] = {}
+_ENUM_TO_TAG: dict[type, str] = {}
 
 T = TypeVar("T")
 
@@ -45,12 +48,47 @@ def register_data_type(tag: str) -> Callable[[Type[T]], Type[T]]:
     return deco
 
 
+def register_enum_type(tag: str) -> Callable[[Type[T]], Type[T]]:
+    """Class decorator registering an Enum so its members round-trip as the
+    enum (tagged on the wire), anywhere they appear — as dataclass fields,
+    inside containers, or in raw user payloads. Unregistered enums raise a
+    loud TypeError at serialize time rather than decoding corrupted."""
+
+    def deco(cls: Type[T]) -> Type[T]:
+        if not (isinstance(cls, type) and issubclass(cls, Enum)):
+            raise TypeError(f"{cls!r} must be an Enum to be wire-registered")
+        existing = _TAG_TO_ENUM.get(tag)
+        if existing is not None and existing is not cls:
+            raise ValueError(f"tag {tag!r} already registered to {existing!r}")
+        _TAG_TO_ENUM[tag] = cls
+        _ENUM_TO_TAG[cls] = tag
+        return cls
+
+    return deco
+
+
 def _encode(obj: Any) -> Any:
     """Recursively convert payloads to JSON-compatible structures."""
+    if isinstance(obj, Enum):  # before int: IntEnum is an int subclass
+        tag = _ENUM_TO_TAG.get(type(obj))
+        if tag is None:
+            raise TypeError(
+                f"not wire-serializable: unregistered enum {type(obj).__name__}"
+            )
+        return {_TYPE_KEY: "enum", "e": tag, "v": obj.value}
     if obj is None or isinstance(obj, (bool, int, float, str)):
         return obj
     if isinstance(obj, Address):
         return {_TYPE_KEY: "address", "value": str(obj)}
+    if isinstance(obj, Message):
+        # Messages nest inside protocol payloads (gossip envelopes carry the
+        # user's message, GossipRequest.java:8-37 analog).
+        return {
+            _TYPE_KEY: "message",
+            "headers": dict(obj.headers),
+            "data": _encode(obj.data),
+            "sender": str(obj.sender) if obj.sender else None,
+        }
     if isinstance(obj, tuple):
         # Tagged so tuples round-trip as tuples (frozen dataclass fields
         # must stay hashable after a wire hop).
@@ -81,6 +119,18 @@ def _decode(obj: Any) -> Any:
             return Address.from_string(obj["value"])
         if tag == "tuple":
             return tuple(_decode(v) for v in obj["items"])
+        if tag == "enum":
+            enum_cls = _TAG_TO_ENUM.get(obj["e"])
+            if enum_cls is None:
+                raise ValueError(f"unknown wire enum tag: {obj['e']!r}")
+            return enum_cls(obj["v"])
+        if tag == "message":
+            sender = obj.get("sender")
+            return Message(
+                headers=obj.get("headers") or {},
+                data=_decode(obj.get("data")),
+                sender=Address.from_string(sender) if sender else None,
+            )
         cls = _TAG_TO_TYPE.get(tag)
         if cls is None:
             raise ValueError(f"unknown wire type tag: {tag!r}")

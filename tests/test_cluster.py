@@ -1,0 +1,164 @@
+"""End-to-end Cluster facade tests.
+
+Ports ClusterTest.java:33-502: member lookup, 10-node dynamic-port join,
+metadata propagation, user messaging + handler callbacks, system-traffic
+filtering, and seedless-seed startup.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu import ClusterMessageHandler
+from scalecube_cluster_tpu.cluster.payloads import SYSTEM_MESSAGES
+from scalecube_cluster_tpu.testlib import await_until, shutdown_all, start_node
+from scalecube_cluster_tpu.transport.message import Message
+
+
+@pytest.mark.asyncio
+async def test_ten_node_join():
+    """10 nodes on dynamic ports join one seed and all converge
+    (ClusterTest.java:88-114)."""
+    seed = await start_node()
+    others = []
+    for _ in range(9):
+        others.append(await start_node(seeds=(seed.address,)))
+    clusters = [seed] + others
+    try:
+        await await_until(
+            lambda: all(len(c.members()) == 10 for c in clusters), timeout=30
+        )
+        ids = {c.member().id for c in clusters}
+        for c in clusters:
+            assert {m.id for m in c.members()} == ids
+    finally:
+        await shutdown_all(*clusters)
+
+
+@pytest.mark.asyncio
+async def test_member_lookup_by_id_and_address():
+    seed = await start_node()
+    a = await start_node(seeds=(seed.address,))
+    try:
+        await await_until(lambda: len(seed.members()) == 2, timeout=10)
+        found = seed.member_by_id(a.member().id)
+        assert found is not None and found.address == a.member().address
+        assert seed.member_by_address(a.member().address).id == a.member().id
+        assert seed.member_by_id("nonexistent") is None
+    finally:
+        await shutdown_all(seed, a)
+
+
+@pytest.mark.asyncio
+async def test_user_messaging_and_handler_callbacks():
+    """send / request_response / gossip reach user handlers; system traffic
+    never does (ClusterImpl.java:255-263)."""
+    received: list[Message] = []
+    gossips: list[Message] = []
+    events = []
+
+    class Handler(ClusterMessageHandler):
+        def on_message(self, message: Message) -> None:
+            received.append(message)
+
+        def on_gossip(self, gossip: Message) -> None:
+            gossips.append(gossip)
+
+        def on_membership_event(self, event) -> None:
+            events.append(event)
+
+    seed = await start_node(handler=Handler())
+    a = await start_node(seeds=(seed.address,))
+    try:
+        await await_until(lambda: len(seed.members()) == 2, timeout=10)
+        assert any(e.is_added for e in events)
+
+        await a.send(seed.member(), Message.create(qualifier="hello", data=42))
+        await await_until(lambda: len(received) == 1, timeout=5)
+        assert received[0].data == 42
+        assert received[0].sender == a.member().address
+
+        a.spread_gossip(Message.create(qualifier="news", data="flash"))
+        await await_until(lambda: len(gossips) == 1, timeout=10)
+        assert gossips[0].data == "flash"
+
+        # only user traffic surfaced, despite constant protocol chatter
+        assert all(m.qualifier not in SYSTEM_MESSAGES for m in received)
+        assert all(g.qualifier == "news" for g in gossips)
+    finally:
+        await shutdown_all(seed, a)
+
+
+@pytest.mark.asyncio
+async def test_user_request_response():
+    seed = await start_node()
+    a = await start_node(seeds=(seed.address,))
+    try:
+        await await_until(lambda: len(a.members()) == 2, timeout=10)
+
+        async def responder():
+            async for msg in seed.listen():
+                if msg.qualifier == "ask":
+                    await seed.send(
+                        msg.sender,
+                        Message.create(
+                            qualifier="answer",
+                            data=msg.data * 2,
+                            correlation_id=msg.correlation_id,
+                        ),
+                    )
+
+        task = asyncio.create_task(responder())
+        resp = await a.request_response(
+            seed.member(),
+            Message.create(qualifier="ask", data=21, correlation_id="q-1"),
+            timeout=5,
+        )
+        assert resp.data == 42
+        task.cancel()
+    finally:
+        await shutdown_all(seed, a)
+
+
+@pytest.mark.asyncio
+async def test_metadata_visible_to_all_members():
+    """Each node's metadata is fetchable at every other node after join
+    (ClusterTest.java:117-273)."""
+    seed = await start_node(metadata={"role": "seed"})
+    a = await start_node(seeds=(seed.address,), metadata={"role": "a"})
+    b = await start_node(seeds=(seed.address,), metadata={"role": "b"})
+    clusters = [seed, a, b]
+    try:
+        await await_until(
+            lambda: all(len(c.members()) == 3 for c in clusters), timeout=10
+        )
+        for c in clusters:
+            roles = {c.metadata(m)["role"] for m in c.members()}
+            assert roles == {"seed", "a", "b"}
+    finally:
+        await shutdown_all(*clusters)
+
+
+@pytest.mark.asyncio
+async def test_seedless_seed_startup():
+    """A node seeded with its own address starts cleanly as a 1-member
+    cluster (ClusterTest.java:473+)."""
+    seed = await start_node()
+    try:
+        assert len(seed.members()) == 1
+        assert seed.members()[0].id == seed.member().id
+        assert not seed.is_shutdown
+    finally:
+        await shutdown_all(seed)
+
+
+@pytest.mark.asyncio
+async def test_shutdown_is_idempotent_and_resolves_on_shutdown():
+    seed = await start_node()
+    waiter = asyncio.create_task(seed.on_shutdown())
+    await seed.shutdown()
+    await seed.shutdown()  # second call is a no-op
+    await asyncio.wait_for(waiter, timeout=5)
+    assert seed.is_shutdown

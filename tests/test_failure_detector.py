@@ -1,0 +1,154 @@
+"""Failure-detector probe-matrix tests.
+
+Ports the scenarios of FailureDetectorTest.java:50-498: all-ALIVE trios,
+all-SUSPECT under full block, ALIVE despite one bad link (ping-req rescue),
+and restart detection via DEST_GONE. Nodes here are bare FailureDetector
+instances over emulated transports with manually-injected member lists, the
+same isolation level the reference suite uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from scalecube_cluster_tpu.cluster.fdetector import FailureDetector
+from scalecube_cluster_tpu.cluster_api.config import FailureDetectorConfig
+from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
+from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.testlib import NetworkEmulatorTransport, await_until
+from scalecube_cluster_tpu.transport.tcp import TcpTransport
+from scalecube_cluster_tpu.utils.ids import CorrelationIdGenerator
+
+FD_CONFIG = FailureDetectorConfig(
+    ping_interval=200, ping_timeout=100, ping_req_members=2
+)
+
+
+class FdNode:
+    """One failure-detector-only node (the reference test fixture shape)."""
+
+    def __init__(self, transport: NetworkEmulatorTransport, member: Member):
+        self.transport = transport
+        self.member = member
+        self.fd = FailureDetector(
+            transport,
+            member,
+            FD_CONFIG,
+            CorrelationIdGenerator(member.id),
+            rng=random.Random(member.id),
+        )
+        self.statuses: dict[str, MemberStatus] = {}
+        self._watch: asyncio.Task | None = None
+
+    def start(self, peers: list["FdNode"]) -> None:
+        for peer in peers:
+            if peer is not self:
+                self.fd.on_membership_event(MembershipEvent.added(peer.member))
+        self.fd.start()
+        self._watch = asyncio.create_task(self._watch_events())
+
+    async def _watch_events(self) -> None:
+        async for event in self.fd.listen():
+            self.statuses[event.member.id] = event.status
+
+    async def stop(self) -> None:
+        if self._watch:
+            self._watch.cancel()
+        self.fd.stop()
+        await self.transport.stop()
+
+
+async def make_nodes(n: int) -> list[FdNode]:
+    nodes = []
+    for i in range(n):
+        transport = NetworkEmulatorTransport(await TcpTransport.bind(), seed=i)
+        nodes.append(FdNode(transport, Member.create(transport.address)))
+    for node in nodes:
+        node.start(nodes)
+    return nodes
+
+
+async def stop_nodes(nodes: list[FdNode]) -> None:
+    await asyncio.gather(*(n.stop() for n in nodes), return_exceptions=True)
+
+
+def saw_all(node: FdNode, others: list[FdNode], status: MemberStatus) -> bool:
+    return all(
+        node.statuses.get(o.member.id) is status for o in others if o is not node
+    )
+
+
+@pytest.mark.asyncio
+async def test_trio_all_alive():
+    """Healthy links: every node reports every peer ALIVE
+    (FailureDetectorTest.java:50-77)."""
+    nodes = await make_nodes(3)
+    try:
+        await await_until(
+            lambda: all(saw_all(n, nodes, MemberStatus.ALIVE) for n in nodes),
+            timeout=5,
+        )
+    finally:
+        await stop_nodes(nodes)
+
+
+@pytest.mark.asyncio
+async def test_all_suspect_under_full_block():
+    """All links blocked: every node suspects every peer
+    (FailureDetectorTest.java:79-114)."""
+    nodes = await make_nodes(3)
+    try:
+        for node in nodes:
+            node.network_emulator = node.transport.network_emulator
+            node.transport.network_emulator.block_all_outbound()
+            node.transport.network_emulator.block_all_inbound()
+        # drop pre-block verdicts
+        for node in nodes:
+            node.statuses.clear()
+        await await_until(
+            lambda: all(saw_all(n, nodes, MemberStatus.SUSPECT) for n in nodes),
+            timeout=5,
+        )
+    finally:
+        await stop_nodes(nodes)
+
+
+@pytest.mark.asyncio
+async def test_ping_req_rescues_one_bad_link():
+    """A->B blocked both ways, but A-C and C-B fine: A still sees B ALIVE via
+    the C relay (FailureDetectorTest.java:117-146)."""
+    a, b, c = nodes = await make_nodes(3)
+    try:
+        a.transport.network_emulator.block_outbound(b.transport.address)
+        b.transport.network_emulator.block_outbound(a.transport.address)
+        a.statuses.clear()
+        await await_until(
+            lambda: a.statuses.get(b.member.id) is MemberStatus.ALIVE, timeout=5
+        )
+        # and the rescue never produced a false DEAD
+        assert a.statuses.get(b.member.id) is not MemberStatus.DEAD
+    finally:
+        await stop_nodes(nodes)
+
+
+@pytest.mark.asyncio
+async def test_restarted_process_detected_as_dead():
+    """A process restarted at the same address answers with a new member id:
+    the ack is DEST_GONE and the old identity goes DEAD
+    (FailureDetectorTest.java:344+, PingData.java:8-23)."""
+    a, b = nodes = await make_nodes(2)
+    try:
+        # "Restart" b: same transport/address, new member identity answering.
+        b.fd.stop()
+        reborn = FdNode(b.transport, Member.create(b.transport.address))
+        reborn.start([a, reborn])
+        nodes.append(reborn)
+        a.statuses.clear()
+        await await_until(
+            lambda: a.statuses.get(b.member.id) is MemberStatus.DEAD, timeout=5
+        )
+    finally:
+        await stop_nodes([a, nodes[-1]])

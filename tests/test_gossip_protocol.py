@@ -1,0 +1,130 @@
+"""Gossip dissemination experiments.
+
+Ports GossipProtocolTest.java:44-297: parameterized {N, loss%} grids
+asserting complete dissemination, **no double delivery**, dissemination
+within the sweep deadline, and spread() completion at sweep — measured
+against the ClusterMath predictions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+import pytest
+
+from scalecube_cluster_tpu import cluster_math
+from scalecube_cluster_tpu.cluster.gossip import GossipProtocol
+from scalecube_cluster_tpu.cluster_api.config import GossipConfig
+from scalecube_cluster_tpu.cluster_api.member import Member
+from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.testlib import NetworkEmulatorTransport, await_until
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.transport.tcp import TcpTransport
+
+GOSSIP_CONFIG = GossipConfig(gossip_interval=50, gossip_fanout=3, gossip_repeat_mult=3)
+
+
+class GossipNode:
+    def __init__(self, transport: NetworkEmulatorTransport, member: Member):
+        self.transport = transport
+        self.member = member
+        self.protocol = GossipProtocol(
+            transport, member, GOSSIP_CONFIG, rng=random.Random(member.id)
+        )
+        self.received: list[Message] = []
+        self._watch: asyncio.Task | None = None
+
+    def start(self, peers: list["GossipNode"]) -> None:
+        for peer in peers:
+            if peer is not self:
+                self.protocol.on_membership_event(MembershipEvent.added(peer.member))
+        self.protocol.start()
+        self._watch = asyncio.create_task(self._watch_messages())
+
+    async def _watch_messages(self) -> None:
+        async for msg in self.protocol.listen():
+            self.received.append(msg)
+
+    async def stop(self) -> None:
+        if self._watch:
+            self._watch.cancel()
+        self.protocol.stop()
+        await self.transport.stop()
+
+
+async def make_mesh(n: int, loss_percent: float = 0.0) -> list[GossipNode]:
+    nodes = []
+    for i in range(n):
+        transport = NetworkEmulatorTransport(await TcpTransport.bind(), seed=i)
+        if loss_percent:
+            transport.network_emulator.set_default_outbound_settings(loss_percent)
+        nodes.append(GossipNode(transport, Member.create(transport.address)))
+    for node in nodes:
+        node.start(nodes)
+    return nodes
+
+
+async def stop_mesh(nodes: list[GossipNode]) -> None:
+    await asyncio.gather(*(n.stop() for n in nodes), return_exceptions=True)
+
+
+@pytest.mark.asyncio
+@pytest.mark.parametrize("n,loss", [(6, 0.0), (10, 20.0)])
+async def test_complete_dissemination_exactly_once(n: int, loss: float):
+    """Every node receives the rumor exactly once, within the sweep deadline
+    (GossipProtocolTest.java:154-173)."""
+    nodes = await make_mesh(n, loss)
+    try:
+        origin = nodes[0]
+        origin.protocol.spread(
+            Message.create(qualifier="rumor", data="payload")
+        )
+        deadline_ms = cluster_math.gossip_timeout_to_sweep(
+            GOSSIP_CONFIG.gossip_repeat_mult, n, GOSSIP_CONFIG.gossip_interval
+        )
+        await await_until(
+            lambda: all(len(peer.received) >= 1 for peer in nodes[1:]),
+            timeout=deadline_ms / 1000.0 + 2.0,
+        )
+        # settle, then assert exactly-once (dedup by gossip id)
+        await asyncio.sleep(0.5)
+        for peer in nodes[1:]:
+            assert len(peer.received) == 1, f"double delivery at {peer.member}"
+            assert peer.received[0].data == "payload"
+    finally:
+        await stop_mesh(nodes)
+
+
+@pytest.mark.asyncio
+async def test_spread_future_resolves_at_sweep():
+    """spread() completes with the gossip id once the rumor is swept
+    (GossipProtocolImpl.java:299-302)."""
+    nodes = await make_mesh(4)
+    try:
+        fut = nodes[0].protocol.spread(Message.create(qualifier="r", data=1))
+        gossip_id = await asyncio.wait_for(fut, timeout=10)
+        assert gossip_id.startswith(nodes[0].member.id)
+        assert not nodes[0].protocol._gossips  # swept
+    finally:
+        await stop_mesh(nodes)
+
+
+@pytest.mark.asyncio
+async def test_message_bound_respects_cluster_math():
+    """Per-node sends for one gossip stay within the ClusterMath upper bound
+    (GossipProtocolTest.java:176-203 logs measured vs theory)."""
+    n = 6
+    nodes = await make_mesh(n)
+    try:
+        origin = nodes[0]
+        sent_before = origin.transport.network_emulator.total_message_sent_count
+        fut = origin.protocol.spread(Message.create(qualifier="r", data=1))
+        await asyncio.wait_for(fut, timeout=15)
+        sent = origin.transport.network_emulator.total_message_sent_count - sent_before
+        bound = cluster_math.max_messages_per_gossip_per_node(
+            GOSSIP_CONFIG.gossip_fanout, GOSSIP_CONFIG.gossip_repeat_mult, n
+        )
+        assert sent <= bound, f"{sent} sends exceed ClusterMath bound {bound}"
+    finally:
+        await stop_mesh(nodes)

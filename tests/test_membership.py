@@ -1,0 +1,227 @@
+"""Membership-protocol distributed scenarios.
+
+Ports the core of MembershipProtocolTest.java:40-1086: 3-node joins,
+outbound-block partitions with suspicion-timeout removal and recovery,
+restart at the same port, seed-chain joins, sync-group isolation, and
+self-refutation (incarnation bump) under false suspicion.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.cluster_api.member import MemberStatus
+from scalecube_cluster_tpu.testlib import (
+    await_until,
+    fast_test_config,
+    shutdown_all,
+    start_node,
+    suspicion_settle_time,
+)
+
+
+def views_converged(clusters, n) -> bool:
+    return all(len(c.members()) == n for c in clusters)
+
+
+@pytest.mark.asyncio
+async def test_three_node_join():
+    """Seed + two joiners all see a 3-member view
+    (MembershipProtocolTest.java:69-91)."""
+    seed = await start_node()
+    a = await start_node(seeds=(seed.address,))
+    b = await start_node(seeds=(seed.address,))
+    try:
+        await await_until(lambda: views_converged([seed, a, b], 3), timeout=10)
+        ids = {m.id for m in seed.members()}
+        assert ids == {seed.member().id, a.member().id, b.member().id}
+    finally:
+        await shutdown_all(seed, a, b)
+
+
+@pytest.mark.asyncio
+async def test_seed_chain_join():
+    """C only knows B, B only knows A: the views still converge to 3
+    (MembershipProtocolTest.java:523-552)."""
+    a = await start_node()
+    b = await start_node(seeds=(a.address,))
+    c = await start_node(seeds=(b.address,))
+    try:
+        await await_until(lambda: views_converged([a, b, c], 3), timeout=10)
+    finally:
+        await shutdown_all(a, b, c)
+
+
+@pytest.mark.asyncio
+async def test_partitioned_member_removed_then_rejoins():
+    """Fully partition one node: the rest suspect it and remove it after the
+    suspicion timeout; healing the partition re-admits it
+    (MembershipProtocolTest.java:94-263, 321-371)."""
+    seed = await start_node()
+    a = await start_node(seeds=(seed.address,))
+    b = await start_node(seeds=(seed.address,))
+    clusters = [seed, a, b]
+    try:
+        await await_until(lambda: views_converged(clusters, 3), timeout=10)
+        # partition b both directions
+        b.network_emulator.block_all_outbound()
+        b.network_emulator.block_all_inbound()
+        await await_until(
+            lambda: len(seed.members()) == 2 and len(a.members()) == 2,
+            timeout=suspicion_settle_time(3) + 5,
+        )
+        assert seed.member_by_id(b.member().id) is None
+        # heal: periodic SYNC from b re-introduces it
+        b.network_emulator.unblock_all()
+        await await_until(
+            lambda: views_converged([seed, a], 3) and len(b.members()) == 3,
+            timeout=15,
+        )
+    finally:
+        await shutdown_all(*clusters)
+
+
+@pytest.mark.asyncio
+async def test_suspected_member_refutes_with_incarnation_bump():
+    """A transient partition gets ``a`` suspected; when it heals before the
+    suspicion deadline, ``a`` sees the SUSPECT rumor about itself, refutes by
+    bumping its incarnation, and is never removed
+    (MembershipProtocolImpl.java:549-569, 612-618)."""
+    # Stretch the suspicion window so the heal always lands inside it.
+    cfg = fast_test_config().membership(lambda m: m.with_(suspicion_mult=15))
+    seed = await start_node(config=cfg)
+    a = await start_node(config=cfg, seeds=(seed.address,))
+    try:
+        await await_until(lambda: views_converged([seed, a], 2), timeout=10)
+        inc0 = a.monitor().incarnation
+        a.network_emulator.block_all_outbound()
+        a.network_emulator.block_all_inbound()
+        await await_until(
+            lambda: any(
+                m.id == a.member().id for m in seed.monitor().suspected_members
+            ),
+            timeout=10,
+        )
+        a.network_emulator.unblock_all()
+        # a learns of the suspicion (sync/gossip), refutes, seed re-ALIVEs it
+        await await_until(lambda: a.monitor().incarnation > inc0, timeout=10)
+        await await_until(
+            lambda: not seed.monitor().suspected_members, timeout=10
+        )
+        assert len(seed.members()) == 2
+        assert seed.member_by_id(a.member().id) is not None
+    finally:
+        await shutdown_all(seed, a)
+
+
+@pytest.mark.asyncio
+async def test_restart_same_port_swaps_identity():
+    """A member restarted on the same port joins with a new id; the old id is
+    removed (MembershipProtocolTest.java:374-520)."""
+    seed = await start_node()
+    a = await start_node(seeds=(seed.address,))
+    try:
+        await await_until(lambda: views_converged([seed, a], 2), timeout=10)
+        old_id = a.member().id
+        port = a.member().address.port
+        await a.shutdown()
+        cfg = fast_test_config().transport(lambda t: t.with_(port=port))
+        a2 = await start_node(config=cfg, seeds=(seed.address,))
+        await await_until(
+            lambda: seed.member_by_id(a2.member().id) is not None
+            and seed.member_by_id(old_id) is None,
+            timeout=suspicion_settle_time(2) + 5,
+        )
+        assert a2.member().address.port == port
+        assert old_id in {m.id for m in seed.monitor().removed_members}
+        await shutdown_all(a2)
+    finally:
+        await shutdown_all(seed, a)
+
+
+@pytest.mark.asyncio
+async def test_sync_group_isolation():
+    """Nodes in different sync groups ignore each other's SYNCs even when
+    seeded at each other (ClusterJoinExamples syncGroup isolation;
+    MembershipProtocolImpl.java:442-448)."""
+    seed = await start_node()
+    outsider_cfg = fast_test_config().membership(
+        lambda m: m.with_(sync_group="other-group")
+    )
+    outsider = await start_node(config=outsider_cfg, seeds=(seed.address,))
+    try:
+        await asyncio.sleep(2.0)
+        assert len(seed.members()) == 1
+        assert len(outsider.members()) == 1
+    finally:
+        await shutdown_all(seed, outsider)
+
+
+@pytest.mark.asyncio
+async def test_graceful_leave_observed_without_suspicion_delay():
+    """Shutdown spreads a self-DEAD rumor: peers remove the leaver quickly,
+    not after the suspicion timeout (ClusterTest.java:358-399)."""
+    seed = await start_node()
+    a = await start_node(seeds=(seed.address,))
+    b = await start_node(seeds=(seed.address,))
+    try:
+        await await_until(lambda: views_converged([seed, a, b], 3), timeout=10)
+        t0 = asyncio.get_running_loop().time()
+        await b.shutdown()
+        await await_until(
+            lambda: len(seed.members()) == 2 and len(a.members()) == 2, timeout=5
+        )
+        elapsed = asyncio.get_running_loop().time() - t0
+        # well under the ~2s suspicion route for this config
+        assert elapsed < suspicion_settle_time(3)
+    finally:
+        await shutdown_all(seed, a, b)
+
+
+@pytest.mark.asyncio
+async def test_metadata_update_emits_updated_event():
+    """update_metadata bumps incarnation and propagates UPDATED with old and
+    new metadata (ClusterTest.java:117-273)."""
+    seed = await start_node(metadata={"v": 1})
+    a = await start_node(seeds=(seed.address,))
+    try:
+        await await_until(lambda: views_converged([seed, a], 2), timeout=10)
+        events = []
+
+        async def watch():
+            async for e in a.listen_membership():
+                if e.is_updated:
+                    events.append(e)
+                    return
+
+        task = asyncio.create_task(watch())
+        await seed.update_metadata({"v": 2})
+        await asyncio.wait_for(task, timeout=10)
+        assert events[0].member.id == seed.member().id
+        assert events[0].old_metadata == {"v": 1}
+        assert events[0].new_metadata == {"v": 2}
+        assert a.metadata(a.member_by_id(seed.member().id)) == {"v": 2}
+    finally:
+        await shutdown_all(seed, a)
+
+
+@pytest.mark.asyncio
+async def test_suspected_lists_in_monitor():
+    """The monitor exposes suspected members while a partition lasts
+    (MembershipProtocolImpl.java:732-791 MBean lists)."""
+    seed = await start_node()
+    a = await start_node(seeds=(seed.address,))
+    try:
+        await await_until(lambda: views_converged([seed, a], 2), timeout=10)
+        a.network_emulator.block_all_outbound()
+        a.network_emulator.block_all_inbound()
+        await await_until(
+            lambda: any(
+                m.id == a.member().id for m in seed.monitor().suspected_members
+            ),
+            timeout=10,
+        )
+    finally:
+        await shutdown_all(seed, a)
