@@ -1,0 +1,29 @@
+"""TPU-native array kernels for the SWIM simulation backend.
+
+These are the "ops" of the framework: pure jax-traceable building blocks the
+`sim/` engines compose per tick. They are the vectorized counterparts of the
+reference's per-node scalar logic (merge rule, peer selection, message
+delivery) — see each module's docstring for the file:line parity map.
+"""
+
+from scalecube_cluster_tpu.ops.merge import (  # noqa: F401
+    DEAD_BIT,
+    EPOCH_MAX,
+    INC_MAX,
+    UNKNOWN_KEY,
+    decode_epoch,
+    decode_incarnation,
+    decode_status,
+    encode_key,
+    is_alive_key,
+    merge_views,
+    overrides_same_epoch,
+)
+from scalecube_cluster_tpu.ops.select import (  # noqa: F401
+    masked_random_choice,
+    masked_random_topk,
+)
+from scalecube_cluster_tpu.ops.delivery import (  # noqa: F401
+    deliver_rows_any,
+    deliver_rows_max,
+)

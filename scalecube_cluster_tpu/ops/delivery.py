@@ -1,0 +1,57 @@
+"""Batched message delivery: fan-out send + per-receiver combine.
+
+This is the sim's whole "network": where the reference hands a Message to
+reactor-netty per destination (TransportImpl.java:263-297) and each receiver
+folds it into local state on its scheduler thread, the sim represents one
+tick's sends as ``(dst, edge_ok)`` fan-out edges and delivers them with a
+`segment_max` scatter — the GNN-style message-passing step of BASELINE.json's
+north star. Combining by ``max`` is sound because record priority keys form a
+lattice (ops/merge.py); "any" delivery (bool OR) is the degenerate max.
+
+Lost / blocked edges (NetworkEmulator equivalents, sim/faults.py) are routed
+to a dummy segment ``n`` instead of being masked out of the data, so the
+operand needs no per-edge copy.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import ops as jops
+
+
+def deliver_rows_max(rows, dst, edge_ok, n):
+    """Each sender i pushes its payload row to ``dst[i, c]`` for every edge c;
+    each receiver keeps the elementwise max over everything it received.
+
+    Args:
+      rows: ``[N, M]`` int32 payloads (UNKNOWN_KEY/-1 = "nothing for this
+        column"). All of a sender's edges carry the same row, matching the
+        reference where one gossip message carries all young records
+        (GossipProtocolImpl.selectGossipsToSend, :242-251).
+      dst: ``[N, k]`` int32 destinations.
+      edge_ok: ``[N, k]`` bool — edge actually delivers (valid pick, sender
+        alive, receiver alive, not blocked, not lost).
+      n: static receiver count.
+
+    Returns:
+      ``[n, M]`` int32 — per-receiver max, -1 where nothing arrived.
+    """
+    k = dst.shape[1]
+    safe_dst = jnp.where(edge_ok, dst, n)
+    best = jnp.full((n, rows.shape[1]), -1, rows.dtype)
+    for c in range(k):  # k is 1-4: unrolled scatter per fan-out column
+        seg = jops.segment_max(rows, safe_dst[:, c], num_segments=n + 1)[:n]
+        best = jnp.maximum(best, seg)
+    return jnp.maximum(best, jnp.asarray(-1, rows.dtype))
+
+
+def deliver_rows_any(flags, dst, edge_ok, n):
+    """Bool-OR delivery: receiver learns every flag any sender pushed to it.
+
+    Args:
+      flags: ``[N, M]`` bool payload rows.
+    Returns:
+      ``[n, M]`` bool.
+    """
+    got = deliver_rows_max(flags.astype(jnp.int32), dst, edge_ok, n)
+    return got > 0

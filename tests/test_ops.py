@@ -1,0 +1,223 @@
+"""ops/ kernels pinned to the scalar reference semantics.
+
+The decisive test is the exhaustive cross-check of the vectorized lattice
+(ops/merge.py) against the scalar ``is_overrides`` (MembershipRecord.java:66-84
+truth table, already pinned by test_membership_record.py) over every
+(status, incarnation) pair combination.
+"""
+
+import itertools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
+from scalecube_cluster_tpu.cluster_api.membership_record import (
+    MembershipRecord,
+    is_overrides,
+)
+from scalecube_cluster_tpu.ops import (
+    UNKNOWN_KEY,
+    decode_epoch,
+    decode_incarnation,
+    decode_status,
+    deliver_rows_any,
+    deliver_rows_max,
+    encode_key,
+    is_alive_key,
+    masked_random_choice,
+    masked_random_topk,
+    merge_views,
+    overrides_same_epoch,
+)
+from scalecube_cluster_tpu.utils.address import Address
+
+STATUSES = [MemberStatus.ALIVE, MemberStatus.SUSPECT, MemberStatus.DEAD]
+INCS = [0, 1, 2, 7]
+
+_MEMBER = Member(id="m", address=Address.create("127.0.0.1", 1))
+
+
+def _rec(status, inc):
+    return MembershipRecord(member=_MEMBER, status=status, incarnation=inc)
+
+
+# -- key codec ----------------------------------------------------------------
+
+
+def test_encode_decode_roundtrip():
+    statuses, incs, epochs = [], [], []
+    for s in STATUSES:
+        for inc in INCS:
+            for ep in (0, 1, 5):
+                statuses.append(int(s))
+                incs.append(inc)
+                epochs.append(ep)
+    key = encode_key(jnp.array(statuses), jnp.array(incs), jnp.array(epochs))
+    np.testing.assert_array_equal(decode_status(key), np.array(statuses))
+    np.testing.assert_array_equal(decode_incarnation(key), np.array(incs))
+    np.testing.assert_array_equal(decode_epoch(key), np.array(epochs))
+
+
+def test_unknown_encodes_to_sentinel():
+    key = encode_key(jnp.array([int(MemberStatus.UNKNOWN)]), jnp.array([5]))
+    assert int(key[0]) == UNKNOWN_KEY
+    assert int(decode_status(key)[0]) == int(MemberStatus.UNKNOWN)
+    assert not bool(is_alive_key(key)[0])
+
+
+def test_is_alive_key():
+    key = encode_key(
+        jnp.array([int(s) for s in STATUSES]), jnp.array([3, 3, 3])
+    )
+    np.testing.assert_array_equal(
+        np.asarray(is_alive_key(key)), [True, False, False]
+    )
+
+
+# -- override lattice vs scalar truth table -----------------------------------
+
+
+def test_overrides_matches_scalar_exhaustively():
+    """Every same-epoch (r1, r0) pair must agree with scalar is_overrides."""
+    pairs = list(
+        itertools.product(
+            itertools.product(STATUSES, INCS), itertools.product(STATUSES, INCS)
+        )
+    )
+    s1 = jnp.array([int(p[0][0]) for p in pairs])
+    i1 = jnp.array([p[0][1] for p in pairs])
+    s0 = jnp.array([int(p[1][0]) for p in pairs])
+    i0 = jnp.array([p[1][1] for p in pairs])
+    got = np.asarray(overrides_same_epoch(encode_key(s1, i1), encode_key(s0, i0)))
+    want = np.array(
+        [is_overrides(_rec(p[0][0], p[0][1]), _rec(p[1][0], p[1][1])) for p in pairs]
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_overrides_unknown_introduction_via_merge():
+    """r0=None: only ALIVE introduces (membership_record.py is_overrides)."""
+    local = jnp.full((3,), UNKNOWN_KEY, jnp.int32)
+    incoming = encode_key(
+        jnp.array([int(s) for s in STATUSES]), jnp.array([5, 5, 5])
+    )
+    best_alive = jnp.where(is_alive_key(incoming), incoming, UNKNOWN_KEY)
+    merged, changed = merge_views(local, incoming, best_alive)
+    # ALIVE introduced; SUSPECT and DEAD rumors about unknown members dropped.
+    np.testing.assert_array_equal(np.asarray(changed), [True, False, False])
+    assert int(decode_status(merged)[0]) == int(MemberStatus.ALIVE)
+    assert int(merged[1]) == UNKNOWN_KEY and int(merged[2]) == UNKNOWN_KEY
+
+
+def test_merge_epoch_rules():
+    alive, suspect, dead = (
+        int(MemberStatus.ALIVE),
+        int(MemberStatus.SUSPECT),
+        int(MemberStatus.DEAD),
+    )
+    # local: epoch-0 DEAD (sticky) | epoch-0 ALIVE | epoch-1 ALIVE inc=4
+    local = encode_key(
+        jnp.array([dead, alive, alive]),
+        jnp.array([3, 3, 4]),
+        jnp.array([0, 0, 1]),
+    )
+    # incoming: epoch-1 ALIVE (restart) | epoch-0 SUSPECT same inc | stale epoch-0
+    best_any = encode_key(
+        jnp.array([alive, suspect, suspect]),
+        jnp.array([0, 3, 9]),
+        jnp.array([1, 0, 0]),
+    )
+    best_alive = jnp.where(is_alive_key(best_any), best_any, UNKNOWN_KEY)
+    merged, changed = merge_views(local, best_any, best_alive)
+    # restart epoch supersedes sticky dead of the previous generation
+    assert int(decode_epoch(merged)[0]) == 1
+    assert int(decode_status(merged)[0]) == alive
+    # same-epoch SUSPECT overrides ALIVE at equal incarnation
+    assert int(decode_status(merged)[1]) == suspect
+    # stale lower-epoch rumor dropped
+    assert not bool(changed[2])
+
+
+def test_merge_dead_epoch_cannot_introduce():
+    """A newer-epoch SUSPECT/DEAD rumor must not introduce the identity."""
+    alive, dead = int(MemberStatus.ALIVE), int(MemberStatus.DEAD)
+    local = encode_key(jnp.array([alive]), jnp.array([7]), jnp.array([0]))
+    best_any = encode_key(jnp.array([dead]), jnp.array([0]), jnp.array([1]))
+    best_alive = jnp.full((1,), UNKNOWN_KEY, jnp.int32)
+    merged, changed = merge_views(local, best_any, best_alive)
+    assert not bool(changed[0])
+    assert int(decode_epoch(merged)[0]) == 0
+
+
+# -- delivery scatter ---------------------------------------------------------
+
+
+def test_deliver_rows_max_combines_and_drops():
+    rows = jnp.array(
+        [[5, -1], [3, 9], [-1, 7], [1, 1]], jnp.int32
+    )  # sender payloads
+    dst = jnp.array([[2, 3], [2, 0], [0, 1], [0, 0]], jnp.int32)
+    edge_ok = jnp.array(
+        [[True, True], [True, True], [True, False], [False, False]]
+    )
+    got = np.asarray(deliver_rows_max(rows, dst, edge_ok, 4))
+    # receiver 0: from sender1 (ack edge) and sender2 -> max([3,9],[-1,7])
+    np.testing.assert_array_equal(got[0], [3, 9])
+    # receiver 1: sender2's second edge is dropped
+    np.testing.assert_array_equal(got[1], [-1, -1])
+    # receiver 2: senders 0 and 1
+    np.testing.assert_array_equal(got[2], [5, 9])
+    # receiver 3: sender 0 only
+    np.testing.assert_array_equal(got[3], [5, -1])
+
+
+def test_deliver_rows_any():
+    flags = jnp.array([[True, False], [False, True]])
+    dst = jnp.array([[1], [0]], jnp.int32)
+    edge_ok = jnp.array([[True], [False]])
+    got = np.asarray(deliver_rows_any(flags, dst, edge_ok, 2))
+    np.testing.assert_array_equal(got, [[False, False], [True, False]])
+
+
+# -- selection ----------------------------------------------------------------
+
+
+def test_masked_topk_distinct_and_valid():
+    rng = jax.random.PRNGKey(0)
+    n = 16
+    mask = jnp.ones((8, n), bool).at[:, 0].set(False)
+    mask = mask & ~jnp.eye(8, n, dtype=bool)
+    idx, valid = masked_random_topk(rng, mask, 3)
+    assert bool(valid.all())
+    idx = np.asarray(idx)
+    for row, picks in enumerate(idx):
+        assert len(set(picks.tolist())) == 3  # distinct
+        assert 0 not in picks and row not in picks  # respects mask
+
+
+def test_masked_topk_undersized_candidate_set():
+    mask = jnp.zeros((2, 4), bool).at[0, 2].set(True)
+    _, valid = masked_random_topk(jax.random.PRNGKey(1), mask, 3)
+    assert int(valid[0].sum()) == 1 and int(valid[1].sum()) == 0
+
+
+def test_masked_choice_uniformity():
+    rng = jax.random.PRNGKey(42)
+    mask = jnp.ones((4000, 8), bool).at[:, 3].set(False)
+    idx, valid = masked_random_choice(rng, mask)
+    assert bool(valid.all())
+    counts = np.bincount(np.asarray(idx), minlength=8)
+    assert counts[3] == 0
+    # each of the 7 candidates ~ 4000/7 ≈ 571; loose 4-sigma band
+    assert counts[counts > 0].min() > 450 and counts.max() < 700
+
+
+@pytest.mark.parametrize("k", [1, 3])
+def test_topk_jit_compatible(k):
+    mask = jnp.ones((4, 6), bool)
+    f = jax.jit(lambda r, m: masked_random_topk(r, m, k))
+    idx, valid = f(jax.random.PRNGKey(0), mask)
+    assert idx.shape == (4, k)
