@@ -1,0 +1,17 @@
+"""Device-mesh sharding of the simulated member axis.
+
+The reference scales by adding JVMs — each process owns one node and NCCL-less
+TCP carries the messages (SURVEY.md §2.11). The TPU framework scales by
+sharding the member axis of the state pytree over a `jax.sharding.Mesh`:
+viewer-partitioned ``[N, N]`` matrices ride ICI collectives that XLA inserts
+around the delivery scatters — the DP/SP analog called out in SURVEY.md §2.10.
+"""
+
+from scalecube_cluster_tpu.parallel.mesh import (
+    make_mesh,
+    shard_plan,
+    shard_state,
+    state_shardings,
+)
+
+__all__ = ["make_mesh", "shard_plan", "shard_state", "state_shardings"]

@@ -1,0 +1,64 @@
+"""Mesh construction and sharding specs for SimState / FaultPlan.
+
+Layout: every per-member array shards its **viewer axis** (axis 0) across the
+``"members"`` mesh axis; subject axes stay replicated-size but local, so each
+device owns the full rows of its N/D viewers:
+
+- ``view / rumor_age / suspect_at / useen / uage``: ``P("members", None)``
+- ``inc_self / epoch / alive``: ``P("members")``
+- ``tick / rng``: replicated
+
+Delivery (ops/delivery.py) scatters rows by destination — a cross-shard
+permute XLA lowers to ICI all-to-alls; the SYNC reply gather
+(sim/tick.py ``view1[prt]``) is likewise a sharded gather. Nothing in the
+tick is host-side, so one jit of ``run_ticks`` with these shardings is the
+whole multi-chip story (multi-slice over DCN works the same way with a
+larger mesh).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.state import SimState
+
+AXIS = "members"
+
+
+def make_mesh(devices=None) -> Mesh:
+    """One-axis mesh over all (or the given) devices."""
+    devices = jax.devices() if devices is None else devices
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+def state_shardings(mesh: Mesh) -> SimState:
+    """A SimState-shaped pytree of NamedShardings (viewer axis sharded)."""
+    row = NamedSharding(mesh, P(AXIS, None))
+    vec = NamedSharding(mesh, P(AXIS))
+    rep = NamedSharding(mesh, P())
+    return SimState(
+        view=row,
+        rumor_age=row,
+        suspect_at=row,
+        inc_self=vec,
+        epoch=vec,
+        alive=vec,
+        useen=row,
+        uage=row,
+        tick=rep,
+        rng=rep,
+    )
+
+
+def shard_state(state: SimState, mesh: Mesh) -> SimState:
+    """Place a host-built SimState onto the mesh."""
+    return jax.device_put(state, state_shardings(mesh))
+
+
+def shard_plan(plan: FaultPlan, mesh: Mesh) -> FaultPlan:
+    """Fault matrices shard like the view: sender/viewer axis split."""
+    row = NamedSharding(mesh, P(AXIS, None))
+    return jax.device_put(plan, FaultPlan(block=row, loss=row))

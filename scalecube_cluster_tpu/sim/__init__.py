@@ -1,0 +1,38 @@
+"""TPU simulation backend: N SWIM nodes as one vmapped state machine.
+
+This is the `transport-jax` in-array backend of SURVEY.md §2.11: instead of N
+`ClusterImpl` event loops exchanging TCP frames (ClusterImpl.java:178,
+TransportImpl.java:263-297), the whole cluster is a pytree of arrays over the
+member axis, stepped by a pure ``sim_tick`` under `jax.lax.scan`, with message
+delivery as segment_max scatters (ops/delivery.py) and the SWIM merge rule as
+an integer lattice max (ops/merge.py). One tick = one gossip period; the
+ping/sync protocols fire on tick masks derived from the reference's interval
+ratios (FailureDetectorConfig.java:8-20, GossipConfig.java:8,
+MembershipConfig.java:13-24).
+"""
+
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.state import (
+    SimState,
+    init_full_view,
+    init_seeded,
+    inject_gossip,
+    kill,
+    restart,
+)
+from scalecube_cluster_tpu.sim.tick import sim_tick
+from scalecube_cluster_tpu.sim.run import run_ticks
+
+__all__ = [
+    "FaultPlan",
+    "SimParams",
+    "SimState",
+    "init_full_view",
+    "init_seeded",
+    "inject_gossip",
+    "kill",
+    "restart",
+    "sim_tick",
+    "run_ticks",
+]

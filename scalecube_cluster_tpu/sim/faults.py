@@ -1,0 +1,84 @@
+"""Network-fault plan for the sim backend — NetworkEmulator in array form.
+
+The host backend injects faults through a Transport decorator
+(testlib/network_emulator.py, mirroring NetworkEmulator.java:25-411); the sim
+expresses the same per-link settings as dense matrices consulted at every
+delivery edge:
+
+- ``block[i, j]``  — directional hard block of link i→j
+  (NetworkEmulator.blockOutbound/blockInbound, :87-138, 236-288)
+- ``loss[i, j]``   — probability a message on i→j is dropped
+  (OutboundSettings.evaluateLoss, :358-362)
+
+Delay emulation (exponential mean delay, :363-368) has no sub-tick meaning in
+a synchronous tick world; its observable effect at protocol granularity — a
+message missing its round's deadline — is expressible as extra loss, so the
+plan exposes loss/block only (deviation documented for the judge).
+
+A plan is *static data* passed alongside the state; scenario scripts build new
+plans between runs (partitions, asymmetric links) exactly like the reference
+tests flip emulator settings mid-test (MembershipProtocolTest.java:94-263).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_dataclass
+
+
+@register_dataclass
+@dataclass
+class FaultPlan:
+    """Per-directed-link fault settings over an N-member cluster."""
+
+    block: jax.Array  # [N, N] bool
+    loss: jax.Array  # [N, N] float32 in [0, 1)
+
+    def replace(self, **changes) -> "FaultPlan":
+        return dataclasses.replace(self, **changes)
+
+    @classmethod
+    def clean(cls, n: int) -> "FaultPlan":
+        """No faults (the emulator's initial state)."""
+        return cls(
+            block=jnp.zeros((n, n), bool),
+            loss=jnp.zeros((n, n), jnp.float32),
+        )
+
+    def with_loss(self, percent: float) -> "FaultPlan":
+        """Uniform loss on every link (setDefaultOutboundSettings, :189-199)."""
+        return self.replace(loss=jnp.full_like(self.loss, percent / 100.0))
+
+    def block_outbound(self, src, dst) -> "FaultPlan":
+        """Block link(s) src→dst (blockOutbound, NetworkEmulator.java:87-110)."""
+        return self.replace(block=self.block.at[src, dst].set(True))
+
+    def partition(self, group_a, group_b) -> "FaultPlan":
+        """Symmetric partition between two member groups (the reference's
+        block-both-directions pattern, MembershipProtocolTest.java:94-180)."""
+        a = jnp.asarray(group_a, jnp.int32)
+        b = jnp.asarray(group_b, jnp.int32)
+        block = self.block.at[a[:, None], b[None, :]].set(True)
+        block = block.at[b[:, None], a[None, :]].set(True)
+        return self.replace(block=block)
+
+
+def edge_pass(rng: jax.Array, plan: FaultPlan, dst: jax.Array) -> jax.Array:
+    """Sample per-edge delivery success for sender-row fan-out edges.
+
+    Args:
+      rng: PRNG key.
+      plan: fault plan.
+      dst: ``[N, k]`` int32 — edge c of sender i targets ``dst[i, c]``.
+
+    Returns:
+      ``[N, k]`` bool — True where the link is unblocked and survives loss.
+    """
+    blocked = jnp.take_along_axis(plan.block, dst, axis=1)
+    loss = jnp.take_along_axis(plan.loss, dst, axis=1)
+    u = jax.random.uniform(rng, dst.shape)
+    return ~blocked & (u >= loss)

@@ -1,0 +1,74 @@
+"""Static (trace-time) parameters of the simulated cluster.
+
+Derived from ClusterConfig's millisecond intervals by normalizing to the
+**gossip interval as the tick unit** — the smallest period in every reference
+preset (GossipConfig.java:8: 200 ms LAN vs ping 1000 ms, sync 30 s). All
+fields are Python ints so the dataclass is hashable and can be a static jit
+argument; shapes in the sim depend only on ``n``, ``gossip_fanout``,
+``ping_req_members`` and ``user_gossip_slots``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from scalecube_cluster_tpu import cluster_math
+from scalecube_cluster_tpu.cluster_api.config import ClusterConfig
+
+
+@dataclass(frozen=True)
+class SimParams:
+    """Protocol constants for an ``n``-member simulated cluster."""
+
+    n: int
+    #: Gossip fan-out per tick (GossipConfig.java:10 — 3 LAN / 4 WAN).
+    gossip_fanout: int = 3
+    #: Ticks a rumor keeps spreading: repeatMult*ceil(log2(n+1))
+    #: (ClusterMath.java:111-113).
+    periods_to_spread: int = 18
+    #: Ticks until a swept gossip id may be garbage-collected:
+    #: 2*(spread+1) (ClusterMath.java:99-102).
+    periods_to_sweep: int = 38
+    #: Failure-detector period in ticks (pingInterval / gossipInterval).
+    fd_period_ticks: int = 5
+    #: Anti-entropy SYNC period in ticks (syncInterval / gossipInterval).
+    sync_period_ticks: int = 150
+    #: Ticks from SUSPECT to DEAD: suspicionMult*ceil(log2(n+1))*pingInterval
+    #: in tick units (ClusterMath.java:123-125).
+    suspicion_ticks: int = 150
+    #: Indirect-probe relay count (FailureDetectorConfig.java:10).
+    ping_req_members: int = 3
+    #: Number of user-gossip payload slots tracked by the sim.
+    user_gossip_slots: int = 4
+
+    @classmethod
+    def from_cluster_config(
+        cls,
+        n: int,
+        config: ClusterConfig | None = None,
+        user_gossip_slots: int = 4,
+    ) -> "SimParams":
+        """Normalize a ClusterConfig's millisecond intervals into tick units."""
+        config = config or ClusterConfig.default_lan()
+        fd = config.failure_detector_config
+        gs = config.gossip_config
+        ms = config.membership_config
+        tick_ms = gs.gossip_interval
+        spread = cluster_math.gossip_periods_to_spread(gs.gossip_repeat_mult, n)
+        return cls(
+            n=n,
+            gossip_fanout=gs.gossip_fanout,
+            periods_to_spread=spread,
+            periods_to_sweep=cluster_math.gossip_periods_to_sweep(
+                gs.gossip_repeat_mult, n
+            ),
+            fd_period_ticks=max(1, fd.ping_interval // tick_ms),
+            sync_period_ticks=max(1, ms.sync_interval // tick_ms),
+            suspicion_ticks=max(
+                1,
+                cluster_math.suspicion_timeout(ms.suspicion_mult, n, fd.ping_interval)
+                // tick_ms,
+            ),
+            ping_req_members=fd.ping_req_members,
+            user_gossip_slots=user_gossip_slots,
+        )

@@ -1,0 +1,66 @@
+"""Scan-driven simulation runs with per-tick metric traces.
+
+The host backend advances wall-clock timers on an asyncio loop; here the whole
+experiment is one `jax.lax.scan` over ticks — the reference's per-interval
+scheduler tasks (FailureDetectorImpl.java:102-106, GossipProtocolImpl.java:106-111,
+MembershipProtocolImpl.java:450-455) become tick masks inside sim_tick. The
+returned metrics arrays are the array-native replacement for the reference's
+per-period log lines and the gossip experiment statistics that
+GossipProtocolTest.java:176-203 prints (convergence %, message counts).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+from jax import lax
+
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.state import SimState
+from scalecube_cluster_tpu.sim.tick import sim_tick
+
+
+@partial(jax.jit, static_argnums=(0, 4))
+def run_ticks(
+    params: SimParams,
+    state: SimState,
+    plan: FaultPlan,
+    seeds: jax.Array,
+    n_ticks: int,
+):
+    """Run ``n_ticks`` gossip periods. Returns ``(final_state, metric_traces)``
+    where each trace has leading axis ``n_ticks``."""
+
+    def step(carry: SimState, _):
+        new_state, metrics = sim_tick(params, carry, plan, seeds)
+        return new_state, metrics
+
+    return lax.scan(step, state, None, length=n_ticks)
+
+
+def run_until(
+    params: SimParams,
+    state: SimState,
+    plan: FaultPlan,
+    seeds: jax.Array,
+    predicate,
+    max_ticks: int,
+    chunk: int = 16,
+):
+    """Host-driven run in jitted chunks until ``predicate(metrics) -> bool``
+    holds (metrics = the last tick's scalars) or ``max_ticks`` elapse.
+
+    The experiment-harness analog of the reference tests' awaitUntil polling
+    (MembershipProtocolTest.java:1002-1005), with virtual time instead of
+    wall-clock sleeps. Returns ``(state, ticks_run, satisfied)``.
+    """
+    ticks = 0
+    while ticks < max_ticks:
+        state, traces = run_ticks(params, state, plan, seeds, chunk)
+        ticks += chunk
+        last = {k: v[-1] for k, v in traces.items()}
+        if predicate(last):
+            return state, ticks, True
+    return state, ticks, False

@@ -1,0 +1,179 @@
+"""The cluster-as-arrays state pytree and its host-side mutation helpers.
+
+One ``SimState`` holds the complete soft state of N SWIM nodes — the arrays
+play the roles of the reference's per-node objects:
+
+- ``view[i, j]``       — node i's MembershipRecord about j as a priority key
+                         (membershipTable, MembershipProtocolImpl.java:87-88)
+- ``rumor_age[i, j]``  — gossip periods since i's record about j last changed;
+                         records younger than periods_to_spread are included in
+                         i's gossip messages (GossipState.java:8-50 +
+                         spreadMembershipGossip, MembershipProtocolImpl.java:649-656)
+- ``suspect_at[i, j]`` — tick at which i started suspecting j (the suspicion
+                         timeout task, MembershipProtocolImpl.java:620-635)
+- ``inc_self[j]``      — j's own incarnation counter (refutation,
+                         MembershipProtocolImpl.java:549-569)
+- ``epoch[j]``         — restart generation of slot j; stands in for the fresh
+                         random Member id a restarted process mints
+                         (Member.java:25-27, ops/merge.py epoch rationale)
+- ``alive[j]``         — ground truth: process j is up (host fault control)
+- ``useen/uage[j, g]`` — user-gossip dissemination state per payload slot g
+                         (GossipProtocolImpl gossips map, :163-169)
+
+Host-side helpers (`kill`/`restart`/`inject_gossip`) are the NetworkEmulator-
+style control plane for churn scenarios; they run between jitted tick runs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_dataclass
+
+from scalecube_cluster_tpu.ops import merge as merge_ops
+
+#: "No suspicion pending" sentinel for ``suspect_at`` (far future).
+NO_SUSPECT = jnp.iinfo(jnp.int32).max // 2
+
+
+@register_dataclass
+@dataclass
+class SimState:
+    """Complete state of an N-member simulated cluster (arrays over members)."""
+
+    view: jax.Array  # [N, N] int32 priority keys
+    rumor_age: jax.Array  # [N, N] int32
+    suspect_at: jax.Array  # [N, N] int32
+    inc_self: jax.Array  # [N] int32
+    epoch: jax.Array  # [N] int32
+    alive: jax.Array  # [N] bool
+    useen: jax.Array  # [N, G] bool
+    uage: jax.Array  # [N, G] int32
+    tick: jax.Array  # [] int32
+    rng: jax.Array  # PRNG key
+
+    def replace(self, **changes) -> "SimState":
+        return dataclasses.replace(self, **changes)
+
+
+def _blank(n: int, slots: int, seed: int) -> SimState:
+    return SimState(
+        view=jnp.full((n, n), merge_ops.UNKNOWN_KEY, jnp.int32),
+        rumor_age=jnp.full((n, n), 1 << 20, jnp.int32),
+        suspect_at=jnp.full((n, n), NO_SUSPECT, jnp.int32),
+        inc_self=jnp.zeros((n,), jnp.int32),
+        epoch=jnp.zeros((n,), jnp.int32),
+        alive=jnp.ones((n,), bool),
+        useen=jnp.zeros((n, slots), bool),
+        uage=jnp.zeros((n, slots), jnp.int32),
+        tick=jnp.zeros((), jnp.int32),
+        rng=jax.random.PRNGKey(seed),
+    )
+
+
+def init_full_view(n: int, user_gossip_slots: int = 4, seed: int = 0) -> SimState:
+    """Post-join steady state: everyone knows everyone ALIVE at incarnation 0.
+
+    The standard starting point for convergence / failure studies (the state
+    the reference reaches after ClusterTest.java:88-114's join phase).
+    """
+    state = _blank(n, user_gossip_slots, seed)
+    alive_keys = merge_ops.encode_key(
+        jnp.zeros((n, n), jnp.int32), jnp.zeros((n, n), jnp.int32)
+    )
+    return state.replace(view=alive_keys)
+
+
+def init_seeded(
+    n: int, seeds: jax.Array | list[int], user_gossip_slots: int = 4, seed: int = 0
+) -> SimState:
+    """Cold join: node i knows only itself; seed addresses are config-known.
+
+    Mirrors start0's initial state (MembershipProtocolImpl.java:222-257): the
+    membership table starts with the local record only, and the configured
+    seeds are *addresses*, not table entries — the SYNC phase (sim/tick.py)
+    always treats the seed mask as eligible partners, which reproduces the
+    initial-sync join flow tick by tick.
+    """
+    state = _blank(n, user_gossip_slots, seed)
+    diag = jnp.eye(n, dtype=bool)
+    self_key = merge_ops.encode_key(jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+    view = jnp.where(diag, self_key, merge_ops.UNKNOWN_KEY)
+    # Own record starts fresh so the join SYNC spreads it immediately.
+    return state.replace(view=view, rumor_age=jnp.where(diag, 0, state.rumor_age))
+
+
+def seeds_mask(n: int, seeds: list[int]) -> jax.Array:
+    """Bool [N] mask of seed member slots (MembershipConfig.seed_members)."""
+    return jnp.zeros((n,), bool).at[jnp.asarray(seeds, jnp.int32)].set(True)
+
+
+def kill(state: SimState, idx) -> SimState:
+    """Hard-stop process ``idx`` (no leave gossip — the crash scenario of
+    MembershipProtocolTest's partition/stop cases)."""
+    return state.replace(alive=state.alive.at[idx].set(False))
+
+
+def leave(state: SimState, idx) -> SimState:
+    """Graceful shutdown, phase 1: announce self-DEAD at inc+1
+    (leaveCluster, MembershipProtocolImpl.java:203-212).
+
+    The process stays up so the leave gossip rides the normal dissemination
+    path for a tick or two — mirroring the reference, where the gossip is
+    enqueued before the transport stops (ClusterImpl.java:376-390). The tick
+    engine recognises a DEAD own-diagonal as "voluntarily left" and suppresses
+    self-refutation for it. Call :func:`kill` a few ticks later for phase 2.
+    """
+    idx = jnp.asarray(idx)
+    inc = state.inc_self[idx] + 1
+    dead_key = merge_ops.encode_key(
+        jnp.full_like(inc, 2), inc, state.epoch[idx]
+    )  # MemberStatus.DEAD == 2
+    return state.replace(
+        inc_self=state.inc_self.at[idx].set(inc),
+        view=state.view.at[idx, idx].set(dead_key),
+        rumor_age=state.rumor_age.at[idx, idx].set(0),
+    )
+
+
+def restart(state: SimState, idx) -> SimState:
+    """Restart process ``idx`` as a brand-new identity in the same slot.
+
+    The reference models this as a fresh Member id at the same address
+    (MembershipProtocolTest.java:454-520); the sim bumps the slot epoch, which
+    the merge lattice treats exactly like a previously-unknown member
+    (ops/merge.py). The node rejoins via the seed-SYNC path.
+    """
+    n = state.view.shape[0]
+    if int(state.epoch[idx]) >= merge_ops.EPOCH_MAX:
+        # encode_key would clip the epoch back to the previous generation's
+        # value and the restarted identity could never be introduced again.
+        raise ValueError(
+            f"slot {idx} exhausted its {merge_ops.EPOCH_MAX} restart epochs"
+        )
+    new_epoch = state.epoch[idx] + 1
+    self_key = merge_ops.encode_key(
+        jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32), new_epoch
+    )
+    row = jnp.full((n,), merge_ops.UNKNOWN_KEY, jnp.int32).at[idx].set(self_key)
+    return state.replace(
+        alive=state.alive.at[idx].set(True),
+        epoch=state.epoch.at[idx].set(new_epoch),
+        inc_self=state.inc_self.at[idx].set(0),
+        view=state.view.at[idx, :].set(row),
+        rumor_age=state.rumor_age.at[idx, :].set(1 << 20).at[idx, idx].set(0),
+        suspect_at=state.suspect_at.at[idx, :].set(NO_SUSPECT),
+        useen=state.useen.at[idx, :].set(False),
+    )
+
+
+def inject_gossip(state: SimState, node_idx: int, slot: int) -> SimState:
+    """`cluster.spreadGossip` equivalent: enqueue user payload ``slot`` at
+    ``node_idx`` (GossipProtocolImpl.spread, :124-128, 163-169)."""
+    return state.replace(
+        useen=state.useen.at[node_idx, slot].set(True),
+        uage=state.uage.at[node_idx, slot].set(0),
+    )

@@ -1,0 +1,315 @@
+"""``sim_tick`` — one gossip period of the whole N-member cluster, pure.
+
+This function is the TPU rewrite of the three hot loops of SURVEY.md §3 —
+failure-detector round (FailureDetectorImpl.doPing, :126-170), gossip spread
+(GossipProtocolImpl.doSpreadGossip, :139-157) and SYNC anti-entropy
+(MembershipProtocolImpl.doSync, :304-320) — collapsed into one batched,
+branchless step suitable for `jax.lax.scan` + `jit` + sharding:
+
+  1. FD probe: every node picks one target (shuffled-round-robin becomes
+     Gumbel sampling, ops/select.py), direct ping with loss/block-sampled
+     round trip, indirect ping-req via k relays on direct failure
+     (FailureDetectorImpl.java:160-208), DEST_GONE on epoch mismatch
+     (PingData.java:8-23) → SUSPECT / DEAD record updates.
+  2. Suspicion sweep: SUSPECT older than the suspicion timeout becomes DEAD
+     (MembershipProtocolImpl.onSuspicionTimeout, :637-647).
+  3. Gossip + SYNC delivery: per-node fan-out of membership rumors younger
+     than periodsToSpread (selectGossipsToSend, GossipProtocolImpl.java:242-251)
+     plus, on sync ticks, full-table exchange with one partner both ways
+     (onSync/onSyncAck, MembershipProtocolImpl.java:343-373); all edges are
+     folded with segment_max and merged through the priority-key lattice
+     (ops/merge.py = updateMembership/isOverrides).
+  4. Self-refutation: a node seeing a SUSPECT/DEAD rumor about its own current
+     epoch at inc >= its own bumps incarnation and re-announces ALIVE
+     (onSelfMemberDetected, MembershipProtocolImpl.java:549-569), unless it
+     voluntarily left (DEAD own-diagonal, sim/state.py::leave).
+  5. User-gossip dissemination with exactly-once first-seen accounting
+     (onGossipReq dedup, GossipProtocolImpl.java:171-183).
+
+Documented deviations from the reference (protocol-equivalent at period
+granularity; the convergence tests are the oracle):
+
+- A whole ping→timeout→ping-req round resolves within its FD tick (the
+  reference bounds it by pingInterval the same way); sub-tick timings vanish.
+- FD ALIVE results do not trigger the direct-SYNC nudge of
+  MembershipProtocolImpl.java:385-397; refutation rides the gossiped SUSPECT
+  rumor reaching the target instead — same outcome, ≤ spread-latency later.
+- A node whose table knows nobody else retries its join SYNC every tick,
+  approximating the one-shot initial sync to all seeds (start0, :222-257).
+- SYNC_ACK replies carry the partner's pre-merge table (one tick staler than
+  the reference's merged reply).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu.cluster_api.member import MemberStatus
+from scalecube_cluster_tpu.ops.delivery import deliver_rows_any, deliver_rows_max
+from scalecube_cluster_tpu.ops.merge import (
+    DEAD_BIT,
+    UNKNOWN_KEY,
+    decode_epoch,
+    decode_incarnation,
+    decode_status,
+    encode_key,
+    is_alive_key,
+    merge_views,
+    overrides_same_epoch,
+)
+from scalecube_cluster_tpu.ops.select import masked_random_choice, masked_random_topk
+from scalecube_cluster_tpu.sim.faults import FaultPlan, edge_pass
+from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.state import NO_SUSPECT, SimState
+
+_ALIVE = int(MemberStatus.ALIVE)
+_SUSPECT = int(MemberStatus.SUSPECT)
+_DEAD = int(MemberStatus.DEAD)
+_AGE_CAP = 1 << 20
+
+
+def _reverse_edge_pass(rng, plan: FaultPlan, src, i):
+    """Delivery success for edges src[...]→i (the ack / reply direction)."""
+    blocked = plan.block[src, i]
+    loss = plan.loss[src, i]
+    u = jax.random.uniform(rng, jnp.shape(src))
+    return ~blocked & (u >= loss)
+
+
+@partial(jax.jit, static_argnums=0)
+def sim_tick(params: SimParams, state: SimState, plan: FaultPlan, seeds: jax.Array):
+    """Advance the cluster one gossip period. Returns ``(new_state, metrics)``.
+
+    Args:
+      params: static protocol constants.
+      state: current :class:`SimState`.
+      plan: :class:`FaultPlan` for this tick.
+      seeds: ``[N]`` bool — seed slots, always eligible SYNC partners
+        (selectSyncAddress draws from seeds ∪ members, :416-427).
+    """
+    n = params.n
+    t = state.tick + 1
+    keys = jax.random.split(state.rng, 10)
+    (rng_next, k_tgt, k_ping, k_ack, k_relay, k_rlink,
+     k_gsel, k_glink, k_ssel, k_slink) = keys
+
+    view0 = state.view
+    status0 = decode_status(view0)
+    known0 = view0 >= 0
+    alive = state.alive
+    col = jnp.arange(n, dtype=jnp.int32)
+    diag = jnp.eye(n, dtype=bool)
+    i_idx = col  # row index == receiver identity for reverse links
+
+    do_fd = (t % params.fd_period_ticks) == 0
+    do_sync_tick = (t % params.sync_period_ticks) == 0
+
+    # Live-member candidate sets: known, not seen DEAD, not self — the member
+    # lists FD/gossip draw from (FailureDetectorImpl.java:323-333,
+    # GossipProtocolImpl.java:185-197 maintain them off membership events).
+    cand = known0 & (status0 != _DEAD) & ~diag
+
+    # ------------------------------------------------------------------ 1. FD
+    tgt, tgt_valid = masked_random_choice(k_tgt, cand)
+    vkey = jnp.take_along_axis(view0, tgt[:, None], axis=1)[:, 0]
+    v_inc = decode_incarnation(vkey)
+    v_epoch = decode_epoch(vkey)
+
+    probing = do_fd & alive & tgt_valid
+    fwd_ok = edge_pass(k_ping, plan, tgt[:, None])[:, 0]
+    ack_ok = _reverse_edge_pass(k_ack, plan, tgt, i_idx)
+    direct_reach = probing & alive[tgt] & fwd_ok & ack_ok
+
+    # Indirect probe via k relays: origin→relay→target→relay→origin, all four
+    # legs sampled (onPingReq transit + onTransitPingAck forwarding,
+    # FailureDetectorImpl.java:255-305).
+    relay_cand = cand & (col[None, :] != tgt[:, None])
+    ridx, rvalid = masked_random_topk(k_relay, relay_cand, params.ping_req_members)
+    rk1, rk2, rk3, rk4 = jax.random.split(k_rlink, 4)
+    leg_or = edge_pass(rk1, plan, ridx)  # origin→relay
+    u = jax.random.uniform(rk2, ridx.shape)
+    leg_rt = ~plan.block[ridx, tgt[:, None]] & (u >= plan.loss[ridx, tgt[:, None]])
+    u = jax.random.uniform(rk3, ridx.shape)
+    leg_tr = ~plan.block[tgt[:, None], ridx] & (u >= plan.loss[tgt[:, None], ridx])
+    u = jax.random.uniform(rk4, ridx.shape)
+    leg_ro = ~plan.block[ridx, i_idx[:, None]] & (u >= plan.loss[ridx, i_idx[:, None]])
+    relay_reach = (
+        rvalid & alive[ridx] & alive[tgt][:, None] & leg_or & leg_rt & leg_tr & leg_ro
+    )
+    indirect_reach = probing & jnp.any(relay_reach, axis=1)
+
+    reached = direct_reach | indirect_reach
+    # Ack carries the responder's identity: epoch ahead of the viewed record
+    # means the old process is gone (AckType.DEST_GONE, PingData.java:8-23).
+    gone = reached & (state.epoch[tgt] != v_epoch)
+
+    fd_suspect = probing & ~reached
+    fd_dead = gone
+    fd_fire = fd_suspect | fd_dead
+    fd_status = jnp.where(fd_dead, _DEAD, _SUSPECT)
+    fd_key = encode_key(fd_status, v_inc, v_epoch)
+
+    onehot_tgt = col[None, :] == tgt[:, None]
+    fd_mat = jnp.where(
+        onehot_tgt & fd_fire[:, None], fd_key[:, None], UNKNOWN_KEY
+    )
+    # Same-epoch candidate by construction: plain lattice accept. SUSPECT at
+    # the viewed incarnation outranks ALIVE (rank bit); DEAD outranks both;
+    # an existing DEAD record stays sticky.
+    fd_accept = (fd_mat >= 0) & known0 & overrides_same_epoch(fd_mat, view0)
+    view1 = jnp.where(fd_accept, fd_mat, view0)
+    changed = fd_accept
+
+    # ------------------------------------------------ 2. suspicion timeout
+    expired = (
+        alive[:, None]
+        & (decode_status(view1) == _SUSPECT)
+        & ((t - state.suspect_at) >= params.suspicion_ticks)
+    )
+    dead_keys = encode_key(
+        jnp.full((n, n), _DEAD, jnp.int32),
+        decode_incarnation(view1),
+        decode_epoch(view1),
+    )
+    view1 = jnp.where(expired, dead_keys, view1)
+    changed = changed | expired
+
+    # ------------------------------------------- 3. gossip + sync delivery
+    status1 = decode_status(view1)
+    g_cand = (view1 >= 0) & (status1 != _DEAD) & ~diag
+    dst, dvalid = masked_random_topk(k_gsel, g_cand, params.gossip_fanout)
+    edge_ok = (
+        dvalid
+        & alive[:, None]
+        & alive[dst]
+        & edge_pass(k_glink, plan, dst)
+    )
+
+    age0 = jnp.where(changed, 0, state.rumor_age)
+    rows = jnp.where(age0 < params.periods_to_spread, view1, UNKNOWN_KEY)
+    best_any = deliver_rows_max(rows, dst, edge_ok, n)
+    alive_rows = jnp.where(is_alive_key(rows), rows, UNKNOWN_KEY)
+    best_alive = deliver_rows_max(alive_rows, dst, edge_ok, n)
+
+    # SYNC: full-table exchange with one partner from seeds ∪ members. Nodes
+    # that know nobody (fresh joiners/restarts) retry every tick — the
+    # initial-sync path (start0, MembershipProtocolImpl.java:222-257).
+    joining = jnp.sum(g_cand, axis=1) == 0
+    do_sync = (do_sync_tick | joining) & alive
+    s_cand = (g_cand | seeds[None, :]) & ~diag
+    prt, p_valid = masked_random_choice(k_ssel, s_cand)
+    sk1, sk2 = jax.random.split(k_slink)
+    s_fwd = (
+        do_sync & p_valid & alive[prt]
+        & edge_pass(sk1, plan, prt[:, None])[:, 0]
+    )
+    s_rev = s_fwd & _reverse_edge_pass(sk2, plan, prt, i_idx)
+
+    full_alive_rows = jnp.where(is_alive_key(view1), view1, UNKNOWN_KEY)
+    best_any = jnp.maximum(
+        best_any, deliver_rows_max(view1, prt[:, None], s_fwd[:, None], n)
+    )
+    best_alive = jnp.maximum(
+        best_alive, deliver_rows_max(full_alive_rows, prt[:, None], s_fwd[:, None], n)
+    )
+    reply = view1[prt, :]  # SYNC_ACK: partner's full table back to the caller
+    best_any = jnp.maximum(best_any, jnp.where(s_rev[:, None], reply, UNKNOWN_KEY))
+    best_alive = jnp.maximum(
+        best_alive,
+        jnp.where(s_rev[:, None] & is_alive_key(reply), reply, UNKNOWN_KEY),
+    )
+
+    # Merge everything delivered off-diagonal through the lattice.
+    best_any_nd = jnp.where(diag, UNKNOWN_KEY, best_any)
+    best_alive_nd = jnp.where(diag, UNKNOWN_KEY, best_alive)
+    merged, mchanged = merge_views(view1, best_any_nd, best_alive_nd)
+    merged = jnp.where(alive[:, None], merged, view1)
+    mchanged = mchanged & alive[:, None]
+    changed = changed | mchanged
+
+    # --------------------------------------------------- 4. self-refutation
+    self_rumor = jnp.diagonal(best_any)  # strongest rumor about me this tick
+    own_key = jnp.diagonal(view1)
+    left = (own_key & DEAD_BIT) != 0
+    r_status = decode_status(self_rumor)
+    threat = (
+        alive
+        & ~left
+        & (self_rumor >= 0)
+        & (decode_epoch(self_rumor) == state.epoch)
+        & ((r_status == _SUSPECT) | (r_status == _DEAD))
+        & (decode_incarnation(self_rumor) >= state.inc_self)
+    )
+    inc_self = jnp.where(threat, decode_incarnation(self_rumor) + 1, state.inc_self)
+    own_new = encode_key(jnp.full((n,), _ALIVE, jnp.int32), inc_self, state.epoch)
+    view2 = jnp.where(diag & threat[:, None], own_new[:, None], merged)
+    changed = changed | (diag & threat[:, None])
+
+    rumor_age = jnp.where(changed, 0, jnp.minimum(state.rumor_age + 1, _AGE_CAP))
+
+    # Tombstone expiry: the reference REMOVES an accepted DEAD record from the
+    # table right away (onDeadMemberDetected, MembershipProtocolImpl.java:571-587)
+    # while the rumor keeps circulating until swept. The dense view keeps the
+    # DEAD key as the circulating tombstone and demotes it to UNKNOWN once it
+    # stops spreading (age > periodsToSweep, ClusterMath.java:99-102) — after
+    # which a refuted/restarted member's ALIVE record can re-introduce it via
+    # the best_alive channel, exactly like the reference's r0 == null accept.
+    tomb_expired = (
+        ~diag
+        & ((view2 & DEAD_BIT) != 0)
+        & (view2 >= 0)
+        & (rumor_age > params.periods_to_sweep)
+        & alive[:, None]
+    )
+    view2 = jnp.where(tomb_expired, UNKNOWN_KEY, view2)
+
+    status2 = decode_status(view2)
+    is_susp = status2 == _SUSPECT
+    was_susp = status0 == _SUSPECT
+    suspect_at = jnp.where(
+        is_susp & ~was_susp, t, jnp.where(is_susp, state.suspect_at, NO_SUSPECT)
+    )
+    suspect_at = jnp.where(alive[:, None], suspect_at, state.suspect_at)
+
+    # ----------------------------------------------------- 5. user gossip
+    urows = state.useen & (state.uage < params.periods_to_spread)
+    got = deliver_rows_any(urows, dst, edge_ok, n)
+    new_seen = state.useen | (got & alive[:, None])
+    first_seen = new_seen & ~state.useen
+    uage = jnp.where(first_seen, 0, jnp.minimum(state.uage + 1, _AGE_CAP))
+
+    # ------------------------------------------------------------- metrics
+    n_alive = jnp.sum(alive)
+    truth_alive = alive[None, :] & (decode_epoch(view2) == state.epoch[None, :])
+    ok_alive = truth_alive & (status2 == _ALIVE)
+    ok_dead = ~alive[None, :] & ((status2 == _DEAD) | (view2 < 0))
+    match = jnp.where(alive[None, :], ok_alive, ok_dead) | diag
+    viewer_conv = jnp.mean(match, axis=1)
+    convergence = jnp.sum(viewer_conv * alive) / jnp.maximum(n_alive, 1)
+    metrics = {
+        "tick": t,
+        "convergence": convergence,
+        "n_alive": n_alive,
+        "n_suspected": jnp.sum(is_susp & alive[:, None]),
+        "msgs_gossip": jnp.sum(edge_ok),
+        "msgs_fd": jnp.sum(probing)
+        + jnp.sum((probing & ~direct_reach)[:, None] & rvalid),
+        "msgs_sync": jnp.sum(s_fwd) + jnp.sum(s_rev),
+        "gossip_coverage": jnp.sum(new_seen & alive[:, None], axis=0)
+        / jnp.maximum(n_alive, 1),
+    }
+
+    new_state = state.replace(
+        view=view2,
+        rumor_age=rumor_age,
+        suspect_at=suspect_at,
+        inc_self=inc_self,
+        useen=new_seen,
+        uage=uage,
+        tick=t,
+        rng=rng_next,
+    )
+    return new_state, metrics

@@ -15,6 +15,13 @@ if "xla_force_host_platform_device_count" not in xla_flags:
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The env vars alone are not enough when a TPU PJRT plugin (e.g. the axon
+# tunnel) is installed and overrides platform selection — pin it via config.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 

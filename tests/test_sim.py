@@ -1,0 +1,243 @@
+"""Scenario tests for the TPU sim backend (sim/ + ops/ + parallel/).
+
+These mirror the reference's distributed suites on the array engine with
+virtual time — each reference wall-clock scenario becomes a tick-indexed
+assertion (SURVEY.md §4 "weakness to inherit-and-fix"):
+
+- MembershipProtocolTest.java:69-91    -> test_cold_join_converges
+- MembershipProtocolTest.java:321-371  -> test_kill_suspect_then_dead
+- FailureDetectorTest.java:117-146     -> test_lossy_network_no_false_deaths
+- MembershipProtocolTest.java:94-263   -> test_partition_and_heal
+- MembershipProtocolTest.java:454-520  -> test_restart_new_epoch
+- ClusterTest.java:358-399             -> test_graceful_leave
+- GossipProtocolTest.java:154-173      -> test_user_gossip_dissemination
+- threading model (§1)                 -> test_determinism, test_sharded_equals_single
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from scalecube_cluster_tpu.ops.merge import decode_epoch, decode_status
+from scalecube_cluster_tpu.parallel import make_mesh, shard_plan, shard_state
+from scalecube_cluster_tpu.sim import (
+    FaultPlan,
+    SimParams,
+    init_full_view,
+    init_seeded,
+    inject_gossip,
+    kill,
+    restart,
+    run_ticks,
+)
+from scalecube_cluster_tpu.sim.state import leave, seeds_mask
+
+ALIVE, SUSPECT, DEAD = 0, 1, 2
+
+
+def small_params(n, **kw):
+    """Fast test constants: short sync period so join/heal paths are quick."""
+    base = dict(
+        n=n,
+        gossip_fanout=3,
+        periods_to_spread=8,
+        periods_to_sweep=18,
+        fd_period_ticks=2,
+        sync_period_ticks=10,
+        suspicion_ticks=30,
+        ping_req_members=2,
+        user_gossip_slots=2,
+    )
+    base.update(kw)
+    return SimParams(**base)
+
+
+def statuses(state):
+    return decode_status(state.view)
+
+
+def test_cold_join_converges():
+    n = 16
+    p = small_params(n)
+    st = init_seeded(n, [0], user_gossip_slots=2)
+    st, tr = run_ticks(p, st, FaultPlan.clean(n), seeds_mask(n, [0]), 60)
+    assert float(tr["convergence"][-1]) == 1.0
+    # Everyone sees everyone ALIVE at epoch 0.
+    assert bool(jnp.all(statuses(st) == ALIVE))
+
+
+def test_kill_suspect_then_dead():
+    n = 12
+    p = small_params(n)
+    st = init_full_view(n, user_gossip_slots=2)
+    st = kill(st, 5)
+    plan, sm = FaultPlan.clean(n), seeds_mask(n, [0])
+
+    # Within a few FD periods every live node suspects 5 (direct probes fail,
+    # relays can't reach a dead process either) — but nobody is dead yet.
+    st, _ = run_ticks(p, st, plan, sm, p.fd_period_ticks * 4 + p.periods_to_spread)
+    live = st.alive
+    col5 = statuses(st)[:, 5]
+    assert bool(jnp.all(jnp.where(live, col5 == SUSPECT, True)))
+
+    # After the suspicion timeout, DEAD (then tombstone-expired to UNKNOWN).
+    st, tr = run_ticks(p, st, plan, sm, p.suspicion_ticks + 10)
+    col5 = statuses(st)[:, 5]
+    assert bool(jnp.all(jnp.where(live, (col5 == DEAD) | (col5 == 3), True)))
+    assert float(tr["convergence"][-1]) == 1.0
+
+
+def test_lossy_network_no_false_deaths():
+    n = 32
+    p = small_params(n, suspicion_ticks=40, ping_req_members=3)
+    st = init_full_view(n, user_gossip_slots=2)
+    plan = FaultPlan.clean(n).with_loss(20.0)
+    st, tr = run_ticks(p, st, plan, seeds_mask(n, [0]), 250)
+    s = statuses(st)
+    false_dead = jnp.sum((s == DEAD) & st.alive[None, :])
+    assert int(false_dead) == 0
+    # Refutation must have fired under this much loss.
+    assert int(st.inc_self.max()) > 0
+    assert float(tr["convergence"][-1]) > 0.85
+
+
+def test_partition_and_heal():
+    n = 10
+    p = small_params(n)
+    st = init_full_view(n, user_gossip_slots=2)
+    sm = seeds_mask(n, [0])
+    side_a, side_b = list(range(5)), list(range(5, 10))
+    cut = FaultPlan.clean(n).partition(side_a, side_b)
+
+    # Partition long enough for suspicion timeouts: each side declares the
+    # other DEAD (suspicion-timeout removal, MembershipProtocolTest.java:321-371).
+    st, _ = run_ticks(p, st, cut, sm, p.suspicion_ticks + p.fd_period_ticks * 6 + 20)
+    s = statuses(st)
+    cross = s[jnp.asarray(side_a)][:, jnp.asarray(side_b)]
+    assert bool(jnp.all((cross == DEAD) | (cross == 3)))
+
+    # Heal: SYNC anti-entropy (to the seed) re-introduces both sides
+    # (README.md:16-17 — SYNC heals partitions).
+    st, tr = run_ticks(p, st, FaultPlan.clean(n), sm, 250)
+    assert float(tr["convergence"][-1]) == 1.0
+    assert bool(jnp.all(statuses(st) == ALIVE))
+
+
+def test_restart_new_epoch():
+    n = 8
+    p = small_params(n)
+    sm = seeds_mask(n, [0])
+    plan = FaultPlan.clean(n)
+    st = init_full_view(n, user_gossip_slots=2)
+    st = kill(st, 3)
+    st, _ = run_ticks(p, st, plan, sm, p.suspicion_ticks + 40)
+
+    st = restart(st, 3)
+    st, tr = run_ticks(p, st, plan, sm, 200)
+    assert float(tr["convergence"][-1]) == 1.0
+    # Everyone sees node 3 ALIVE at its new epoch.
+    assert bool(jnp.all(decode_epoch(st.view)[:, 3] == 1))
+    assert bool(jnp.all(statuses(st)[:, 3] == ALIVE))
+
+
+def test_restart_detected_gone_by_fd():
+    """A restarted process answers probes with a new identity — DEST_GONE
+    (PingData.java:17-22) kills the old record without waiting out suspicion."""
+    n = 8
+    p = small_params(n, suspicion_ticks=10_000)  # suspicion can't help here
+    sm = seeds_mask(n, [0])
+    plan = FaultPlan.clean(n)
+    st = init_full_view(n, user_gossip_slots=2)
+    st = restart(st, 3)  # instant restart: process up, epoch bumped
+    st, tr = run_ticks(p, st, plan, sm, 200)
+    assert bool(jnp.all(decode_epoch(st.view)[:, 3] == 1))
+    assert float(tr["convergence"][-1]) == 1.0
+
+
+def test_graceful_leave():
+    n = 8
+    p = small_params(n)
+    sm = seeds_mask(n, [0])
+    plan = FaultPlan.clean(n)
+    st = init_full_view(n, user_gossip_slots=2)
+    st = leave(st, 2)
+    st, _ = run_ticks(p, st, plan, sm, 3)  # leave gossip rides normal spread
+    st = kill(st, 2)
+    st, _ = run_ticks(p, st, plan, sm, p.periods_to_spread)
+    s = statuses(st)[:, 2]
+    live = st.alive
+    # Leavers are seen DEAD well before any suspicion timeout could fire.
+    assert bool(jnp.all(jnp.where(live, (s == DEAD) | (s == 3), True)))
+
+
+def test_user_gossip_dissemination():
+    n = 50
+    p = small_params(n, periods_to_spread=18, periods_to_sweep=38)
+    st = init_full_view(n, user_gossip_slots=2)
+    st = inject_gossip(st, 7, 0)
+    st, tr = run_ticks(p, st, FaultPlan.clean(n), seeds_mask(n, [0]), 30)
+    cov = tr["gossip_coverage"][:, 0]
+    assert float(cov[-1]) == 1.0
+    # Dissemination beats the sweep deadline (GossipProtocolTest.java:154-173).
+    full_at = int(jnp.argmax(cov >= 1.0))
+    assert full_at <= p.periods_to_sweep
+
+
+def test_user_gossip_under_loss():
+    n = 50
+    p = small_params(n, periods_to_spread=18, periods_to_sweep=38)
+    st = init_full_view(n, user_gossip_slots=2)
+    st = inject_gossip(st, 0, 1)
+    plan = FaultPlan.clean(n).with_loss(50.0)
+    st, tr = run_ticks(p, st, plan, seeds_mask(n, [0]), 40)
+    # The reference's worst tested grid: N=50, 50% loss still disseminates
+    # (GossipProtocolTest.java:48-64).
+    assert float(tr["gossip_coverage"][-1, 1]) == 1.0
+
+
+def test_determinism():
+    n = 16
+    p = small_params(n)
+    plan, sm = FaultPlan.clean(n).with_loss(10.0), seeds_mask(n, [0])
+    outs = []
+    for _ in range(2):
+        st = init_seeded(n, [0], user_gossip_slots=2, seed=42)
+        st, tr = run_ticks(p, st, plan, sm, 50)
+        outs.append((st.view, tr["convergence"]))
+    assert bool(jnp.all(outs[0][0] == outs[1][0]))
+    assert bool(jnp.all(outs[0][1] == outs[1][1]))
+
+
+@pytest.mark.parametrize("n_dev", [8])
+def test_sharded_equals_single(n_dev):
+    """Sharding the member axis over 8 virtual devices must not change the
+    computation — same seed, same trajectory, bit-for-bit."""
+    assert len(jax.devices()) >= n_dev
+    n = 32
+    p = small_params(n)
+    plan, sm = FaultPlan.clean(n).with_loss(15.0), seeds_mask(n, [0])
+
+    st_single = init_full_view(n, user_gossip_slots=2, seed=7)
+    st_single = kill(st_single, 4)
+    ref, tr_ref = run_ticks(p, st_single, plan, sm, 80)
+
+    mesh = make_mesh(jax.devices()[:n_dev])
+    st_sh = shard_state(kill(init_full_view(n, user_gossip_slots=2, seed=7), 4), mesh)
+    plan_sh = shard_plan(plan, mesh)
+    out, tr_sh = run_ticks(p, st_sh, plan_sh, sm, 80)
+
+    assert bool(jnp.all(jax.device_get(out.view) == jax.device_get(ref.view)))
+    assert bool(
+        jnp.all(jax.device_get(tr_sh["convergence"]) == jax.device_get(tr_ref["convergence"]))
+    )
+
+
+def test_diagonal_invariant():
+    """A live node never believes itself SUSPECT/DEAD (self-refutation)."""
+    n = 16
+    p = small_params(n)
+    st = init_full_view(n, user_gossip_slots=2)
+    plan = FaultPlan.clean(n).with_loss(30.0)
+    st, _ = run_ticks(p, st, plan, seeds_mask(n, [0]), 150)
+    diag_status = jnp.diagonal(statuses(st))
+    assert bool(jnp.all(jnp.where(st.alive, diag_status == ALIVE, True)))
