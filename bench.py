@@ -1,0 +1,54 @@
+"""Headline benchmark: member-gossip-rounds per second on one chip.
+
+Simulates a dense SWIM cluster (sim/) at the largest member count that fits
+single-chip HBM dense, under LAN protocol ratios with 5% packet loss — the
+BASELINE.json "1k-member SWIM sim, 5% packet loss + suspicion" config scaled
+up. One tick advances every member one gossip round (plus the FD/SYNC work on
+their cadence), so throughput = n_members × ticks/sec, measured against the
+driver's north-star 1M member-gossip-rounds/sec (BASELINE.json north_star).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+BASELINE_MEMBER_ROUNDS_PER_SEC = 1_000_000.0
+
+
+def bench(n_members: int = 8192, chunk: int = 50, reps: int = 4) -> dict:
+    from scalecube_cluster_tpu.sim import FaultPlan, SimParams, init_full_view, run_ticks
+    from scalecube_cluster_tpu.sim.state import seeds_mask
+
+    params = SimParams.from_cluster_config(n_members)
+    state = init_full_view(n_members)
+    plan = FaultPlan.clean(n_members).with_loss(5.0)
+    seeds = seeds_mask(n_members, [0, 1])
+
+    # Warmup: compile + reach protocol steady state. NOTE: timings sync via a
+    # host fetch of the last metric — jax.block_until_ready can report ready
+    # prematurely over this box's tunneled-TPU transport.
+    state, traces = run_ticks(params, state, plan, seeds, chunk)
+    float(traces["convergence"][-1])
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, traces = run_ticks(params, state, plan, seeds, chunk)
+        float(traces["convergence"][-1])
+    dt = time.perf_counter() - t0
+
+    value = n_members * (reps * chunk / dt)
+    return {
+        "metric": f"member_gossip_rounds_per_sec_n{n_members}",
+        "value": round(value, 1),
+        "unit": "member·rounds/s",
+        "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+    }
+
+
+if __name__ == "__main__":
+    print(json.dumps(bench()))

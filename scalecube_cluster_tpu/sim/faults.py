@@ -67,18 +67,21 @@ class FaultPlan:
         return self.replace(block=block)
 
 
-def edge_pass(rng: jax.Array, plan: FaultPlan, dst: jax.Array) -> jax.Array:
-    """Sample per-edge delivery success for sender-row fan-out edges.
+def link_pass(rng: jax.Array, plan: FaultPlan, src: jax.Array, dst: jax.Array) -> jax.Array:
+    """Sample delivery success for arbitrary directed links src[...]→dst[...].
 
-    Args:
-      rng: PRNG key.
-      plan: fault plan.
-      dst: ``[N, k]`` int32 — edge c of sender i targets ``dst[i, c]``.
-
-    Returns:
-      ``[N, k]`` bool — True where the link is unblocked and survives loss.
+    The single source of truth for link-fault semantics: a message passes iff
+    the link is unblocked and survives the loss draw. ``src``/``dst`` are
+    broadcast-compatible int32 index arrays.
     """
-    blocked = jnp.take_along_axis(plan.block, dst, axis=1)
-    loss = jnp.take_along_axis(plan.loss, dst, axis=1)
-    u = jax.random.uniform(rng, dst.shape)
+    blocked = plan.block[src, dst]
+    loss = plan.loss[src, dst]
+    u = jax.random.uniform(rng, jnp.shape(blocked))
     return ~blocked & (u >= loss)
+
+
+def edge_pass(rng: jax.Array, plan: FaultPlan, dst: jax.Array) -> jax.Array:
+    """:func:`link_pass` for sender-row fan-out edges: sender i on edge c
+    targets ``dst[i, c]``."""
+    src = jnp.arange(dst.shape[0], dtype=jnp.int32)[:, None]
+    return link_pass(rng, plan, src, dst)

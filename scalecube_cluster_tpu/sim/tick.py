@@ -61,7 +61,7 @@ from scalecube_cluster_tpu.ops.merge import (
     overrides_same_epoch,
 )
 from scalecube_cluster_tpu.ops.select import masked_random_choice, masked_random_topk
-from scalecube_cluster_tpu.sim.faults import FaultPlan, edge_pass
+from scalecube_cluster_tpu.sim.faults import FaultPlan, edge_pass, link_pass
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import NO_SUSPECT, SimState
 
@@ -69,14 +69,6 @@ _ALIVE = int(MemberStatus.ALIVE)
 _SUSPECT = int(MemberStatus.SUSPECT)
 _DEAD = int(MemberStatus.DEAD)
 _AGE_CAP = 1 << 20
-
-
-def _reverse_edge_pass(rng, plan: FaultPlan, src, i):
-    """Delivery success for edges src[...]→i (the ack / reply direction)."""
-    blocked = plan.block[src, i]
-    loss = plan.loss[src, i]
-    u = jax.random.uniform(rng, jnp.shape(src))
-    return ~blocked & (u >= loss)
 
 
 @partial(jax.jit, static_argnums=0)
@@ -119,8 +111,8 @@ def sim_tick(params: SimParams, state: SimState, plan: FaultPlan, seeds: jax.Arr
     v_epoch = decode_epoch(vkey)
 
     probing = do_fd & alive & tgt_valid
-    fwd_ok = edge_pass(k_ping, plan, tgt[:, None])[:, 0]
-    ack_ok = _reverse_edge_pass(k_ack, plan, tgt, i_idx)
+    fwd_ok = link_pass(k_ping, plan, i_idx, tgt)
+    ack_ok = link_pass(k_ack, plan, tgt, i_idx)
     direct_reach = probing & alive[tgt] & fwd_ok & ack_ok
 
     # Indirect probe via k relays: origin→relay→target→relay→origin, all four
@@ -129,13 +121,10 @@ def sim_tick(params: SimParams, state: SimState, plan: FaultPlan, seeds: jax.Arr
     relay_cand = cand & (col[None, :] != tgt[:, None])
     ridx, rvalid = masked_random_topk(k_relay, relay_cand, params.ping_req_members)
     rk1, rk2, rk3, rk4 = jax.random.split(k_rlink, 4)
-    leg_or = edge_pass(rk1, plan, ridx)  # origin→relay
-    u = jax.random.uniform(rk2, ridx.shape)
-    leg_rt = ~plan.block[ridx, tgt[:, None]] & (u >= plan.loss[ridx, tgt[:, None]])
-    u = jax.random.uniform(rk3, ridx.shape)
-    leg_tr = ~plan.block[tgt[:, None], ridx] & (u >= plan.loss[tgt[:, None], ridx])
-    u = jax.random.uniform(rk4, ridx.shape)
-    leg_ro = ~plan.block[ridx, i_idx[:, None]] & (u >= plan.loss[ridx, i_idx[:, None]])
+    leg_or = link_pass(rk1, plan, i_idx[:, None], ridx)  # origin→relay
+    leg_rt = link_pass(rk2, plan, ridx, tgt[:, None])  # relay→target
+    leg_tr = link_pass(rk3, plan, tgt[:, None], ridx)  # target→relay
+    leg_ro = link_pass(rk4, plan, ridx, i_idx[:, None])  # relay→origin
     relay_reach = (
         rvalid & alive[ridx] & alive[tgt][:, None] & leg_or & leg_rt & leg_tr & leg_ro
     )
@@ -202,11 +191,8 @@ def sim_tick(params: SimParams, state: SimState, plan: FaultPlan, seeds: jax.Arr
     s_cand = (g_cand | seeds[None, :]) & ~diag
     prt, p_valid = masked_random_choice(k_ssel, s_cand)
     sk1, sk2 = jax.random.split(k_slink)
-    s_fwd = (
-        do_sync & p_valid & alive[prt]
-        & edge_pass(sk1, plan, prt[:, None])[:, 0]
-    )
-    s_rev = s_fwd & _reverse_edge_pass(sk2, plan, prt, i_idx)
+    s_fwd = do_sync & p_valid & alive[prt] & link_pass(sk1, plan, i_idx, prt)
+    s_rev = s_fwd & link_pass(sk2, plan, prt, i_idx)
 
     full_alive_rows = jnp.where(is_alive_key(view1), view1, UNKNOWN_KEY)
     best_any = jnp.maximum(

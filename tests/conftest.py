@@ -6,18 +6,10 @@ before the first `import jax` anywhere in the test process, which is why they
 live at the top of the root conftest.
 """
 
-import os
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
-
-# The env vars alone are not enough when a TPU PJRT plugin (e.g. the axon
-# tunnel) is installed and overrides platform selection — pin it via config.
-import jax  # noqa: E402
+# Env vars (JAX_PLATFORMS/XLA_FLAGS) do not stick on this box — an installed
+# TPU PJRT plugin (the axon tunnel) overrides platform selection. The config
+# calls are authoritative and must run before any other jax operation.
+import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
