@@ -30,15 +30,15 @@ def bench(n_members: int = 8192, chunk: int = 50, reps: int = 4) -> dict:
     seeds = seeds_mask(n_members, [0, 1])
 
     # Warmup: compile + reach protocol steady state. NOTE: timings sync via a
-    # host fetch of the last metric — jax.block_until_ready can report ready
+    # host fetch of the tick counter — jax.block_until_ready can report ready
     # prematurely over this box's tunneled-TPU transport.
-    state, traces = run_ticks(params, state, plan, seeds, chunk)
-    float(traces["convergence"][-1])
+    state, traces = run_ticks(params, state, plan, seeds, chunk, collect=False)
+    int(state.tick)
 
     t0 = time.perf_counter()
     for _ in range(reps):
-        state, traces = run_ticks(params, state, plan, seeds, chunk)
-        float(traces["convergence"][-1])
+        state, traces = run_ticks(params, state, plan, seeds, chunk, collect=False)
+        int(state.tick)
     dt = time.perf_counter() - t0
 
     value = n_members * (reps * chunk / dt)

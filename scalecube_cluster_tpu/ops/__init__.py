@@ -26,4 +26,7 @@ from scalecube_cluster_tpu.ops.select import (  # noqa: F401
 from scalecube_cluster_tpu.ops.delivery import (  # noqa: F401
     deliver_rows_any,
     deliver_rows_max,
+    fanout_permutations,
+    permuted_delivery,
+    permuted_delivery_two_channel,
 )

@@ -80,8 +80,3 @@ def link_pass(rng: jax.Array, plan: FaultPlan, src: jax.Array, dst: jax.Array) -
     return ~blocked & (u >= loss)
 
 
-def edge_pass(rng: jax.Array, plan: FaultPlan, dst: jax.Array) -> jax.Array:
-    """:func:`link_pass` for sender-row fan-out edges: sender i on edge c
-    targets ``dst[i, c]``."""
-    src = jnp.arange(dst.shape[0], dtype=jnp.int32)[:, None]
-    return link_pass(rng, plan, src, dst)

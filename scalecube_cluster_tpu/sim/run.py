@@ -22,19 +22,21 @@ from scalecube_cluster_tpu.sim.state import SimState
 from scalecube_cluster_tpu.sim.tick import sim_tick
 
 
-@partial(jax.jit, static_argnums=(0, 4))
+@partial(jax.jit, static_argnums=(0, 4), static_argnames=("collect",))
 def run_ticks(
     params: SimParams,
     state: SimState,
     plan: FaultPlan,
     seeds: jax.Array,
     n_ticks: int,
+    collect: bool = True,
 ):
     """Run ``n_ticks`` gossip periods. Returns ``(final_state, metric_traces)``
-    where each trace has leading axis ``n_ticks``."""
+    where each trace has leading axis ``n_ticks``. ``collect=False`` trims the
+    traces to the tick counter (benchmark mode)."""
 
     def step(carry: SimState, _):
-        new_state, metrics = sim_tick(params, carry, plan, seeds)
+        new_state, metrics = sim_tick(params, carry, plan, seeds, collect=collect)
         return new_state, metrics
 
     return lax.scan(step, state, None, length=n_ticks)

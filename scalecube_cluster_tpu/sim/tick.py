@@ -6,24 +6,27 @@ failure-detector round (FailureDetectorImpl.doPing, :126-170), gossip spread
 (MembershipProtocolImpl.doSync, :304-320) — collapsed into one batched,
 branchless step suitable for `jax.lax.scan` + `jit` + sharding:
 
-  1. FD probe: every node picks one target (shuffled-round-robin becomes
-     Gumbel sampling, ops/select.py), direct ping with loss/block-sampled
-     round trip, indirect ping-req via k relays on direct failure
-     (FailureDetectorImpl.java:160-208), DEST_GONE on epoch mismatch
-     (PingData.java:8-23) → SUSPECT / DEAD record updates.
+  1. FD probe (cond-gated to ping ticks): every node picks one target
+     (shuffled-round-robin becomes Gumbel sampling, ops/select.py), direct
+     ping with loss/block-sampled round trip, indirect ping-req via k relays
+     on direct failure (FailureDetectorImpl.java:160-208), DEST_GONE on epoch
+     mismatch (PingData.java:8-23) → SUSPECT / DEAD record updates.
   2. Suspicion sweep: SUSPECT older than the suspicion timeout becomes DEAD
      (MembershipProtocolImpl.onSuspicionTimeout, :637-647).
-  3. Gossip + SYNC delivery: per-node fan-out of membership rumors younger
-     than periodsToSpread (selectGossipsToSend, GossipProtocolImpl.java:242-251)
-     plus, on sync ticks, full-table exchange with one partner both ways
-     (onSync/onSyncAck, MembershipProtocolImpl.java:343-373); all edges are
-     folded with segment_max and merged through the priority-key lattice
-     (ops/merge.py = updateMembership/isOverrides).
-  4. Self-refutation: a node seeing a SUSPECT/DEAD rumor about its own current
+  3. Gossip delivery, every tick: fan-out along per-tick random permutations
+     (ops/delivery.py::fanout_permutations — the TPU form of the reference's
+     shuffled sliding window, GossipProtocolImpl.java:253-274) carrying
+     membership rumors younger than periodsToSpread (selectGossipsToSend,
+     :242-251), folded receiver-side by gather + lattice max (ops/merge.py =
+     updateMembership/isOverrides).
+  4. SYNC anti-entropy (cond-gated to sync ticks / joining nodes): full-table
+     exchange with one partner both ways (onSync/onSyncAck,
+     MembershipProtocolImpl.java:343-373).
+  5. Self-refutation: a node seeing a SUSPECT/DEAD rumor about its own current
      epoch at inc >= its own bumps incarnation and re-announces ALIVE
      (onSelfMemberDetected, MembershipProtocolImpl.java:549-569), unless it
      voluntarily left (DEAD own-diagonal, sim/state.py::leave).
-  5. User-gossip dissemination with exactly-once first-seen accounting
+  6. User-gossip dissemination with exactly-once first-seen accounting
      (onGossipReq dedup, GossipProtocolImpl.java:171-183).
 
 Documented deviations from the reference (protocol-equivalent at period
@@ -31,6 +34,12 @@ granularity; the convergence tests are the oracle):
 
 - A whole ping→timeout→ping-req round resolves within its FD tick (the
   reference bounds it by pingInterval the same way); sub-tick timings vanish.
+- Gossip fan-out is a random permutation per tick: out-degree AND in-degree
+  are exactly `fanout`, and targets are drawn cluster-wide rather than from
+  the sender's live-member list. A message to a node the sender believes dead
+  is a no-op unless the target is actually alive — in which case it only
+  accelerates rumor refutation. The reference's sliding window regularizes
+  selection the same way over n/fanout periods.
 - FD ALIVE results do not trigger the direct-SYNC nudge of
   MembershipProtocolImpl.java:385-397; refutation rides the gossiped SUSPECT
   rumor reaching the target instead — same outcome, ≤ spread-latency later.
@@ -46,9 +55,15 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from scalecube_cluster_tpu.cluster_api.member import MemberStatus
-from scalecube_cluster_tpu.ops.delivery import deliver_rows_any, deliver_rows_max
+from scalecube_cluster_tpu.ops.delivery import (
+    deliver_rows_max,
+    fanout_permutations,
+    permuted_delivery,
+    permuted_delivery_two_channel,
+)
 from scalecube_cluster_tpu.ops.merge import (
     DEAD_BIT,
     UNKNOWN_KEY,
@@ -61,7 +76,7 @@ from scalecube_cluster_tpu.ops.merge import (
     overrides_same_epoch,
 )
 from scalecube_cluster_tpu.ops.select import masked_random_choice, masked_random_topk
-from scalecube_cluster_tpu.sim.faults import FaultPlan, edge_pass, link_pass
+from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import NO_SUSPECT, SimState
 
@@ -71,8 +86,14 @@ _DEAD = int(MemberStatus.DEAD)
 _AGE_CAP = 1 << 20
 
 
-@partial(jax.jit, static_argnums=0)
-def sim_tick(params: SimParams, state: SimState, plan: FaultPlan, seeds: jax.Array):
+@partial(jax.jit, static_argnums=0, static_argnames=("collect",))
+def sim_tick(
+    params: SimParams,
+    state: SimState,
+    plan: FaultPlan,
+    seeds: jax.Array,
+    collect: bool = True,
+):
     """Advance the cluster one gossip period. Returns ``(new_state, metrics)``.
 
     Args:
@@ -81,76 +102,82 @@ def sim_tick(params: SimParams, state: SimState, plan: FaultPlan, seeds: jax.Arr
       plan: :class:`FaultPlan` for this tick.
       seeds: ``[N]`` bool — seed slots, always eligible SYNC partners
         (selectSyncAddress draws from seeds ∪ members, :416-427).
+      collect: static; False trims metrics to the tick counter (benchmark
+        mode — skips the convergence/count reductions).
     """
     n = params.n
     t = state.tick + 1
-    keys = jax.random.split(state.rng, 10)
-    (rng_next, k_tgt, k_ping, k_ack, k_relay, k_rlink,
-     k_gsel, k_glink, k_ssel, k_slink) = keys
+    keys = jax.random.split(state.rng, 8)
+    (rng_next, k_tgt, k_ping, k_relay, k_gsel, k_glink, k_ssel, k_slink) = keys
 
     view0 = state.view
     status0 = decode_status(view0)
-    known0 = view0 >= 0
     alive = state.alive
     col = jnp.arange(n, dtype=jnp.int32)
     diag = jnp.eye(n, dtype=bool)
-    i_idx = col  # row index == receiver identity for reverse links
+    i_idx = col  # row index == sender/receiver identity for link sampling
 
     do_fd = (t % params.fd_period_ticks) == 0
     do_sync_tick = (t % params.sync_period_ticks) == 0
 
     # Live-member candidate sets: known, not seen DEAD, not self — the member
-    # lists FD/gossip draw from (FailureDetectorImpl.java:323-333,
-    # GossipProtocolImpl.java:185-197 maintain them off membership events).
-    cand = known0 & (status0 != _DEAD) & ~diag
+    # lists FD/sync draw from (FailureDetectorImpl.java:323-333).
+    cand = (view0 >= 0) & (status0 != _DEAD) & ~diag
 
     # ------------------------------------------------------------------ 1. FD
-    tgt, tgt_valid = masked_random_choice(k_tgt, cand)
-    vkey = jnp.take_along_axis(view0, tgt[:, None], axis=1)[:, 0]
-    v_inc = decode_incarnation(vkey)
-    v_epoch = decode_epoch(vkey)
+    def fd_fire_phase(view0):
+        tgt, tgt_valid = masked_random_choice(k_tgt, cand)
+        vkey = jnp.take_along_axis(view0, tgt[:, None], axis=1)[:, 0]
+        v_inc = decode_incarnation(vkey)
+        v_epoch = decode_epoch(vkey)
 
-    probing = do_fd & alive & tgt_valid
-    fwd_ok = link_pass(k_ping, plan, i_idx, tgt)
-    ack_ok = link_pass(k_ack, plan, tgt, i_idx)
-    direct_reach = probing & alive[tgt] & fwd_ok & ack_ok
+        probing = alive & tgt_valid
+        pk1, pk2 = jax.random.split(k_ping)
+        fwd_ok = link_pass(pk1, plan, i_idx, tgt)
+        ack_ok = link_pass(pk2, plan, tgt, i_idx)
+        direct_reach = probing & alive[tgt] & fwd_ok & ack_ok
 
-    # Indirect probe via k relays: origin→relay→target→relay→origin, all four
-    # legs sampled (onPingReq transit + onTransitPingAck forwarding,
-    # FailureDetectorImpl.java:255-305).
-    relay_cand = cand & (col[None, :] != tgt[:, None])
-    ridx, rvalid = masked_random_topk(k_relay, relay_cand, params.ping_req_members)
-    rk1, rk2, rk3, rk4 = jax.random.split(k_rlink, 4)
-    leg_or = link_pass(rk1, plan, i_idx[:, None], ridx)  # origin→relay
-    leg_rt = link_pass(rk2, plan, ridx, tgt[:, None])  # relay→target
-    leg_tr = link_pass(rk3, plan, tgt[:, None], ridx)  # target→relay
-    leg_ro = link_pass(rk4, plan, ridx, i_idx[:, None])  # relay→origin
-    relay_reach = (
-        rvalid & alive[ridx] & alive[tgt][:, None] & leg_or & leg_rt & leg_tr & leg_ro
-    )
-    indirect_reach = probing & jnp.any(relay_reach, axis=1)
+        # Indirect probe via k relays: origin→relay→target→relay→origin, all
+        # four legs sampled (onPingReq transit + onTransitPingAck forwarding,
+        # FailureDetectorImpl.java:255-305).
+        relay_cand = cand & (col[None, :] != tgt[:, None])
+        kr1, rk1, rk2, rk3, rk4 = jax.random.split(k_relay, 5)
+        ridx, rvalid = masked_random_topk(kr1, relay_cand, params.ping_req_members)
+        leg_or = link_pass(rk1, plan, i_idx[:, None], ridx)  # origin→relay
+        leg_rt = link_pass(rk2, plan, ridx, tgt[:, None])  # relay→target
+        leg_tr = link_pass(rk3, plan, tgt[:, None], ridx)  # target→relay
+        leg_ro = link_pass(rk4, plan, ridx, i_idx[:, None])  # relay→origin
+        relay_reach = (
+            rvalid
+            & alive[ridx]
+            & alive[tgt][:, None]
+            & leg_or
+            & leg_rt
+            & leg_tr
+            & leg_ro
+        )
+        reached = direct_reach | (probing & jnp.any(relay_reach, axis=1))
 
-    reached = direct_reach | indirect_reach
-    # Ack carries the responder's identity: epoch ahead of the viewed record
-    # means the old process is gone (AckType.DEST_GONE, PingData.java:8-23).
-    gone = reached & (state.epoch[tgt] != v_epoch)
+        # Ack carries the responder's identity: epoch ahead of the viewed
+        # record means the old process is gone (AckType.DEST_GONE,
+        # PingData.java:8-23).
+        gone = reached & (state.epoch[tgt] != v_epoch)
+        fd_fire = (probing & ~reached) | gone
+        fd_key = encode_key(jnp.where(gone, _DEAD, _SUSPECT), v_inc, v_epoch)
 
-    fd_suspect = probing & ~reached
-    fd_dead = gone
-    fd_fire = fd_suspect | fd_dead
-    fd_status = jnp.where(fd_dead, _DEAD, _SUSPECT)
-    fd_key = encode_key(fd_status, v_inc, v_epoch)
+        onehot_tgt = col[None, :] == tgt[:, None]
+        fd_mat = jnp.where(onehot_tgt & fd_fire[:, None], fd_key[:, None], UNKNOWN_KEY)
+        # Same-epoch candidate by construction: plain lattice accept. SUSPECT
+        # at the viewed incarnation outranks ALIVE (rank bit); DEAD outranks
+        # both; an existing DEAD record stays sticky.
+        fd_accept = (fd_mat >= 0) & (view0 >= 0) & overrides_same_epoch(fd_mat, view0)
+        msgs = jnp.sum(probing) + jnp.sum((probing & ~direct_reach)[:, None] & rvalid)
+        return jnp.where(fd_accept, fd_mat, view0), fd_accept, msgs
 
-    onehot_tgt = col[None, :] == tgt[:, None]
-    fd_mat = jnp.where(
-        onehot_tgt & fd_fire[:, None], fd_key[:, None], UNKNOWN_KEY
-    )
-    # Same-epoch candidate by construction: plain lattice accept. SUSPECT at
-    # the viewed incarnation outranks ALIVE (rank bit); DEAD outranks both;
-    # an existing DEAD record stays sticky.
-    fd_accept = (fd_mat >= 0) & known0 & overrides_same_epoch(fd_mat, view0)
-    view1 = jnp.where(fd_accept, fd_mat, view0)
-    changed = fd_accept
+    def fd_skip_phase(view0):
+        return view0, jnp.zeros((n, n), bool), jnp.asarray(0, jnp.int32)
+
+    view1, changed, msgs_fd = lax.cond(do_fd, fd_fire_phase, fd_skip_phase, view0)
 
     # ------------------------------------------------ 2. suspicion timeout
     expired = (
@@ -166,46 +193,62 @@ def sim_tick(params: SimParams, state: SimState, plan: FaultPlan, seeds: jax.Arr
     view1 = jnp.where(expired, dead_keys, view1)
     changed = changed | expired
 
-    # ------------------------------------------- 3. gossip + sync delivery
-    status1 = decode_status(view1)
-    g_cand = (view1 >= 0) & (status1 != _DEAD) & ~diag
-    dst, dvalid = masked_random_topk(k_gsel, g_cand, params.gossip_fanout)
-    edge_ok = (
-        dvalid
-        & alive[:, None]
-        & alive[dst]
-        & edge_pass(k_glink, plan, dst)
+    # ------------------------------------------------- 3. gossip delivery
+    _, inv_perm = fanout_permutations(k_gsel, n, params.gossip_fanout)
+    lks = jax.random.split(k_glink, params.gossip_fanout)
+    edge_ok = jnp.stack(
+        [
+            alive[inv_perm[c]] & link_pass(lks[c], plan, inv_perm[c], i_idx)
+            for c in range(params.gossip_fanout)
+        ]
     )
 
     age0 = jnp.where(changed, 0, state.rumor_age)
     rows = jnp.where(age0 < params.periods_to_spread, view1, UNKNOWN_KEY)
-    best_any = deliver_rows_max(rows, dst, edge_ok, n)
-    alive_rows = jnp.where(is_alive_key(rows), rows, UNKNOWN_KEY)
-    best_alive = deliver_rows_max(alive_rows, dst, edge_ok, n)
+    best_any, best_alive = permuted_delivery_two_channel(
+        rows, is_alive_key, inv_perm, edge_ok
+    )
 
-    # SYNC: full-table exchange with one partner from seeds ∪ members. Nodes
-    # that know nobody (fresh joiners/restarts) retry every tick — the
+    # ------------------------------------------------- 4. SYNC anti-entropy
+    # Nodes that know nobody (fresh joiners/restarts) retry every tick — the
     # initial-sync path (start0, MembershipProtocolImpl.java:222-257).
-    joining = jnp.sum(g_cand, axis=1) == 0
-    do_sync = (do_sync_tick | joining) & alive
-    s_cand = (g_cand | seeds[None, :]) & ~diag
-    prt, p_valid = masked_random_choice(k_ssel, s_cand)
-    sk1, sk2 = jax.random.split(k_slink)
-    s_fwd = do_sync & p_valid & alive[prt] & link_pass(sk1, plan, i_idx, prt)
-    s_rev = s_fwd & link_pass(sk2, plan, prt, i_idx)
+    joining = (jnp.sum(cand, axis=1) == 0) & alive
 
-    full_alive_rows = jnp.where(is_alive_key(view1), view1, UNKNOWN_KEY)
-    best_any = jnp.maximum(
-        best_any, deliver_rows_max(view1, prt[:, None], s_fwd[:, None], n)
-    )
-    best_alive = jnp.maximum(
-        best_alive, deliver_rows_max(full_alive_rows, prt[:, None], s_fwd[:, None], n)
-    )
-    reply = view1[prt, :]  # SYNC_ACK: partner's full table back to the caller
-    best_any = jnp.maximum(best_any, jnp.where(s_rev[:, None], reply, UNKNOWN_KEY))
-    best_alive = jnp.maximum(
-        best_alive,
-        jnp.where(s_rev[:, None] & is_alive_key(reply), reply, UNKNOWN_KEY),
+    def sync_fire_phase(args):
+        best_any, best_alive = args
+        status1 = decode_status(view1)
+        s_cand = (((view1 >= 0) & (status1 != _DEAD)) | seeds[None, :]) & ~diag
+        prt, p_valid = masked_random_choice(k_ssel, s_cand)
+        do_sync = (do_sync_tick | joining) & alive
+        sk1, sk2 = jax.random.split(k_slink)
+        s_fwd = do_sync & p_valid & alive[prt] & link_pass(sk1, plan, i_idx, prt)
+        s_rev = s_fwd & link_pass(sk2, plan, prt, i_idx)
+
+        full_alive_rows = jnp.where(is_alive_key(view1), view1, UNKNOWN_KEY)
+        best_any = jnp.maximum(
+            best_any, deliver_rows_max(view1, prt[:, None], s_fwd[:, None], n)
+        )
+        best_alive = jnp.maximum(
+            best_alive,
+            deliver_rows_max(full_alive_rows, prt[:, None], s_fwd[:, None], n),
+        )
+        reply = view1[prt, :]  # SYNC_ACK: partner's full table to the caller
+        best_any = jnp.maximum(best_any, jnp.where(s_rev[:, None], reply, UNKNOWN_KEY))
+        best_alive = jnp.maximum(
+            best_alive,
+            jnp.where(s_rev[:, None] & is_alive_key(reply), reply, UNKNOWN_KEY),
+        )
+        return best_any, best_alive, jnp.sum(s_fwd) + jnp.sum(s_rev)
+
+    def sync_skip_phase(args):
+        best_any, best_alive = args
+        return best_any, best_alive, jnp.asarray(0, jnp.int32)
+
+    best_any, best_alive, msgs_sync = lax.cond(
+        do_sync_tick | jnp.any(joining),
+        sync_fire_phase,
+        sync_skip_phase,
+        (best_any, best_alive),
     )
 
     # Merge everything delivered off-diagonal through the lattice.
@@ -216,7 +259,7 @@ def sim_tick(params: SimParams, state: SimState, plan: FaultPlan, seeds: jax.Arr
     mchanged = mchanged & alive[:, None]
     changed = changed | mchanged
 
-    # --------------------------------------------------- 4. self-refutation
+    # --------------------------------------------------- 5. self-refutation
     self_rumor = jnp.diagonal(best_any)  # strongest rumor about me this tick
     own_key = jnp.diagonal(view1)
     left = (own_key & DEAD_BIT) != 0
@@ -260,14 +303,27 @@ def sim_tick(params: SimParams, state: SimState, plan: FaultPlan, seeds: jax.Arr
     )
     suspect_at = jnp.where(alive[:, None], suspect_at, state.suspect_at)
 
-    # ----------------------------------------------------- 5. user gossip
+    # ----------------------------------------------------- 6. user gossip
     urows = state.useen & (state.uage < params.periods_to_spread)
-    got = deliver_rows_any(urows, dst, edge_ok, n)
+    got = permuted_delivery(urows.astype(jnp.int32), inv_perm, edge_ok) > 0
     new_seen = state.useen | (got & alive[:, None])
     first_seen = new_seen & ~state.useen
     uage = jnp.where(first_seen, 0, jnp.minimum(state.uage + 1, _AGE_CAP))
 
     # ------------------------------------------------------------- metrics
+    new_state = state.replace(
+        view=view2,
+        rumor_age=rumor_age,
+        suspect_at=suspect_at,
+        inc_self=inc_self,
+        useen=new_seen,
+        uage=uage,
+        tick=t,
+        rng=rng_next,
+    )
+    if not collect:
+        return new_state, {"tick": t}
+
     n_alive = jnp.sum(alive)
     truth_alive = alive[None, :] & (decode_epoch(view2) == state.epoch[None, :])
     ok_alive = truth_alive & (status2 == _ALIVE)
@@ -280,22 +336,14 @@ def sim_tick(params: SimParams, state: SimState, plan: FaultPlan, seeds: jax.Arr
         "convergence": convergence,
         "n_alive": n_alive,
         "n_suspected": jnp.sum(is_susp & alive[:, None]),
-        "msgs_gossip": jnp.sum(edge_ok),
-        "msgs_fd": jnp.sum(probing)
-        + jnp.sum((probing & ~direct_reach)[:, None] & rvalid),
-        "msgs_sync": jnp.sum(s_fwd) + jnp.sum(s_rev),
+        # Real messages only: exclude permutation self-edges and sends to
+        # dead processes (the reference never delivers either).
+        "msgs_gossip": jnp.sum(
+            edge_ok & alive[None, :] & (inv_perm != col[None, :])
+        ),
+        "msgs_fd": msgs_fd,
+        "msgs_sync": msgs_sync,
         "gossip_coverage": jnp.sum(new_seen & alive[:, None], axis=0)
         / jnp.maximum(n_alive, 1),
     }
-
-    new_state = state.replace(
-        view=view2,
-        rumor_age=rumor_age,
-        suspect_at=suspect_at,
-        inc_self=inc_self,
-        useen=new_seen,
-        uage=uage,
-        tick=t,
-        rng=rng_next,
-    )
     return new_state, metrics
