@@ -20,7 +20,7 @@ import jax
 BASELINE_MEMBER_ROUNDS_PER_SEC = 1_000_000.0
 
 
-def bench(n_members: int = 8192, chunk: int = 50, reps: int = 4) -> dict:
+def bench(n_members: int = 10240, chunk: int = 40, reps: int = 4) -> dict:
     from scalecube_cluster_tpu.sim import FaultPlan, SimParams, init_full_view, run_ticks
     from scalecube_cluster_tpu.sim.state import seeds_mask
 

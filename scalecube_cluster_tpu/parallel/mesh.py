@@ -4,7 +4,7 @@ Layout: every per-member array shards its **viewer axis** (axis 0) across the
 ``"members"`` mesh axis; subject axes stay replicated-size but local, so each
 device owns the full rows of its N/D viewers:
 
-- ``view / rumor_age / suspect_at / useen / uage``: ``P("members", None)``
+- ``view / rumor_age / suspect_left / useen / uage``: ``P("members", None)``
 - ``inc_self / epoch / alive``: ``P("members")``
 - ``tick / rng``: replicated
 
@@ -42,7 +42,7 @@ def state_shardings(mesh: Mesh) -> SimState:
     return SimState(
         view=row,
         rumor_age=row,
-        suspect_at=row,
+        suspect_left=row,
         inc_self=vec,
         epoch=vec,
         alive=vec,

@@ -9,8 +9,9 @@ play the roles of the reference's per-node objects:
                          records younger than periods_to_spread are included in
                          i's gossip messages (GossipState.java:8-50 +
                          spreadMembershipGossip, MembershipProtocolImpl.java:649-656)
-- ``suspect_at[i, j]`` — tick at which i started suspecting j (the suspicion
-                         timeout task, MembershipProtocolImpl.java:620-635)
+- ``suspect_left[i,j]``— countdown (ticks) until i declares suspect j DEAD
+                         (the suspicion timeout task,
+                         MembershipProtocolImpl.java:620-635); 0 = no timer
 - ``inc_self[j]``      — j's own incarnation counter (refutation,
                          MembershipProtocolImpl.java:549-569)
 - ``epoch[j]``         — restart generation of slot j; stands in for the fresh
@@ -35,8 +36,9 @@ from jax.tree_util import register_dataclass
 
 from scalecube_cluster_tpu.ops import merge as merge_ops
 
-#: "No suspicion pending" sentinel for ``suspect_at`` (far future).
-NO_SUSPECT = jnp.iinfo(jnp.int32).max // 2
+#: Saturation value for ``rumor_age`` (int8): anything this old is inert —
+#: past every spread/sweep deadline (SimParams asserts sweep < AGE_STALE).
+AGE_STALE = 120
 
 
 @register_dataclass
@@ -45,8 +47,8 @@ class SimState:
     """Complete state of an N-member simulated cluster (arrays over members)."""
 
     view: jax.Array  # [N, N] int32 priority keys
-    rumor_age: jax.Array  # [N, N] int32
-    suspect_at: jax.Array  # [N, N] int32
+    rumor_age: jax.Array  # [N, N] int8, saturates at AGE_STALE
+    suspect_left: jax.Array  # [N, N] int16 countdown, 0 = no timer
     inc_self: jax.Array  # [N] int32
     epoch: jax.Array  # [N] int32
     alive: jax.Array  # [N] bool
@@ -62,8 +64,8 @@ class SimState:
 def _blank(n: int, slots: int, seed: int) -> SimState:
     return SimState(
         view=jnp.full((n, n), merge_ops.UNKNOWN_KEY, jnp.int32),
-        rumor_age=jnp.full((n, n), 1 << 20, jnp.int32),
-        suspect_at=jnp.full((n, n), NO_SUSPECT, jnp.int32),
+        rumor_age=jnp.full((n, n), AGE_STALE, jnp.int8),
+        suspect_left=jnp.zeros((n, n), jnp.int16),
         inc_self=jnp.zeros((n,), jnp.int32),
         epoch=jnp.zeros((n,), jnp.int32),
         alive=jnp.ones((n,), bool),
@@ -164,8 +166,8 @@ def restart(state: SimState, idx) -> SimState:
         epoch=state.epoch.at[idx].set(new_epoch),
         inc_self=state.inc_self.at[idx].set(0),
         view=state.view.at[idx, :].set(row),
-        rumor_age=state.rumor_age.at[idx, :].set(1 << 20).at[idx, idx].set(0),
-        suspect_at=state.suspect_at.at[idx, :].set(NO_SUSPECT),
+        rumor_age=state.rumor_age.at[idx, :].set(AGE_STALE).at[idx, idx].set(0),
+        suspect_left=state.suspect_left.at[idx, :].set(0),
         useen=state.useen.at[idx, :].set(False),
     )
 

@@ -78,7 +78,7 @@ from scalecube_cluster_tpu.ops.merge import (
 from scalecube_cluster_tpu.ops.select import masked_random_choice, masked_random_topk
 from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass
 from scalecube_cluster_tpu.sim.params import SimParams
-from scalecube_cluster_tpu.sim.state import NO_SUSPECT, SimState
+from scalecube_cluster_tpu.sim.state import AGE_STALE, SimState
 
 _ALIVE = int(MemberStatus.ALIVE)
 _SUSPECT = int(MemberStatus.SUSPECT)
@@ -180,10 +180,16 @@ def sim_tick(
     view1, changed, msgs_fd = lax.cond(do_fd, fd_fire_phase, fd_skip_phase, view0)
 
     # ------------------------------------------------ 2. suspicion timeout
+    # Countdown form: the timer decrements once per tick after the tick that
+    # set it, so it hits 0 exactly suspicion_ticks later. Records that became
+    # SUSPECT this very tick (FD above) have no timer yet — was_susp guards.
+    was_susp = status0 == _SUSPECT
+    left0 = jnp.maximum(state.suspect_left.astype(jnp.int32) - 1, 0)
     expired = (
         alive[:, None]
+        & was_susp
         & (decode_status(view1) == _SUSPECT)
-        & ((t - state.suspect_at) >= params.suspicion_ticks)
+        & (left0 == 0)
     )
     dead_keys = encode_key(
         jnp.full((n, n), _DEAD, jnp.int32),
@@ -277,7 +283,11 @@ def sim_tick(
     view2 = jnp.where(diag & threat[:, None], own_new[:, None], merged)
     changed = changed | (diag & threat[:, None])
 
-    rumor_age = jnp.where(changed, 0, jnp.minimum(state.rumor_age + 1, _AGE_CAP))
+    rumor_age = jnp.where(
+        changed,
+        jnp.asarray(0, jnp.int8),
+        jnp.minimum(state.rumor_age, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
+    )
 
     # Tombstone expiry: the reference REMOVES an accepted DEAD record from the
     # table right away (onDeadMemberDetected, MembershipProtocolImpl.java:571-587)
@@ -297,11 +307,12 @@ def sim_tick(
 
     status2 = decode_status(view2)
     is_susp = status2 == _SUSPECT
-    was_susp = status0 == _SUSPECT
-    suspect_at = jnp.where(
-        is_susp & ~was_susp, t, jnp.where(is_susp, state.suspect_at, NO_SUSPECT)
-    )
-    suspect_at = jnp.where(alive[:, None], suspect_at, state.suspect_at)
+    suspect_left = jnp.where(
+        is_susp & ~was_susp,
+        params.suspicion_ticks,
+        jnp.where(is_susp, left0, 0),
+    ).astype(jnp.int16)
+    suspect_left = jnp.where(alive[:, None], suspect_left, state.suspect_left)
 
     # ----------------------------------------------------- 6. user gossip
     urows = state.useen & (state.uage < params.periods_to_spread)
@@ -314,7 +325,7 @@ def sim_tick(
     new_state = state.replace(
         view=view2,
         rumor_age=rumor_age,
-        suspect_at=suspect_at,
+        suspect_left=suspect_left,
         inc_self=inc_self,
         useen=new_seen,
         uage=uage,
