@@ -11,7 +11,9 @@ ratios (FailureDetectorConfig.java:8-20, GossipConfig.java:8,
 MembershipConfig.java:13-24).
 """
 
+from scalecube_cluster_tpu.sim.checkpoint import load_checkpoint, save_checkpoint
 from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.monitor import cluster_summary, node_view
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import (
     SimState,
@@ -19,20 +21,29 @@ from scalecube_cluster_tpu.sim.state import (
     init_seeded,
     inject_gossip,
     kill,
+    leave,
     restart,
+    update_metadata,
 )
 from scalecube_cluster_tpu.sim.tick import sim_tick
-from scalecube_cluster_tpu.sim.run import run_ticks
+from scalecube_cluster_tpu.sim.run import run_ticks, run_until
 
 __all__ = [
     "FaultPlan",
     "SimParams",
     "SimState",
+    "cluster_summary",
     "init_full_view",
     "init_seeded",
     "inject_gossip",
     "kill",
+    "leave",
+    "load_checkpoint",
+    "node_view",
     "restart",
-    "sim_tick",
     "run_ticks",
+    "run_until",
+    "save_checkpoint",
+    "sim_tick",
+    "update_metadata",
 ]

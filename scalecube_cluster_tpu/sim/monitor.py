@@ -1,0 +1,77 @@
+"""Introspection over a SimState — the array engine's JMX equivalent.
+
+The reference exposes per-node MBeans: cluster-level member/metadata views
+(ClusterImpl.java:434-469) and membership internals — incarnation, alive and
+suspected member lists, and a ring of recently removed members
+(MembershipProtocolImpl.java:720-791). The host backend mirrors that as
+``Cluster.monitor()`` (cluster/cluster.py::ClusterMonitor); this module is the
+same surface over the batched sim: answers come from the state arrays, either
+for one node (``node_view``) or the whole cluster (``cluster_summary``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from scalecube_cluster_tpu.cluster_api.member import MemberStatus
+from scalecube_cluster_tpu.ops.merge import (
+    decode_epoch,
+    decode_incarnation,
+    decode_status,
+)
+from scalecube_cluster_tpu.sim.state import SimState
+
+
+@dataclass(frozen=True)
+class NodeView:
+    """One node's membership introspection (MembershipMonitorMBean analog)."""
+
+    node: int
+    incarnation: int
+    epoch: int
+    alive_members: list[int]  # slots this node sees ALIVE
+    suspected_members: list[int]  # slots this node sees SUSPECT
+    dead_members: list[int]  # un-expired DEAD tombstones
+    unknown_members: list[int]  # not (or no longer) in the table
+
+
+def node_view(state: SimState, node: int) -> NodeView:
+    """Snapshot node ``node``'s table (host transfer; not for hot loops)."""
+    row = np.asarray(jax.device_get(decode_status(state.view[node])))
+    sets: dict[int, list[int]] = {s: [] for s in range(4)}
+    for j, status in enumerate(row):
+        if j != node:
+            sets[int(status)].append(j)
+    return NodeView(
+        node=node,
+        incarnation=int(state.inc_self[node]),
+        epoch=int(state.epoch[node]),
+        alive_members=sets[int(MemberStatus.ALIVE)],
+        suspected_members=sets[int(MemberStatus.SUSPECT)],
+        dead_members=sets[int(MemberStatus.DEAD)],
+        unknown_members=sets[int(MemberStatus.UNKNOWN)],
+    )
+
+
+def cluster_summary(state: SimState) -> dict:
+    """Whole-cluster aggregates (the metrics dict's host-side sibling)."""
+    status = np.asarray(jax.device_get(decode_status(state.view)))
+    alive = np.asarray(jax.device_get(state.alive))
+    inc = np.asarray(jax.device_get(decode_incarnation(state.view)))
+    epoch = np.asarray(jax.device_get(decode_epoch(state.view)))
+    live_rows = status[alive]
+    return {
+        "tick": int(state.tick),
+        "n": int(alive.size),
+        "n_alive_processes": int(alive.sum()),
+        "viewed_alive_mean": float((live_rows == int(MemberStatus.ALIVE)).mean())
+        if live_rows.size
+        else 0.0,
+        "viewed_suspect_total": int((live_rows == int(MemberStatus.SUSPECT)).sum()),
+        "viewed_dead_total": int((live_rows == int(MemberStatus.DEAD)).sum()),
+        "max_incarnation": int(inc.max()),
+        "max_epoch": int(epoch.max()),
+    }

@@ -44,8 +44,10 @@ class SimParams:
     def __post_init__(self):
         # Dtype envelopes of the state arrays (sim/state.py): rumor_age is
         # int8 saturating at AGE_STALE=120, suspect_left is an int16 countdown.
-        if self.periods_to_sweep >= 120:
-            raise ValueError("periods_to_sweep must stay below AGE_STALE=120")
+        if not self.periods_to_spread < self.periods_to_sweep < 120:
+            raise ValueError(
+                "need periods_to_spread < periods_to_sweep < AGE_STALE=120"
+            )
         if self.suspicion_ticks >= (1 << 15):
             raise ValueError("suspicion_ticks must fit the int16 countdown")
 

@@ -172,6 +172,41 @@ def restart(state: SimState, idx) -> SimState:
     )
 
 
+def update_metadata(state: SimState, idx) -> SimState:
+    """Announce a metadata change at node ``idx``.
+
+    SURVEY.md §7 hard-part 5: metadata PAYLOADS stay on the host (the sim
+    carries no variable-length bytes); what the protocol must propagate is the
+    metadata *version*, and the reference does that by bumping the member's
+    incarnation and re-gossiping its record (updateIncarnation,
+    ClusterImpl.java:365-369 → MembershipProtocolImpl.java:184-196). Here
+    identically: inc+1 on the own record with a fresh rumor age. A viewer's
+    known metadata version of subject j is the incarnation it holds —
+    ``decode_incarnation(state.view[viewer, j])`` — which the host-side
+    metadata store uses as its fetch trigger (UPDATED event analog).
+
+    A node that already announced a voluntary leave (DEAD own-diagonal, see
+    :func:`leave`) keeps its leave record — re-announcing ALIVE here would
+    undo the graceful shutdown cluster-wide, and the reference likewise stops
+    serving updates once leaveCluster ran (ClusterImpl.java:376-390).
+    """
+    idx = jnp.asarray(idx)
+    left = (state.view[idx, idx] & merge_ops.DEAD_BIT) != 0
+    inc = jnp.where(left, state.inc_self[idx], state.inc_self[idx] + 1)
+    key = jnp.where(
+        left,
+        state.view[idx, idx],
+        merge_ops.encode_key(jnp.zeros_like(inc), inc, state.epoch[idx]),
+    )
+    return state.replace(
+        inc_self=state.inc_self.at[idx].set(inc),
+        view=state.view.at[idx, idx].set(key),
+        rumor_age=state.rumor_age.at[idx, idx].set(
+            jnp.where(left, state.rumor_age[idx, idx], 0)
+        ),
+    )
+
+
 def inject_gossip(state: SimState, node_idx: int, slot: int) -> SimState:
     """`cluster.spreadGossip` equivalent: enqueue user payload ``slot`` at
     ``node_idx`` (GossipProtocolImpl.spread, :124-128, 163-169)."""

@@ -1,0 +1,144 @@
+"""Cross-backend validation: sim convergence curves vs the host backend.
+
+The north-star acceptance check (BASELINE.json): the TPU sim's dissemination
+dynamics must match a real-socket run of the same protocol. Both backends run
+the same experiment — start an n-member converged cluster with uniform packet
+loss, spread one user gossip from node 0, record the fraction of members
+infected at each gossip period (the curve GossipProtocolTest.java:176-203
+logs against the ClusterMath prediction) — and the curves are compared
+period-for-period.
+
+The host curve samples real wall-clock periods over loopback TCP with
+emulator loss (testlib/network_emulator.py); the sim curve is the
+``gossip_coverage`` metric trace (sim/tick.py). Stochastic runs are averaged
+over trials before comparison.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+
+import numpy as np
+
+from scalecube_cluster_tpu.testlib.fixtures import (
+    fast_test_config,
+    shutdown_all,
+    start_node,
+)
+from scalecube_cluster_tpu.transport.message import Message
+
+
+@dataclasses.dataclass
+class DisseminationCurve:
+    """Coverage per gossip period, 0..1, starting at injection time."""
+
+    coverage: np.ndarray  # [periods] float
+    completion_period: int | None  # first period with full coverage
+
+    @staticmethod
+    def summarize(coverage: np.ndarray) -> "DisseminationCurve":
+        full = np.flatnonzero(coverage >= 1.0)
+        return DisseminationCurve(
+            coverage=coverage,
+            completion_period=int(full[0]) if full.size else None,
+        )
+
+
+async def host_dissemination_curve(
+    n: int,
+    loss_percent: float,
+    periods: int,
+    emulator_seed: int = 17,
+) -> DisseminationCurve:
+    """Run the experiment on the asyncio TCP backend (one trial)."""
+    cfg = fast_test_config()
+    interval_s = cfg.gossip_config.gossip_interval / 1000.0
+    seed = await start_node(cfg)
+    others = []
+    for i in range(n - 1):
+        others.append(
+            await start_node(cfg, seeds=(seed.address,), emulator_seed=emulator_seed + i)
+        )
+    nodes = [seed, *others]
+    try:
+        # Wait for full membership before injecting (the reference's join
+        # phase, ClusterTest.java:88-114).
+        for _ in range(200):
+            if all(len(c.members()) == n for c in nodes):
+                break
+            await asyncio.sleep(0.05)
+
+        got = [False] * n
+        got[0] = True
+
+        async def watch(idx, cluster):
+            async for _msg in cluster.listen_gossip():
+                got[idx] = True
+
+        watchers = [
+            asyncio.ensure_future(watch(i, c)) for i, c in enumerate(nodes)
+        ]
+        for c in nodes:
+            c.network_emulator.set_default_outbound_settings(loss_percent, 0)
+
+        nodes[0].spread_gossip(Message.create(qualifier="xval", data="payload"))
+        coverage = np.zeros(periods)
+        for p in range(periods):
+            await asyncio.sleep(interval_s)
+            coverage[p] = sum(got) / n
+        for w in watchers:
+            w.cancel()
+        return DisseminationCurve.summarize(coverage)
+    finally:
+        await shutdown_all(*nodes)
+
+
+def sim_dissemination_curve(
+    n: int,
+    loss_percent: float,
+    periods: int,
+    trials: int = 5,
+    seed: int = 0,
+) -> DisseminationCurve:
+    """Run the experiment on the sim backend, averaged over ``trials``."""
+    import jax.numpy as jnp
+
+    from scalecube_cluster_tpu.sim import (
+        FaultPlan,
+        SimParams,
+        init_full_view,
+        inject_gossip,
+        run_ticks,
+    )
+    from scalecube_cluster_tpu.sim.state import seeds_mask
+
+    params = SimParams.from_cluster_config(n, fast_test_config())
+    plan = FaultPlan.clean(n).with_loss(loss_percent)
+    seeds = seeds_mask(n, [0])
+    curves = []
+    for trial in range(trials):
+        state = inject_gossip(init_full_view(n, seed=seed + trial), 0, 0)
+        _, traces = run_ticks(params, state, plan, seeds, periods)
+        curves.append(np.asarray(jnp.stack(traces["gossip_coverage"])[:, 0]))
+    return DisseminationCurve.summarize(np.mean(curves, axis=0))
+
+
+async def compare_dissemination(
+    n: int, loss_percent: float, periods: int, host_trials: int = 3
+) -> dict:
+    """Run both backends; return curves and completion stats for assertion."""
+    host_curves = []
+    for trial in range(host_trials):
+        c = await host_dissemination_curve(
+            n, loss_percent, periods, emulator_seed=100 * trial
+        )
+        host_curves.append(c.coverage)
+    host = DisseminationCurve.summarize(np.mean(host_curves, axis=0))
+    sim = sim_dissemination_curve(n, loss_percent, periods, trials=host_trials)
+    return {
+        "host": host,
+        "sim": sim,
+        "max_abs_gap": float(np.max(np.abs(host.coverage - sim.coverage))),
+        "mean_abs_gap": float(np.mean(np.abs(host.coverage - sim.coverage))),
+    }

@@ -1,0 +1,63 @@
+"""Sim-vs-host backend validation (SURVEY.md §7 stage 6).
+
+The acceptance shape comes from GossipProtocolTest.java:154-203: complete
+dissemination within the sweep deadline, measured curves logged against the
+analytic prediction. Here the assertion is cross-BACKEND: the TPU sim and the
+asyncio-TCP host runtime must produce matching dissemination dynamics for the
+same protocol constants, which is the BASELINE.json north-star check
+("convergence curves matching a Netty-backend run").
+
+Tolerances: both backends are stochastic (independent RNGs, real sockets on
+the host side), so trials are averaged and completion periods are compared
+within a small window rather than bit-exactly.
+"""
+
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu import cluster_math
+from scalecube_cluster_tpu.testlib.crossval import (
+    compare_dissemination,
+    sim_dissemination_curve,
+)
+from scalecube_cluster_tpu.testlib.fixtures import fast_test_config
+
+
+@pytest.mark.asyncio
+async def test_dissemination_matches_host_clean_network():
+    n, periods = 12, 16
+    result = await compare_dissemination(n, loss_percent=0.0, periods=periods)
+    host, sim = result["host"], result["sim"]
+    assert host.completion_period is not None, host.coverage
+    assert sim.completion_period is not None, sim.coverage
+    # Same dissemination speed: full coverage within a 3-period window.
+    assert abs(host.completion_period - sim.completion_period) <= 3, result
+    # Curves track each other on average.
+    assert result["mean_abs_gap"] <= 0.15, result
+
+
+@pytest.mark.asyncio
+async def test_dissemination_matches_host_lossy_network():
+    n, periods = 10, 24
+    result = await compare_dissemination(n, loss_percent=25.0, periods=periods)
+    host, sim = result["host"], result["sim"]
+    assert host.completion_period is not None, host.coverage
+    assert sim.completion_period is not None, sim.coverage
+    assert abs(host.completion_period - sim.completion_period) <= 4, result
+    assert result["mean_abs_gap"] <= 0.2, result
+
+
+def test_sim_dissemination_tracks_cluster_math():
+    """The sim's dissemination time obeys the ClusterMath estimate that the
+    reference logs its measurements against (GossipProtocolTest.java:176-203,
+    ClusterMath.java:77-79)."""
+    cfg = fast_test_config()
+    n = 50
+    curve = sim_dissemination_curve(n, loss_percent=0.0, periods=40, trials=3)
+    assert curve.completion_period is not None
+    expected = cluster_math.gossip_periods_to_spread(
+        cfg.gossip_config.gossip_repeat_mult, n
+    )
+    # Complete within the spread deadline, and not suspiciously instant.
+    assert curve.completion_period <= expected
+    assert curve.completion_period >= np.log2(n) - 2
