@@ -1,0 +1,17 @@
+"""Scenario runners for the BASELINE.json experiment configs."""
+
+from scalecube_cluster_tpu.experiments.scenarios import (
+    churn_benchmark,
+    join_scenario,
+    lossy_suspicion_scenario,
+    partition_recovery_scenario,
+    run_all,
+)
+
+__all__ = [
+    "churn_benchmark",
+    "join_scenario",
+    "lossy_suspicion_scenario",
+    "partition_recovery_scenario",
+    "run_all",
+]

@@ -1,0 +1,7 @@
+"""``python -m scalecube_cluster_tpu.experiments [small|large]``."""
+
+import sys
+
+from scalecube_cluster_tpu.experiments.scenarios import run_all
+
+run_all(sys.argv[1] if len(sys.argv) > 1 else "small")

@@ -1,0 +1,177 @@
+"""The BASELINE.json experiment grid as reproducible sim scenarios.
+
+BASELINE.json names five configs; the first (3-node Alice/Bob/Carol join over
+real sockets) lives in examples/cluster_join.py on the host backend, the
+other four run here on the sim engine at any scale:
+
+1. ``join_scenario``               — cold join of n members to s seeds
+   (cluster-testlib 100-member in-process cluster analog)
+2. ``lossy_suspicion_scenario``    — steady state under packet loss, counting
+   false deaths and refutations (1k @ 5% loss config)
+3. ``partition_recovery_scenario`` — network partition, suspicion-timeout
+   removal, SYNC anti-entropy heal (10k partition config)
+4. ``churn_benchmark``             — sustained join/leave churn per tick
+   (100k-member churn config; rate and n scale to the hardware)
+
+Each returns a metrics dict of plain floats/ints; ``run_all`` executes a
+hardware-appropriate grid and prints one JSON line per scenario (the
+array-native replacement for the reference's experiment logging,
+GossipProtocolTest.java:176-203).
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu.sim import (
+    FaultPlan,
+    SimParams,
+    init_full_view,
+    init_seeded,
+    kill,
+    restart,
+    run_chunked,
+    run_ticks,
+)
+from scalecube_cluster_tpu.sim.state import seeds_mask
+
+
+def _final(traces, key):
+    return float(np.asarray(jax.device_get(traces[key]))[-1])
+
+
+def join_scenario(n: int = 100, n_seeds: int = 1, max_ticks: int = 400) -> dict:
+    """Cold join: all members discover each other from the seeds."""
+    params = SimParams.from_cluster_config(n)
+    state = init_seeded(n, list(range(n_seeds)))
+    plan = FaultPlan.clean(n)
+    seeds = seeds_mask(n, list(range(n_seeds)))
+    state, traces = run_chunked(params, state, plan, seeds, max_ticks)
+    conv = np.asarray(jax.device_get(traces["convergence"]))
+    full = np.flatnonzero(conv >= 1.0)
+    return {
+        "scenario": "join",
+        "n": n,
+        "converged": bool(full.size),
+        "ticks_to_full_view": int(full[0]) if full.size else None,
+        "final_convergence": float(conv[-1]),
+    }
+
+
+def lossy_suspicion_scenario(
+    n: int = 1000, loss_percent: float = 5.0, ticks: int = 600
+) -> dict:
+    """Steady state under loss: suspicion churn must refute, never kill."""
+    params = SimParams.from_cluster_config(n)
+    state = init_full_view(n)
+    plan = FaultPlan.clean(n).with_loss(loss_percent)
+    state, traces = run_chunked(params, state, plan, seeds_mask(n, [0]), ticks)
+    status_dead_of_alive = jnp.sum(
+        ((state.view & (1 << 21)) != 0) & state.alive[None, :] & state.alive[:, None]
+    )
+    return {
+        "scenario": "lossy_suspicion",
+        "n": n,
+        "loss_percent": loss_percent,
+        "final_convergence": _final(traces, "convergence"),
+        "suspects_in_flight": int(_final(traces, "n_suspected")),
+        "false_deaths": int(status_dead_of_alive),
+        "refutations_max_incarnation": int(jax.device_get(state.inc_self).max()),
+    }
+
+
+def partition_recovery_scenario(n: int = 1000, minority_frac: float = 0.3) -> dict:
+    """Partition → suspicion-timeout removal → SYNC heal after reconnection."""
+    params = SimParams.from_cluster_config(n)
+    k = int(n * minority_frac)
+    side_a, side_b = list(range(k)), list(range(k, n))
+    state = init_full_view(n)
+    seeds = seeds_mask(n, [0, n - 1])  # a seed on each side
+    cut = FaultPlan.clean(n).partition(side_a, side_b)
+
+    # Cushion past the suspicion timeout: suspicion acceptance has a straggler
+    # tail (~2×spread, re-originated by each prober), then DEAD tombstones
+    # circulate for up to a sweep before expiring (measured at n=1000: full
+    # dead|unknown by suspicion + ~250 ticks).
+    hold = (
+        params.suspicion_ticks
+        + 2 * params.periods_to_spread
+        + params.periods_to_sweep
+        + 150
+    )
+    state, _ = run_chunked(params, state, cut, seeds, hold)
+    cross = jnp.asarray(jax.device_get(state.view))[:k, k:]
+    detected = bool(np.all((cross < 0) | ((cross & (1 << 21)) != 0)))
+
+    state, traces = run_chunked(
+        params, state, FaultPlan.clean(n), seeds, params.sync_period_ticks * 3 + 200
+    )
+    return {
+        "scenario": "partition_recovery",
+        "n": n,
+        "minority": k,
+        "partition_detected": detected,
+        "healed_convergence": _final(traces, "convergence"),
+    }
+
+
+def churn_benchmark(
+    n: int = 4096, churn_per_tick: int = 8, ticks: int = 400, seed: int = 0
+) -> dict:
+    """Sustained churn: every chunk of ticks, kill some members and restart
+    others (the 1%/tick join/leave config scaled to hardware)."""
+    params = SimParams.from_cluster_config(n)
+    state = init_full_view(n, seed=seed)
+    plan = FaultPlan.clean(n)
+    seeds = seeds_mask(n, [0, 1])
+    rng = np.random.default_rng(seed)
+    chunk = 20
+    down: set[int] = set()
+    for _ in range(ticks // chunk):
+        kills = rng.choice(
+            [i for i in range(2, n) if i not in down],
+            size=churn_per_tick,
+            replace=False,
+        )
+        state = kill(state, jnp.asarray(kills))
+        down.update(int(i) for i in kills)
+        revive = [i for i in list(down)[: churn_per_tick // 2]]
+        for i in revive:
+            state = restart(state, i)
+            down.discard(i)
+        state, traces = run_ticks(params, state, plan, seeds, chunk)  # fixed chunk: one compile
+    return {
+        "scenario": "churn",
+        "n": n,
+        "churned_down": len(down),
+        "final_convergence": _final(traces, "convergence"),
+        "max_epoch": int(jax.device_get(state.epoch).max()),
+    }
+
+
+def run_all(scale: str = "small") -> list[dict]:
+    """Run the grid. ``scale``: small (CI/CPU), large (one TPU chip)."""
+    if scale == "small":
+        grid = [
+            lambda: join_scenario(n=100),
+            lambda: lossy_suspicion_scenario(n=256, ticks=300),
+            lambda: partition_recovery_scenario(n=256),
+            lambda: churn_benchmark(n=256, churn_per_tick=2, ticks=200),
+        ]
+    else:
+        grid = [
+            lambda: join_scenario(n=1000),
+            lambda: lossy_suspicion_scenario(n=1000),
+            lambda: partition_recovery_scenario(n=10_000),
+            lambda: churn_benchmark(n=8192, churn_per_tick=16),
+        ]
+    results = []
+    for fn in grid:
+        result = fn()
+        print(json.dumps(result))
+        results.append(result)
+    return results

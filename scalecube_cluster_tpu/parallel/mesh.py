@@ -61,4 +61,4 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
 def shard_plan(plan: FaultPlan, mesh: Mesh) -> FaultPlan:
     """Fault matrices shard like the view: sender/viewer axis split."""
     row = NamedSharding(mesh, P(AXIS, None))
-    return jax.device_put(plan, FaultPlan(block=row, loss=row))
+    return jax.device_put(plan, FaultPlan(block=row, loss=row, mean_delay=row))

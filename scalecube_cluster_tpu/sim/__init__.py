@@ -26,7 +26,7 @@ from scalecube_cluster_tpu.sim.state import (
     update_metadata,
 )
 from scalecube_cluster_tpu.sim.tick import sim_tick
-from scalecube_cluster_tpu.sim.run import run_ticks, run_until
+from scalecube_cluster_tpu.sim.run import run_chunked, run_ticks, run_until
 
 __all__ = [
     "FaultPlan",
@@ -41,6 +41,7 @@ __all__ = [
     "load_checkpoint",
     "node_view",
     "restart",
+    "run_chunked",
     "run_ticks",
     "run_until",
     "save_checkpoint",

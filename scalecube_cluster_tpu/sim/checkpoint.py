@@ -9,7 +9,7 @@ content-addressable for regression triage.
 Format: one ``.npz`` per snapshot holding every array leaf plus the params
 dataclass as JSON — no framework-specific container, loadable anywhere numpy
 is. Determinism: state carries its PRNG key, so resume+run equals run-through
-exactly (asserted by tests/test_checkpoint.py).
+exactly (asserted by tests/test_sim_aux.py::test_checkpoint_roundtrip_is_exact).
 """
 
 from __future__ import annotations
@@ -27,9 +27,15 @@ from scalecube_cluster_tpu.sim.state import SimState
 _FIELDS = [f.name for f in dataclasses.fields(SimState)]
 
 
+def _normalize(path: str | Path) -> Path:
+    """np.savez appends '.npz' to suffix-less paths; keep load symmetric."""
+    path = Path(path)
+    return path if path.suffix == ".npz" else path.with_name(path.name + ".npz")
+
+
 def save_checkpoint(path: str | Path, state: SimState, params: SimParams) -> None:
     """Write ``state`` (+ its protocol constants) to ``path`` (.npz)."""
-    path = Path(path)
+    path = _normalize(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     arrays = {name: np.asarray(jax.device_get(getattr(state, name))) for name in _FIELDS}
     arrays["__params__"] = np.frombuffer(
@@ -40,7 +46,7 @@ def save_checkpoint(path: str | Path, state: SimState, params: SimParams) -> Non
 
 def load_checkpoint(path: str | Path) -> tuple[SimState, SimParams]:
     """Load a snapshot; arrays come back on the default device."""
-    with np.load(Path(path)) as data:
+    with np.load(_normalize(path)) as data:
         params = SimParams(**json.loads(bytes(data["__params__"]).decode()))
         state = SimState(**{name: jax.numpy.asarray(data[name]) for name in _FIELDS})
     return state, params

@@ -5,15 +5,28 @@ The host backend injects faults through a Transport decorator
 expresses the same per-link settings as dense matrices consulted at every
 delivery edge:
 
-- ``block[i, j]``  — directional hard block of link i→j
+- ``block[i, j]``      — directional hard block of link i→j
   (NetworkEmulator.blockOutbound/blockInbound, :87-138, 236-288)
-- ``loss[i, j]``   — probability a message on i→j is dropped
+- ``loss[i, j]``       — probability a message on i→j is dropped
   (OutboundSettings.evaluateLoss, :358-362)
+- ``mean_delay[i, j]`` — mean of the exponential per-message delay in ms
+  (OutboundSettings.evaluateDelay, :363-368)
 
-Delay emulation (exponential mean delay, :363-368) has no sub-tick meaning in
-a synchronous tick world; its observable effect at protocol granularity — a
-message missing its round's deadline — is expressible as extra loss, so the
-plan exposes loss/block only (deviation documented for the judge).
+Sub-tick delay has no direct meaning in a synchronous tick world; what the
+protocol can observe is a message missing a deadline. The only
+deadline-bearing exchange is the FD probe (ping round trip must beat
+pingTimeout, ping-req legs the remaining interval budget,
+FailureDetectorImpl.java:126-208), so the tick engine draws ONE in-time
+sample per probe path from the Erlang tail of the summed leg delays
+(:func:`round_trip_in_time`). Everything else is deadline-free in the
+reference too: gossip has no ack, and the periodic SYNC is a fire-and-forget
+``transport.send`` whose SYNC_ACK is processed whenever it arrives
+(doSync/onSyncAck, MembershipProtocolImpl.java:304-349; only start0's initial
+join sync awaits syncTimeout, which the sim's every-tick join retry
+supersedes). Deviation: a message delayed past its tick is dropped rather
+than delivered a tick late; senders re-gossip young rumors for
+periodsToSpread rounds, so the distinction does not surface in convergence
+curves.
 
 A plan is *static data* passed alongside the state; scenario scripts build new
 plans between runs (partitions, asymmetric links) exactly like the reference
@@ -37,6 +50,7 @@ class FaultPlan:
 
     block: jax.Array  # [N, N] bool
     loss: jax.Array  # [N, N] float32 in [0, 1)
+    mean_delay: jax.Array  # [N, N] float32 ms (0 = no delay)
 
     def replace(self, **changes) -> "FaultPlan":
         return dataclasses.replace(self, **changes)
@@ -47,11 +61,18 @@ class FaultPlan:
         return cls(
             block=jnp.zeros((n, n), bool),
             loss=jnp.zeros((n, n), jnp.float32),
+            mean_delay=jnp.zeros((n, n), jnp.float32),
         )
 
     def with_loss(self, percent: float) -> "FaultPlan":
         """Uniform loss on every link (setDefaultOutboundSettings, :189-199)."""
         return self.replace(loss=jnp.full_like(self.loss, percent / 100.0))
+
+    def with_mean_delay(self, mean_delay_ms: float) -> "FaultPlan":
+        """Uniform exponential delay on every link."""
+        return self.replace(
+            mean_delay=jnp.full_like(self.mean_delay, mean_delay_ms)
+        )
 
     def block_outbound(self, src, dst) -> "FaultPlan":
         """Block link(s) src→dst (blockOutbound, NetworkEmulator.java:87-110)."""
@@ -67,16 +88,54 @@ class FaultPlan:
         return self.replace(block=block)
 
 
-def link_pass(rng: jax.Array, plan: FaultPlan, src: jax.Array, dst: jax.Array) -> jax.Array:
+def link_pass(
+    rng: jax.Array, plan: FaultPlan, src: jax.Array, dst: jax.Array
+) -> jax.Array:
     """Sample delivery success for arbitrary directed links src[...]→dst[...].
 
-    The single source of truth for link-fault semantics: a message passes iff
-    the link is unblocked and survives the loss draw. ``src``/``dst`` are
-    broadcast-compatible int32 index arrays.
+    The single source of truth for loss/block semantics: a message passes iff
+    the link is unblocked and survives the loss draw. Deadline effects of
+    delay are a separate per-path draw (:func:`round_trip_in_time`).
+    ``src``/``dst`` are broadcast-compatible int32 index arrays.
     """
     blocked = plan.block[src, dst]
     loss = plan.loss[src, dst]
     u = jax.random.uniform(rng, jnp.shape(blocked))
     return ~blocked & (u >= loss)
+
+
+def round_trip_in_time(
+    rng: jax.Array,
+    plan: FaultPlan,
+    legs: list[tuple[jax.Array, jax.Array]],
+    deadline_ms: float,
+) -> jax.Array:
+    """One in-time draw per probe path: the SUMMED exponential delays of all
+    ``legs`` (a list of ``(src, dst)`` index pairs) must beat ``deadline_ms``.
+
+    This matches the host semantics where the whole ping→ack (or
+    ping-req→transit→ack→forward) round trip races one timer
+    (FailureDetectorImpl.java:126-208) — per-leg deadline draws would
+    systematically overestimate success. The sum of k exponentials is
+    Erlang(k) for equal means; for heterogeneous per-link means we use
+    Erlang with the mean of the leg means (exact in the uniform case the
+    emulator tests exercise, approximate otherwise):
+
+        P(miss) = e^(-x) * sum_{i<k} x^i / i!,   x = deadline / theta,
+        theta = (sum of leg mean delays) / k.
+    """
+    k = len(legs)
+    mean_total = sum(plan.mean_delay[s, d] for s, d in legs)
+    theta = mean_total / k
+    has_delay = theta > 0
+    x = deadline_ms / jnp.where(has_delay, theta, 1.0)
+    term = jnp.ones_like(x)
+    acc = jnp.ones_like(x)
+    for i in range(1, k):
+        term = term * x / i
+        acc = acc + term
+    p_miss = jnp.where(has_delay, jnp.exp(-x) * acc, 0.0)
+    u = jax.random.uniform(rng, jnp.shape(p_miss))
+    return u >= p_miss
 
 

@@ -38,6 +38,12 @@ class SimParams:
     suspicion_ticks: int = 150
     #: Indirect-probe relay count (FailureDetectorConfig.java:10).
     ping_req_members: int = 3
+    #: Direct-probe round-trip deadline in ms (pingTimeout,
+    #: FailureDetectorConfig.java:8-20) — only used against FaultPlan delays.
+    ping_timeout_ms: int = 500
+    #: Indirect-probe budget in ms (pingInterval - pingTimeout,
+    #: FailureDetectorImpl.java:160-208).
+    ping_req_timeout_ms: int = 500
     #: Number of user-gossip payload slots tracked by the sim.
     user_gossip_slots: int = 4
 
@@ -80,5 +86,7 @@ class SimParams:
                 // tick_ms,
             ),
             ping_req_members=fd.ping_req_members,
+            ping_timeout_ms=fd.ping_timeout,
+            ping_req_timeout_ms=max(1, fd.ping_interval - fd.ping_timeout),
             user_gossip_slots=user_gossip_slots,
         )

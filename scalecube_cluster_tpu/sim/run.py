@@ -42,6 +42,44 @@ def run_ticks(
     return lax.scan(step, state, None, length=n_ticks)
 
 
+def run_chunked(
+    params: SimParams,
+    state: SimState,
+    plan: FaultPlan,
+    seeds: jax.Array,
+    n_ticks: int,
+    chunk: int = 50,
+    collect: bool = True,
+):
+    """Run ``n_ticks`` in fixed-size scan chunks so every call reuses ONE
+    compiled executable per (params, chunk) — scan length is a static jit
+    argument, so varying tick counts would otherwise each pay a fresh
+    compile. Returns ``(final_state, traces)`` with traces concatenated and
+    trimmed to exactly ``n_ticks``; the state itself advances to the next
+    chunk boundary (ceil(n_ticks/chunk)·chunk ticks — the cluster simply
+    keeps running a few periods longer)."""
+    import numpy as np
+
+    if chunk <= 0:
+        raise ValueError("chunk must be positive")
+    if n_ticks <= 0:
+        return state, {}
+
+    pieces = []
+    done = 0
+    while done < n_ticks:
+        state, tr = run_ticks(params, state, plan, seeds, chunk, collect=collect)
+        take = min(chunk, n_ticks - done)
+        pieces.append(
+            jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a))[:take], tr)
+        )
+        done += take
+    traces = jax.tree_util.tree_map(
+        lambda *xs: np.concatenate(xs, axis=0), *pieces
+    )
+    return state, traces
+
+
 def run_until(
     params: SimParams,
     state: SimState,

@@ -76,7 +76,7 @@ from scalecube_cluster_tpu.ops.merge import (
     overrides_same_epoch,
 )
 from scalecube_cluster_tpu.ops.select import masked_random_choice, masked_random_topk
-from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass
+from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass, round_trip_in_time
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import AGE_STALE, SimState
 
@@ -132,21 +132,37 @@ def sim_tick(
         v_epoch = decode_epoch(vkey)
 
         probing = alive & tgt_valid
-        pk1, pk2 = jax.random.split(k_ping)
+        pk1, pk2, pk3 = jax.random.split(k_ping, 3)
         fwd_ok = link_pass(pk1, plan, i_idx, tgt)
         ack_ok = link_pass(pk2, plan, tgt, i_idx)
-        direct_reach = probing & alive[tgt] & fwd_ok & ack_ok
+        # The whole ping->ack round trip races one pingTimeout timer.
+        rt_ok = round_trip_in_time(
+            pk3, plan, [(i_idx, tgt), (tgt, i_idx)], params.ping_timeout_ms
+        )
+        direct_reach = probing & alive[tgt] & fwd_ok & ack_ok & rt_ok
 
         # Indirect probe via k relays: origin→relay→target→relay→origin, all
         # four legs sampled (onPingReq transit + onTransitPingAck forwarding,
         # FailureDetectorImpl.java:255-305).
         relay_cand = cand & (col[None, :] != tgt[:, None])
-        kr1, rk1, rk2, rk3, rk4 = jax.random.split(k_relay, 5)
+        kr1, rk1, rk2, rk3, rk4, rk5 = jax.random.split(k_relay, 6)
         ridx, rvalid = masked_random_topk(kr1, relay_cand, params.ping_req_members)
-        leg_or = link_pass(rk1, plan, i_idx[:, None], ridx)  # origin→relay
-        leg_rt = link_pass(rk2, plan, ridx, tgt[:, None])  # relay→target
-        leg_tr = link_pass(rk3, plan, tgt[:, None], ridx)  # target→relay
-        leg_ro = link_pass(rk4, plan, ridx, i_idx[:, None])  # relay→origin
+        leg_or = link_pass(rk1, plan, i_idx[:, None], ridx)  # origin->relay
+        leg_rt = link_pass(rk2, plan, ridx, tgt[:, None])  # relay->target
+        leg_tr = link_pass(rk3, plan, tgt[:, None], ridx)  # target->relay
+        leg_ro = link_pass(rk4, plan, ridx, i_idx[:, None])  # relay->origin
+        # All four legs race the remaining interval budget together.
+        path_ok = round_trip_in_time(
+            rk5,
+            plan,
+            [
+                (i_idx[:, None], ridx),
+                (ridx, tgt[:, None]),
+                (tgt[:, None], ridx),
+                (ridx, i_idx[:, None]),
+            ],
+            params.ping_req_timeout_ms,
+        )
         relay_reach = (
             rvalid
             & alive[ridx]
@@ -155,6 +171,7 @@ def sim_tick(
             & leg_rt
             & leg_tr
             & leg_ro
+            & path_ok
         )
         reached = direct_reach | (probing & jnp.any(relay_reach, axis=1))
 

@@ -22,6 +22,7 @@ import dataclasses
 import numpy as np
 
 from scalecube_cluster_tpu.testlib.fixtures import (
+    await_until,
     fast_test_config,
     shutdown_all,
     start_node,
@@ -63,11 +64,10 @@ async def host_dissemination_curve(
     nodes = [seed, *others]
     try:
         # Wait for full membership before injecting (the reference's join
-        # phase, ClusterTest.java:88-114).
-        for _ in range(200):
-            if all(len(c.members()) == n for c in nodes):
-                break
-            await asyncio.sleep(0.05)
+        # phase, ClusterTest.java:88-114); fail loudly on a partial join.
+        await await_until(
+            lambda: all(len(c.members()) == n for c in nodes), timeout=20.0
+        )
 
         got = [False] * n
         got[0] = True

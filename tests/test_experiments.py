@@ -1,0 +1,14 @@
+"""The BASELINE.json scenario grid runs end-to-end at CI scale."""
+
+from scalecube_cluster_tpu.experiments import run_all
+
+
+def test_small_grid_passes():
+    results = {r["scenario"]: r for r in run_all("small")}
+
+    assert results["join"]["converged"]
+    assert results["lossy_suspicion"]["false_deaths"] == 0
+    assert results["lossy_suspicion"]["final_convergence"] > 0.95
+    assert results["partition_recovery"]["partition_detected"]
+    assert results["partition_recovery"]["healed_convergence"] == 1.0
+    assert results["churn"]["final_convergence"] > 0.9

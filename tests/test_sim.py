@@ -195,6 +195,31 @@ def test_user_gossip_under_loss():
     assert float(tr["gossip_coverage"][-1, 1]) == 1.0
 
 
+def test_delay_below_deadline_harmless_above_fatal():
+    """FailureDetectorTest.java:149-177: mean delay well under the ping
+    deadline leaves everyone ALIVE; delay far beyond it makes probe round
+    trips miss their timer and drives SUSPECT verdicts."""
+    n = 12
+    # ping_timeout 500ms (LAN default): mild 20ms mean delay never misses.
+    p = small_params(n, suspicion_ticks=10_000)  # isolate FD verdicts
+    sm = seeds_mask(n, [0])
+
+    mild = FaultPlan.clean(n).with_mean_delay(20.0)
+    st = init_full_view(n, user_gossip_slots=2)
+    st, tr = run_ticks(p, st, mild, sm, 80)
+    assert int(tr["n_suspected"][-1]) == 0
+
+    # Erlang-2 tail at x=500/2000: ~97% of ping round trips miss the timer.
+    heavy = FaultPlan.clean(n).with_mean_delay(2000.0)
+    st = init_full_view(n, user_gossip_slots=2)
+    st, tr = run_ticks(p, st, heavy, sm, 80)
+    assert int(tr["n_suspected"][-1]) > n  # widespread missed deadlines
+    # ...but gossip (no deadline) still disseminates fine.
+    st = inject_gossip(st, 0, 0)
+    st, tr = run_ticks(p, st, heavy, sm, 25)
+    assert float(tr["gossip_coverage"][-1, 0]) == 1.0
+
+
 def test_determinism():
     n = 16
     p = small_params(n)
