@@ -27,6 +27,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from scalecube_cluster_tpu.cluster_api.member import MemberStatus
+from scalecube_cluster_tpu.ops.merge import decode_status
 from scalecube_cluster_tpu.sim import (
     FaultPlan,
     SimParams,
@@ -71,7 +73,9 @@ def lossy_suspicion_scenario(
     plan = FaultPlan.clean(n).with_loss(loss_percent)
     state, traces = run_chunked(params, state, plan, seeds_mask(n, [0]), ticks)
     status_dead_of_alive = jnp.sum(
-        ((state.view & (1 << 21)) != 0) & state.alive[None, :] & state.alive[:, None]
+        (decode_status(state.view) == int(MemberStatus.DEAD))
+        & state.alive[None, :]
+        & state.alive[:, None]
     )
     return {
         "scenario": "lossy_suspicion",
@@ -104,8 +108,12 @@ def partition_recovery_scenario(n: int = 1000, minority_frac: float = 0.3) -> di
         + 150
     )
     state, _ = run_chunked(params, state, cut, seeds, hold)
-    cross = jnp.asarray(jax.device_get(state.view))[:k, k:]
-    detected = bool(np.all((cross < 0) | ((cross & (1 << 21)) != 0)))
+    cross = np.asarray(jax.device_get(decode_status(state.view)))[:k, k:]
+    detected = bool(
+        np.all(
+            (cross == int(MemberStatus.DEAD)) | (cross == int(MemberStatus.UNKNOWN))
+        )
+    )
 
     state, traces = run_chunked(
         params, state, FaultPlan.clean(n), seeds, params.sync_period_ticks * 3 + 200
@@ -120,26 +128,28 @@ def partition_recovery_scenario(n: int = 1000, minority_frac: float = 0.3) -> di
 
 
 def churn_benchmark(
-    n: int = 4096, churn_per_tick: int = 8, ticks: int = 400, seed: int = 0
+    n: int = 4096, churn_per_chunk: int = 8, ticks: int = 400, seed: int = 0
 ) -> dict:
-    """Sustained churn: every chunk of ticks, kill some members and restart
-    others (the 1%/tick join/leave config scaled to hardware)."""
+    """Sustained churn: every 20-tick chunk, kill ``churn_per_chunk`` members
+    and restart half as many (the BASELINE churn config scaled to hardware)."""
     params = SimParams.from_cluster_config(n)
     state = init_full_view(n, seed=seed)
     plan = FaultPlan.clean(n)
     seeds = seeds_mask(n, [0, 1])
     rng = np.random.default_rng(seed)
     chunk = 20
+    if ticks < chunk:
+        raise ValueError(f"ticks must be >= {chunk}")
     down: set[int] = set()
     for _ in range(ticks // chunk):
         kills = rng.choice(
             [i for i in range(2, n) if i not in down],
-            size=churn_per_tick,
+            size=churn_per_chunk,
             replace=False,
         )
         state = kill(state, jnp.asarray(kills))
         down.update(int(i) for i in kills)
-        revive = [i for i in list(down)[: churn_per_tick // 2]]
+        revive = [i for i in list(down)[: churn_per_chunk // 2]]
         for i in revive:
             state = restart(state, i)
             down.discard(i)
@@ -155,19 +165,21 @@ def churn_benchmark(
 
 def run_all(scale: str = "small") -> list[dict]:
     """Run the grid. ``scale``: small (CI/CPU), large (one TPU chip)."""
+    if scale not in ("small", "large"):
+        raise ValueError(f"unknown scale {scale!r}; use 'small' or 'large'")
     if scale == "small":
         grid = [
             lambda: join_scenario(n=100),
             lambda: lossy_suspicion_scenario(n=256, ticks=300),
             lambda: partition_recovery_scenario(n=256),
-            lambda: churn_benchmark(n=256, churn_per_tick=2, ticks=200),
+            lambda: churn_benchmark(n=256, churn_per_chunk=2, ticks=200),
         ]
     else:
         grid = [
             lambda: join_scenario(n=1000),
             lambda: lossy_suspicion_scenario(n=1000),
             lambda: partition_recovery_scenario(n=10_000),
-            lambda: churn_benchmark(n=8192, churn_per_tick=16),
+            lambda: churn_benchmark(n=8192, churn_per_chunk=16),
         ]
     results = []
     for fn in grid:

@@ -1,0 +1,81 @@
+"""Pallas TPU kernel for the fused two-channel permutation delivery.
+
+Why a kernel: the XLA lowering of the delivery row-gathers
+(`rows[inv_perm[c]]`, ops/delivery.py) measures at ~160 GB/s effective on a
+v5e chip — latency-bound row DMAs with no overlap of the channel maxes
+(PERF.md "Where the time goes"). This kernel walks receivers as the grid,
+letting the Pallas pipeline double-buffer the three dynamically-indexed
+source-row DMAs (scalar-prefetched ``inv_perm`` feeds the BlockSpec index
+maps) while the VPU folds both channel maxes in VMEM — one pass, no
+intermediate [N, M] materializations.
+
+Semantics are identical to ``permuted_delivery_two_channel`` with the
+``is_alive_key`` channel-2 mask (asserted bit-for-bit by
+tests/test_pallas_delivery.py); the sim engine switches between the two
+implementations on ``SimParams.pallas_delivery``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from scalecube_cluster_tpu.ops.merge import is_alive_key
+
+
+def _kernel_factory(f: int, m: int):
+    def kernel(inv_ref, ok_ref, *refs):
+        del inv_ref  # consumed by the BlockSpec index maps
+        row_refs = refs[:f]
+        any_ref, alive_ref = refs[f], refs[f + 1]
+        i = pl.program_id(0)
+        best_any = jnp.full((1, m), -1, jnp.int32)
+        best_alive = best_any
+        for c in range(f):
+            contrib = jnp.where(ok_ref[c, i] == 1, row_refs[c][...], -1)
+            best_any = jnp.maximum(best_any, contrib)
+            best_alive = jnp.maximum(
+                best_alive, jnp.where(is_alive_key(contrib), contrib, -1)
+            )
+        any_ref[...] = best_any
+        alive_ref[...] = best_alive
+
+    return kernel
+
+
+def permuted_delivery_two_channel_pallas(rows, inv_perm, edge_ok, interpret=None):
+    """Drop-in for ``permuted_delivery_two_channel(rows, is_alive_key, ...)``.
+
+    Args:
+      rows: ``[N, M]`` int32 payloads (-1 = nothing).
+      inv_perm: ``[f, N]`` int32 — receiver j's c-th sender.
+      edge_ok: ``[f, N]`` bool — edge delivers.
+      interpret: force interpreter mode (None = interpret off-TPU).
+
+    Returns:
+      ``(best_any, best_alive)`` int32 ``[N, M]``.
+    """
+    n, m = rows.shape
+    f = inv_perm.shape[0]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def src_map(c):
+        return lambda i, inv_ref, ok_ref: (inv_ref[c, i], 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(n,),
+        in_specs=[pl.BlockSpec((1, m), src_map(c)) for c in range(f)],
+        out_specs=[
+            pl.BlockSpec((1, m), lambda i, inv_ref, ok_ref: (i, 0)) for _ in range(2)
+        ],
+    )
+    return pl.pallas_call(
+        _kernel_factory(f, m),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((n, m), jnp.int32)] * 2,
+        interpret=interpret,
+    )(inv_perm, edge_ok.astype(jnp.int32), *([rows] * f))

@@ -9,9 +9,16 @@ around the delivery scatters — the DP/SP analog called out in SURVEY.md §2.10
 
 from scalecube_cluster_tpu.parallel.mesh import (
     make_mesh,
+    make_mesh2d,
     shard_plan,
     shard_state,
     state_shardings,
 )
 
-__all__ = ["make_mesh", "shard_plan", "shard_state", "state_shardings"]
+__all__ = [
+    "make_mesh",
+    "make_mesh2d",
+    "shard_plan",
+    "shard_state",
+    "state_shardings",
+]

@@ -26,6 +26,9 @@ from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.state import SimState
 
 AXIS = "members"
+#: Second mesh axis of :func:`make_mesh2d`: shards the SUBJECT (column) axis
+#: of the [viewer, subject] matrices — the SP×TP analog of SURVEY.md §2.10.
+SUBJECT_AXIS = "subjects"
 
 
 def make_mesh(devices=None) -> Mesh:
@@ -34,11 +37,36 @@ def make_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (AXIS,))
 
 
+def make_mesh2d(shape: tuple[int, int], devices=None) -> Mesh:
+    """Two-axis mesh: viewers × subjects.
+
+    Splits both dimensions of every [N, N] state matrix, so per-device memory
+    scales 1/(dm·ds) — the layout for member counts whose full rows no longer
+    fit one device (100k: 40 GB of view). Row-gathers in delivery become
+    member-axis all-to-alls; per-viewer reductions (candidate counts,
+    convergence) ride subject-axis psums — all inserted by XLA from these
+    annotations.
+    """
+    devices = jax.devices() if devices is None else devices
+    dm, ds = shape
+    return Mesh(np.asarray(devices[: dm * ds]).reshape(dm, ds), (AXIS, SUBJECT_AXIS))
+
+
+def _specs(mesh: Mesh) -> tuple[P, P, P]:
+    """(matrix, member-vector, replicated) PartitionSpecs for this mesh."""
+    two_d = SUBJECT_AXIS in mesh.axis_names
+    mat = P(AXIS, SUBJECT_AXIS) if two_d else P(AXIS, None)
+    return mat, P(AXIS), P()
+
+
 def state_shardings(mesh: Mesh) -> SimState:
-    """A SimState-shaped pytree of NamedShardings (viewer axis sharded)."""
-    row = NamedSharding(mesh, P(AXIS, None))
-    vec = NamedSharding(mesh, P(AXIS))
-    rep = NamedSharding(mesh, P())
+    """A SimState-shaped pytree of NamedShardings for a 1D or 2D mesh."""
+    mat, vec_p, rep_p = _specs(mesh)
+    row = NamedSharding(mesh, mat)
+    # [N, G] user-gossip arrays keep G tiny — shard viewers only.
+    srow = NamedSharding(mesh, P(AXIS, None))
+    vec = NamedSharding(mesh, vec_p)
+    rep = NamedSharding(mesh, rep_p)
     return SimState(
         view=row,
         rumor_age=row,
@@ -46,8 +74,8 @@ def state_shardings(mesh: Mesh) -> SimState:
         inc_self=vec,
         epoch=vec,
         alive=vec,
-        useen=row,
-        uage=row,
+        useen=srow,
+        uage=srow,
         tick=rep,
         rng=rep,
     )
@@ -59,6 +87,7 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
 
 
 def shard_plan(plan: FaultPlan, mesh: Mesh) -> FaultPlan:
-    """Fault matrices shard like the view: sender/viewer axis split."""
-    row = NamedSharding(mesh, P(AXIS, None))
+    """Fault matrices shard like the view matrices."""
+    mat, _, _ = _specs(mesh)
+    row = NamedSharding(mesh, mat)
     return jax.device_put(plan, FaultPlan(block=row, loss=row, mean_delay=row))

@@ -46,6 +46,9 @@ class SimParams:
     ping_req_timeout_ms: int = 500
     #: Number of user-gossip payload slots tracked by the sim.
     user_gossip_slots: int = 4
+    #: Use the fused Pallas delivery kernel (ops/pallas_delivery.py) instead
+    #: of the XLA gather path. Off-TPU it runs interpreted (slow; tests only).
+    pallas_delivery: bool = False
 
     def __post_init__(self):
         # Dtype envelopes of the state arrays (sim/state.py): rumor_age is

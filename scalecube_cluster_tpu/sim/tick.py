@@ -228,9 +228,18 @@ def sim_tick(
 
     age0 = jnp.where(changed, 0, state.rumor_age)
     rows = jnp.where(age0 < params.periods_to_spread, view1, UNKNOWN_KEY)
-    best_any, best_alive = permuted_delivery_two_channel(
-        rows, is_alive_key, inv_perm, edge_ok
-    )
+    if params.pallas_delivery:
+        from scalecube_cluster_tpu.ops.pallas_delivery import (
+            permuted_delivery_two_channel_pallas,
+        )
+
+        best_any, best_alive = permuted_delivery_two_channel_pallas(
+            rows, inv_perm, edge_ok
+        )
+    else:
+        best_any, best_alive = permuted_delivery_two_channel(
+            rows, is_alive_key, inv_perm, edge_ok
+        )
 
     # ------------------------------------------------- 4. SYNC anti-entropy
     # Nodes that know nobody (fresh joiners/restarts) retry every tick — the

@@ -225,3 +225,61 @@ async def test_suspected_lists_in_monitor():
         )
     finally:
         await shutdown_all(seed, a)
+
+
+@pytest.mark.asyncio
+async def test_external_address_override_advertised():
+    """memberHost/memberPort override what the local member advertises
+    (ClusterImpl.java:277-288; MembershipProtocolTest.java:555-595)."""
+    cfg = fast_test_config(external_host="10.10.10.10", external_port=4242)
+    node = await start_node(cfg)
+    try:
+        assert node.member().address.host == "10.10.10.10"
+        assert node.member().address.port == 4242
+    finally:
+        await shutdown_all(node)
+
+
+@pytest.mark.asyncio
+async def test_asymmetric_inbound_block_recovers():
+    """Blocking only B's INBOUND links makes the others suspect it while its
+    own outbound sync keeps fighting back; unblocking restores full views
+    (the asymmetric scenarios of MembershipProtocolTest.java:598-918)."""
+    seed = await start_node()
+    a = await start_node(seeds=(seed.address,))
+    b = await start_node(seeds=(seed.address,))
+    try:
+        await await_until(lambda: views_converged([seed, a, b], 3), timeout=10)
+
+        b.network_emulator.block_all_inbound()
+        await await_until(
+            lambda: len(seed.monitor().suspected_members) > 0, timeout=10
+        )
+
+        b.network_emulator.unblock_all_inbound()
+        await await_until(
+            lambda: views_converged([seed, a, b], 3)
+            and not seed.monitor().suspected_members,
+            timeout=15,
+        )
+    finally:
+        await shutdown_all(seed, a, b)
+
+
+@pytest.mark.asyncio
+async def test_removed_history_ring():
+    """Removed members are retained in the monitor's bounded history ring
+    (MembershipProtocolImpl.java:732-791 keeps the last 42)."""
+    seed = await start_node()
+    a = await start_node(seeds=(seed.address,))
+    try:
+        await await_until(lambda: views_converged([seed, a], 2), timeout=10)
+        gone_id = a.member().id
+        await a.shutdown()
+        await await_until(
+            lambda: gone_id in {m.id for m in seed.monitor().removed_members},
+            timeout=10,
+        )
+        assert len(seed.monitor().removed_members) <= 42
+    finally:
+        await shutdown_all(seed, a)

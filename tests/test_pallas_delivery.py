@@ -1,0 +1,55 @@
+"""The fused Pallas delivery kernel is bit-equivalent to the XLA path.
+
+Runs interpreted on the CPU test backend (pallas_guide.md interpret mode);
+the performance claim is validated on the TPU chip by bench.py with
+SimParams.pallas_delivery=True.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu.ops.delivery import (
+    fanout_permutations,
+    permuted_delivery_two_channel,
+)
+from scalecube_cluster_tpu.ops.merge import is_alive_key
+from scalecube_cluster_tpu.ops.pallas_delivery import (
+    permuted_delivery_two_channel_pallas,
+)
+from scalecube_cluster_tpu.sim import FaultPlan, init_full_view, kill, run_ticks
+from scalecube_cluster_tpu.sim.state import seeds_mask
+from tests.test_sim import small_params
+
+
+def test_kernel_matches_xla_path():
+    n, m, f = 96, 80, 3
+    rows = jax.random.randint(jax.random.PRNGKey(0), (n, m), -1, 1 << 22, jnp.int32)
+    # Include rows of pure -1 (nothing to send) and full edges-off columns.
+    rows = rows.at[5].set(-1)
+    _, inv = fanout_permutations(jax.random.PRNGKey(1), n, f)
+    ok = jax.random.bernoulli(jax.random.PRNGKey(2), 0.7, (f, n))
+    ok = ok.at[:, 9].set(False)
+
+    a_ref, b_ref = permuted_delivery_two_channel(rows, is_alive_key, inv, ok)
+    a_ker, b_ker = permuted_delivery_two_channel_pallas(rows, inv, ok)
+    assert bool(jnp.all(a_ref == a_ker))
+    assert bool(jnp.all(b_ref == b_ker))
+
+
+def test_sim_tick_equal_with_kernel():
+    """Whole-tick trajectories agree between delivery implementations."""
+    n = 32
+    p = small_params(n)
+    p_pallas = dataclasses.replace(p, pallas_delivery=True)
+    plan, sm = FaultPlan.clean(n).with_loss(10.0), seeds_mask(n, [0])
+
+    st = kill(init_full_view(n, user_gossip_slots=2, seed=11), 3)
+    ref, tr_ref = run_ticks(p, st, plan, sm, 25)
+
+    st = kill(init_full_view(n, user_gossip_slots=2, seed=11), 3)
+    out, tr_ker = run_ticks(p_pallas, st, plan, sm, 25)
+
+    assert bool(jnp.all(ref.view == out.view))
+    assert bool(jnp.all(tr_ref["convergence"] == tr_ker["convergence"]))

@@ -266,3 +266,29 @@ def test_diagonal_invariant():
     st, _ = run_ticks(p, st, plan, seeds_mask(n, [0]), 150)
     diag_status = jnp.diagonal(statuses(st))
     assert bool(jnp.all(jnp.where(st.alive, diag_status == ALIVE, True)))
+
+
+@pytest.mark.parametrize("shape", [(4, 2), (2, 4)])
+def test_mesh2d_equals_single(shape):
+    """Viewer×subject 2D sharding must be bit-identical to single-device —
+    the 100k layout where full rows no longer fit one chip (PERF.md)."""
+    from scalecube_cluster_tpu.parallel import make_mesh2d
+
+    n = 32
+    p = small_params(n)
+    plan, sm = FaultPlan.clean(n).with_loss(15.0), seeds_mask(n, [0])
+
+    st = kill(init_full_view(n, user_gossip_slots=2, seed=7), 4)
+    ref, tr_ref = run_ticks(p, st, plan, sm, 60)
+
+    mesh = make_mesh2d(shape)
+    st_sh = shard_state(kill(init_full_view(n, user_gossip_slots=2, seed=7), 4), mesh)
+    plan_sh = shard_plan(plan, mesh)
+    out, tr_sh = run_ticks(p, st_sh, plan_sh, sm, 60)
+
+    assert bool(jnp.all(jax.device_get(out.view) == jax.device_get(ref.view)))
+    assert bool(
+        jnp.all(
+            jax.device_get(tr_sh["convergence"]) == jax.device_get(tr_ref["convergence"])
+        )
+    )
