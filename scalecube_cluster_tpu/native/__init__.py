@@ -1,0 +1,112 @@
+"""Native runtime components with pure-Python fallbacks.
+
+The compute path of this framework is JAX/XLA (sim/, ops/); the runtime
+around it follows the reference's shape, where the wire hot path is Netty's
+native-backed frame pipeline (TransportImpl.java:383-397). ``framing.c`` is
+that component for the asyncio backend — compiled on first use with the
+toolchain baked into the image, falling back to a bit-identical pure-Python
+implementation when no compiler is available. Both expose:
+
+  encode(payload: bytes, max_frame: int) -> bytes
+  FrameAccumulator(max_frame).feed(chunk) -> list[bytes]   # raises ValueError
+                                                           # on oversized frames
+
+``load_framing()`` returns the module in use; ``USING_NATIVE`` records which.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import logging
+import struct
+import subprocess
+import sysconfig
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct(">I")
+
+
+def py_encode(payload: bytes, max_frame: int) -> bytes:
+    """Pure-Python twin of _framing.encode."""
+    if len(payload) > max_frame:
+        raise ValueError(
+            f"frame of {len(payload)} bytes exceeds max_frame {max_frame}"
+        )
+    return _LEN.pack(len(payload)) + payload
+
+
+class PyFrameAccumulator:
+    """Pure-Python twin of _framing.FrameAccumulator."""
+
+    def __init__(self, max_frame: int = 2 * 1024 * 1024):
+        if max_frame <= 0:
+            raise ValueError("max_frame must be positive")
+        self._max = max_frame
+        self._buf = bytearray()
+
+    def feed(self, chunk: bytes) -> list[bytes]:
+        self._buf += chunk
+        frames: list[bytes] = []
+        pos = 0
+        buf = self._buf
+        while len(buf) - pos >= 4:
+            (flen,) = _LEN.unpack_from(buf, pos)
+            if flen > self._max:
+                raise ValueError(
+                    f"frame of {flen} bytes exceeds max_frame {self._max}"
+                )
+            if len(buf) - pos - 4 < flen:
+                break
+            frames.append(bytes(buf[pos + 4 : pos + 4 + flen]))
+            pos += 4 + flen
+        del buf[:pos]
+        return frames
+
+    def pending(self) -> int:
+        return len(self._buf)
+
+
+def _build_native():
+    src = Path(__file__).with_name("framing.c")
+    build_dir = Path(__file__).with_name("_build")
+    build_dir.mkdir(exist_ok=True)
+    so_path = build_dir / "_framing.so"
+    if not so_path.exists() or so_path.stat().st_mtime < src.stat().st_mtime:
+        include = sysconfig.get_paths()["include"]
+        subprocess.run(
+            [
+                "cc",
+                "-O2",
+                "-shared",
+                "-fPIC",
+                f"-I{include}",
+                str(src),
+                "-o",
+                str(so_path),
+            ],
+            check=True,
+            capture_output=True,
+        )
+    spec = importlib.util.spec_from_file_location("_framing", so_path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+try:
+    _framing = _build_native()
+    encode = _framing.encode
+    FrameAccumulator = _framing.FrameAccumulator
+    USING_NATIVE = True
+except Exception:  # pragma: no cover - toolchain-dependent
+    logger.info("native framing unavailable; using pure-Python fallback")
+    encode = py_encode
+    FrameAccumulator = PyFrameAccumulator
+    USING_NATIVE = False
+
+
+def load_framing():
+    """(encode, FrameAccumulator, is_native) actually in use."""
+    return encode, FrameAccumulator, USING_NATIVE
