@@ -7,37 +7,59 @@ up. One tick advances every member one gossip round (plus the FD/SYNC work on
 their cadence), so throughput = n_members × ticks/sec, measured against the
 driver's north-star 1M member-gossip-rounds/sec (BASELINE.json north_star).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Hardened per VERDICT.md round-1 item 1: this script ALWAYS prints exactly one
+JSON line on stdout, no matter what the TPU tunnel does.
+
+- A tiny probe op with a hard deadline runs first, retried with backoff; if
+  the backend never comes up, the JSON line carries an ``"error"`` field.
+- Each measured config runs in a subprocess with its own deadline, so a
+  mid-dispatch hang (the round-1 failure mode: BENCH_r01.json rc=1, later
+  re-runs hanging >4 min) is converted into a fallback down an n-ladder.
+- Timing syncs via a host fetch of the tick counter — jax.block_until_ready
+  can report ready prematurely over this box's tunneled-TPU transport.
+
+Usage: ``python bench.py`` (driver mode — one JSON line) or
+``python bench.py --child <n> <pallas>`` (internal single-config worker).
 """
 
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import jax
-
 BASELINE_MEMBER_ROUNDS_PER_SEC = 1_000_000.0
+#: Largest-first ladder of member counts; first one that lands a number wins.
+N_LADDER = (10240, 4096, 1024)
+PROBE_DEADLINE_S = 120
+PROBE_RETRIES = 3
+CHILD_DEADLINE_S = 420
 
 
-def bench(n_members: int = 10240, chunk: int = 40, reps: int = 4) -> dict:
+def _measure(n_members: int, pallas: bool, chunk: int = 40, reps: int = 4) -> dict:
+    """Run the sim benchmark in-process and return the result dict."""
     from scalecube_cluster_tpu.sim import FaultPlan, SimParams, init_full_view, run_ticks
     from scalecube_cluster_tpu.sim.state import seeds_mask
 
     params = SimParams.from_cluster_config(n_members)
+    if pallas:
+        import dataclasses
+
+        params = dataclasses.replace(params, pallas_delivery=True)
     state = init_full_view(n_members)
     plan = FaultPlan.clean(n_members).with_loss(5.0)
     seeds = seeds_mask(n_members, [0, 1])
 
-    # Warmup: compile + reach protocol steady state. NOTE: timings sync via a
-    # host fetch of the tick counter — jax.block_until_ready can report ready
-    # prematurely over this box's tunneled-TPU transport.
-    state, traces = run_ticks(params, state, plan, seeds, chunk, collect=False)
+    # Warmup: compile + reach protocol steady state. int() is the host fetch
+    # that actually synchronizes (see module docstring).
+    state, _ = run_ticks(params, state, plan, seeds, chunk, collect=False)
     int(state.tick)
 
     t0 = time.perf_counter()
     for _ in range(reps):
-        state, traces = run_ticks(params, state, plan, seeds, chunk, collect=False)
+        state, _ = run_ticks(params, state, plan, seeds, chunk, collect=False)
         int(state.tick)
     dt = time.perf_counter() - t0
 
@@ -50,5 +72,88 @@ def bench(n_members: int = 10240, chunk: int = 40, reps: int = 4) -> dict:
     }
 
 
+def _probe() -> str | None:
+    """Fail-fast backend check: tiny op in a subprocess under a deadline.
+
+    Returns None when the backend is usable, else the failure description.
+    """
+    code = (
+        "import jax, jax.numpy as jnp, numpy as np;"
+        "x = jnp.arange(64, dtype=jnp.int32);"
+        "print(int(np.asarray(x.sum())))"
+    )
+    err = "probe never ran"
+    for attempt in range(PROBE_RETRIES):
+        try:
+            res = subprocess.run(
+                [sys.executable, "-c", code],
+                capture_output=True,
+                text=True,
+                timeout=PROBE_DEADLINE_S,
+            )
+            if res.returncode == 0 and res.stdout.strip().endswith("2016"):
+                return None
+            err = f"probe rc={res.returncode}: {res.stderr.strip()[-300:]}"
+        except subprocess.TimeoutExpired:
+            err = f"probe timed out after {PROBE_DEADLINE_S}s"
+        time.sleep(2**attempt)
+    return err
+
+
+def _run_child(n: int, pallas: bool) -> dict | None:
+    """One measured config in a subprocess with a hard deadline.
+
+    A fresh process per config also isolates backend state, so a wedged TPU
+    dispatch can only cost this config, not the whole benchmark.
+    """
+    try:
+        res = subprocess.run(
+            [sys.executable, __file__, "--child", str(n), str(int(pallas))],
+            capture_output=True,
+            text=True,
+            timeout=CHILD_DEADLINE_S,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if res.returncode != 0:
+        return None
+    for line in reversed(res.stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                return None
+    return None
+
+
+def main() -> None:
+    result = None
+    err = _probe()
+    if err is None:
+        for n in N_LADDER:
+            result = _run_child(n, pallas=True)
+            if result is None:
+                # Pallas path wedged or failed to lower: same n, XLA path.
+                result = _run_child(n, pallas=False)
+            if result is not None:
+                break
+        if result is None:
+            err = "all benchmark configs failed or timed out"
+    if result is None:
+        result = {
+            "metric": "member_gossip_rounds_per_sec",
+            "value": 0.0,
+            "unit": "member·rounds/s",
+            "vs_baseline": 0.0,
+            "error": err,
+        }
+    print(json.dumps(result), flush=True)
+
+
 if __name__ == "__main__":
-    print(json.dumps(bench()))
+    if len(sys.argv) == 4 and sys.argv[1] == "--child":
+        print(json.dumps(_measure(int(sys.argv[2]), bool(int(sys.argv[3])))))
+    else:
+        os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
+        main()
