@@ -21,6 +21,7 @@ typedef struct {
     Py_ssize_t len;
     Py_ssize_t cap;
     Py_ssize_t max_frame;
+    Py_ssize_t poisoned; /* oversized frame length; 0 = healthy */
 } Accum;
 
 static uint32_t read_be32(const uint8_t *p) {
@@ -41,6 +42,7 @@ static int accum_init(Accum *self, PyObject *args, PyObject *kwds) {
     self->len = 0;
     self->cap = 0;
     self->max_frame = max_frame;
+    self->poisoned = 0;
     return 0;
 }
 
@@ -51,6 +53,12 @@ static void accum_dealloc(Accum *self) {
 
 static PyObject *accum_feed(Accum *self, PyObject *arg) {
     Py_buffer view;
+    if (self->poisoned) {
+        PyErr_Format(PyExc_ValueError,
+                     "frame of %zd bytes exceeds max_frame %zd",
+                     self->poisoned, self->max_frame);
+        return NULL;
+    }
     if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
         return NULL;
 
@@ -79,11 +87,11 @@ static PyObject *accum_feed(Accum *self, PyObject *arg) {
     while (self->len - pos >= 4) {
         Py_ssize_t flen = (Py_ssize_t)read_be32(self->buf + pos);
         if (flen > self->max_frame) {
-            Py_DECREF(frames);
-            PyErr_Format(PyExc_ValueError,
-                         "frame of %zd bytes exceeds max_frame %zd", flen,
-                         self->max_frame);
-            return NULL;
+            /* Netty decode-loop contract: frames parsed earlier in this
+             * chunk are still delivered; the stream is poisoned for any
+             * further feed. */
+            self->poisoned = flen;
+            break;
         }
         if (self->len - pos - 4 < flen)
             break; /* incomplete frame: wait for more bytes */
@@ -108,11 +116,17 @@ static PyObject *accum_pending(Accum *self, PyObject *Py_UNUSED(ignored)) {
     return PyLong_FromSsize_t(self->len);
 }
 
+static PyObject *accum_poisoned(Accum *self, PyObject *Py_UNUSED(ignored)) {
+    return PyLong_FromSsize_t(self->poisoned);
+}
+
 static PyMethodDef accum_methods[] = {
     {"feed", (PyCFunction)accum_feed, METH_O,
      "Append a chunk; return the list of completed frame payloads."},
     {"pending", (PyCFunction)accum_pending, METH_NOARGS,
      "Bytes buffered awaiting frame completion."},
+    {"poisoned", (PyCFunction)accum_poisoned, METH_NOARGS,
+     "Oversized frame length that poisoned the stream (0 = healthy)."},
     {NULL, NULL, 0, NULL}};
 
 static PyTypeObject AccumType = {

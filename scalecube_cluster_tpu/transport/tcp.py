@@ -14,6 +14,11 @@ semantics:
   (TransportImpl.java:53-54), completed on ``stop()``;
 - send to an unresolvable/unreachable destination fails the returned
   awaitable (TransportTest.java:43-85).
+
+Frame assembly runs through the native framing module (native/framing.c — the
+Netty-pipeline-stage equivalent), transparently falling back to its pure
+Python twin when the toolchain can't build it; both are asserted equivalent
+by tests/test_native_framing.py.
 """
 
 from __future__ import annotations
@@ -21,9 +26,9 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
-import struct
 
 from scalecube_cluster_tpu.cluster_api.config import TransportConfig
+from scalecube_cluster_tpu.native import load_framing
 from scalecube_cluster_tpu.transport.api import (
     Transport,
     TransportStoppedError,
@@ -35,7 +40,7 @@ from scalecube_cluster_tpu.utils.address import Address
 
 logger = logging.getLogger(__name__)
 
-_LEN = struct.Struct(">I")
+_READ_CHUNK = 64 * 1024
 
 
 class _Connection:
@@ -62,6 +67,7 @@ class TcpTransport(_ListenMixin, Transport):
         _ListenMixin.__init__(self)
         self._config = config
         self._codec = codec or DEFAULT_CODEC
+        self._encode, self._accumulator_cls, _ = load_framing(build=True)
         self._server: asyncio.AbstractServer | None = None
         self._address: Address | None = None
         # Address -> future resolving to an established _Connection; a future
@@ -130,14 +136,10 @@ class TcpTransport(_ListenMixin, Transport):
         # message neither wastes a dial nor masks its ValueError behind a
         # connect error when the peer is unreachable.
         payload = self._codec.serialize(message)
-        if len(payload) > self._config.max_frame_length:
-            raise ValueError(
-                f"frame of {len(payload)} bytes exceeds max_frame_length "
-                f"{self._config.max_frame_length}"
-            )
+        frame = self._encode(payload, self._config.max_frame_length)
         conn = await self._get_or_connect(to)
         try:
-            conn.writer.write(_LEN.pack(len(payload)) + payload)
+            conn.writer.write(frame)
             await conn.writer.drain()  # flush per send (TransportImpl.java:280)
         except (ConnectionError, OSError):
             self._evict(to)
@@ -208,22 +210,31 @@ class TcpTransport(_ListenMixin, Transport):
     async def _read_loop(
         self, reader: asyncio.StreamReader, evict: Address | None = None
     ) -> None:
-        """Frame-decode loop: 4-byte length prefix, then codec bytes."""
+        """Frame-decode loop: chunked reads through the native accumulator
+        (LengthFieldBasedFrameDecoder stage, TransportImpl.java:383-397)."""
+        accum = self._accumulator_cls(self._config.max_frame_length)
         try:
             while True:
-                header = await reader.readexactly(_LEN.size)
-                (length,) = _LEN.unpack(header)
-                if length > self._config.max_frame_length:
-                    logger.warning("dropping oversized frame of %d bytes", length)
+                chunk = await reader.read(_READ_CHUNK)
+                if not chunk:
                     break
-                payload = await reader.readexactly(length)
-                try:
-                    message = self._codec.deserialize(payload)
-                except Exception:
-                    logger.exception("undecodable frame; closing connection")
+                # Frames parsed ahead of an oversized header are still
+                # dispatched (the accumulator's Netty-decode-loop contract);
+                # the poisoned stream then closes.
+                frames = accum.feed(chunk)
+                for payload in frames:
+                    try:
+                        message = self._codec.deserialize(payload)
+                    except Exception:
+                        logger.exception("undecodable frame; closing connection")
+                        return
+                    self._dispatch(message)
+                if accum.poisoned():
+                    logger.warning(
+                        "dropping oversized frame of %d bytes", accum.poisoned()
+                    )
                     break
-                self._dispatch(message)
-        except (asyncio.IncompleteReadError, ConnectionError, asyncio.CancelledError):
+        except (ConnectionError, asyncio.CancelledError):
             pass
         finally:
             if evict is not None:

@@ -53,12 +53,16 @@ class GossipNode:
         await self.transport.stop()
 
 
-async def make_mesh(n: int, loss_percent: float = 0.0) -> list[GossipNode]:
+async def make_mesh(
+    n: int, loss_percent: float = 0.0, mean_delay_ms: float = 0.0
+) -> list[GossipNode]:
     nodes = []
     for i in range(n):
         transport = NetworkEmulatorTransport(await TcpTransport.bind(), seed=i)
-        if loss_percent:
-            transport.network_emulator.set_default_outbound_settings(loss_percent)
+        if loss_percent or mean_delay_ms:
+            transport.network_emulator.set_default_outbound_settings(
+                loss_percent, mean_delay_ms
+            )
         nodes.append(GossipNode(transport, Member.create(transport.address)))
     for node in nodes:
         node.start(nodes)
@@ -70,11 +74,24 @@ async def stop_mesh(nodes: list[GossipNode]) -> None:
 
 
 @pytest.mark.asyncio
-@pytest.mark.parametrize("n,loss", [(6, 0.0), (10, 20.0)])
-async def test_complete_dissemination_exactly_once(n: int, loss: float):
+@pytest.mark.parametrize(
+    "n,loss,delay",
+    [
+        # The reference experiment grid corners (GossipProtocolTest.java:48-64):
+        # N up to 50, loss up to 50%, exponential mean delay up to 100 ms.
+        (2, 0.0, 0.0),
+        (6, 0.0, 0.0),
+        (10, 20.0, 0.0),
+        (10, 50.0, 0.0),
+        (10, 10.0, 100.0),
+        (50, 0.0, 2.0),
+        (50, 25.0, 0.0),
+    ],
+)
+async def test_complete_dissemination_exactly_once(n: int, loss: float, delay: float):
     """Every node receives the rumor exactly once, within the sweep deadline
     (GossipProtocolTest.java:154-173)."""
-    nodes = await make_mesh(n, loss)
+    nodes = await make_mesh(n, loss, delay)
     try:
         origin = nodes[0]
         origin.protocol.spread(
@@ -85,7 +102,7 @@ async def test_complete_dissemination_exactly_once(n: int, loss: float):
         )
         await await_until(
             lambda: all(len(peer.received) >= 1 for peer in nodes[1:]),
-            timeout=deadline_ms / 1000.0 + 2.0,
+            timeout=deadline_ms / 1000.0 + 2.0 + 4 * delay / 1000.0,
         )
         # settle, then assert exactly-once (dedup by gossip id)
         await asyncio.sleep(0.5)

@@ -84,6 +84,65 @@ async def test_partitioned_member_removed_then_rejoins():
 
 
 @pytest.mark.asyncio
+async def test_double_partition_and_heal():
+    """Split {a, b} vs {c, d}: each half removes the other after the
+    suspicion timeout, and healing restores the full 4-view on every node
+    (the double-partition case of MembershipProtocolTest.java:94-263)."""
+    a = await start_node()
+    b = await start_node(seeds=(a.address,))
+    c = await start_node(seeds=(a.address,))
+    d = await start_node(seeds=(a.address,))
+    nodes = [a, b, c, d]
+    try:
+        await await_until(lambda: views_converged(nodes, 4), timeout=10)
+        left, right = [a, b], [c, d]
+        for u in left:
+            for v in right:
+                u.network_emulator.block_outbound(v.address)
+                v.network_emulator.block_outbound(u.address)
+        settle = suspicion_settle_time(4)
+        await await_until(
+            lambda: all(len(u.members()) == 2 for u in nodes),
+            timeout=settle + 10,
+        )
+        left_ids = {a.member().id, b.member().id}
+        right_ids = {c.member().id, d.member().id}
+        assert {m.id for m in a.members()} == left_ids
+        assert {m.id for m in c.members()} == right_ids
+        for u in nodes:
+            u.network_emulator.unblock_all()
+        await await_until(lambda: views_converged(nodes, 4), timeout=20)
+    finally:
+        await shutdown_all(*nodes)
+
+
+@pytest.mark.asyncio
+async def test_heterogeneous_fd_timings_stay_alive():
+    """Nodes running different ping intervals/timeouts still converge with
+    no false suspicion (FailureDetectorTest.java:149-177)."""
+    slow = fast_test_config().failure_detector(
+        lambda f: f.with_(ping_interval=500, ping_timeout=400)
+    )
+    fast = fast_test_config().failure_detector(
+        lambda f: f.with_(ping_interval=100, ping_timeout=50)
+    )
+    a = await start_node(config=slow)
+    b = await start_node(config=fast, seeds=(a.address,))
+    c = await start_node(seeds=(a.address,))
+    nodes = [a, b, c]
+    try:
+        await await_until(lambda: views_converged(nodes, 3), timeout=10)
+        # Let several heterogeneous FD rounds elapse; nobody may get removed
+        # or even suspected.
+        await asyncio.sleep(2.0)
+        assert views_converged(nodes, 3)
+        for u in nodes:
+            assert u.monitor().suspected_members == ()
+    finally:
+        await shutdown_all(*nodes)
+
+
+@pytest.mark.asyncio
 async def test_suspected_member_refutes_with_incarnation_bump():
     """A transient partition gets ``a`` suspected; when it heals before the
     suspicion deadline, ``a`` sees the SUSPECT rumor about itself, refutes by

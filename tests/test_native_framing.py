@@ -1,0 +1,138 @@
+"""C and Python framing twins are byte-for-byte equivalent.
+
+Covers the round-1 advisor finding: the native module must be wired in
+(transport/tcp.py), built explicitly (not at import time), and proven
+equivalent across chunk boundaries and error cases. The reference behavior
+being mirrored is Netty's LengthFieldPrepender/LengthFieldBasedFrameDecoder
+pair (TransportImpl.java:383-397).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import time
+
+import pytest
+
+from scalecube_cluster_tpu.native import (
+    PyFrameAccumulator,
+    build_native,
+    py_encode,
+)
+
+native = pytest.importorskip_reason = None
+try:
+    _native = build_native()
+except Exception as exc:  # toolchain missing — skip the parity suite
+    _native = None
+    _reason = f"native build failed: {exc}"
+
+
+needs_native = pytest.mark.skipif(_native is None, reason="no native framing")
+
+
+def _frames(seed: int, count: int) -> list[bytes]:
+    rnd = __import__("random").Random(seed)
+    return [
+        bytes(rnd.getrandbits(8) for _ in range(rnd.choice([0, 1, 3, 9, 100, 5000])))
+        for _ in range(count)
+    ]
+
+
+@needs_native
+def test_encode_parity():
+    for payload in _frames(1, 50):
+        assert _native.encode(payload, 1 << 21) == py_encode(payload, 1 << 21)
+    with pytest.raises(ValueError):
+        _native.encode(b"x" * 100, 10)
+    with pytest.raises(ValueError):
+        py_encode(b"x" * 100, 10)
+
+
+@needs_native
+@pytest.mark.parametrize("chunk_size", [1, 2, 3, 4, 5, 7, 64, 1000, 1 << 20])
+def test_accumulator_parity_across_chunk_boundaries(chunk_size):
+    frames = _frames(2, 40)
+    stream = b"".join(py_encode(f, 1 << 21) for f in frames)
+    for acc in (_native.FrameAccumulator(1 << 21), PyFrameAccumulator(1 << 21)):
+        got: list[bytes] = []
+        for i in range(0, len(stream), chunk_size):
+            got.extend(acc.feed(stream[i : i + chunk_size]))
+        assert got == frames
+        assert acc.pending() == 0
+
+
+@needs_native
+def test_accumulator_merged_chunks_and_partials():
+    frames = _frames(3, 10)
+    stream = b"".join(py_encode(f, 1 << 21) for f in frames)
+    # One giant merged chunk, then a partial header, then the rest.
+    for acc in (_native.FrameAccumulator(1 << 21), PyFrameAccumulator(1 << 21)):
+        got = list(acc.feed(stream))
+        assert got == frames
+        got = list(acc.feed(stream[:2]))
+        assert got == [] and acc.pending() == 2
+        got = list(acc.feed(stream[2:]))
+        assert got == frames
+
+
+@needs_native
+def test_oversized_frame_poisons_after_delivering_predecessors():
+    """Netty decode-loop contract: frames ahead of the oversized header are
+    delivered, then the stream is poisoned and further feeds raise."""
+    good = py_encode(b"ok", 10)
+    bad = struct.pack(">I", 100) + b"x" * 100
+    for acc in (_native.FrameAccumulator(10), PyFrameAccumulator(10)):
+        frames = acc.feed(good + bad)
+        assert frames == [b"ok"]
+        assert acc.poisoned() == 100
+        with pytest.raises(ValueError):
+            acc.feed(b"")
+
+
+@needs_native
+def test_zero_and_max_frames():
+    payloads = [b"", b"x" * 10]
+    stream = b"".join(py_encode(p, 10) for p in payloads)
+    for acc in (_native.FrameAccumulator(10), PyFrameAccumulator(10)):
+        assert list(acc.feed(stream)) == payloads
+
+
+@needs_native
+def test_native_is_faster_microbench():
+    """The point of the C module: frame splitting beats the Python twin.
+
+    Asserts a modest >=1.5x so CI noise can't flake it; the measured ratio
+    (typically 5-15x on small frames) is printed for PERF.md.
+    """
+    frames = [os.urandom(120) for _ in range(2000)]
+    stream = b"".join(py_encode(f, 1 << 21) for f in frames)
+
+    def run(acc_cls) -> float:
+        t0 = time.perf_counter()
+        for _ in range(10):
+            acc = acc_cls(1 << 21)
+            n = 0
+            for i in range(0, len(stream), 8192):
+                n += len(acc.feed(stream[i : i + 8192]))
+            assert n == len(frames)
+        return time.perf_counter() - t0
+
+    t_py = run(PyFrameAccumulator)
+    t_c = run(_native.FrameAccumulator)
+    print(f"framing microbench: python={t_py*1e3:.1f}ms C={t_c*1e3:.1f}ms "
+          f"ratio={t_py/t_c:.1f}x")
+    assert t_c * 1.5 < t_py
+
+
+def test_transport_uses_wired_framing():
+    """TcpTransport constructs its accumulator from load_framing()."""
+    from scalecube_cluster_tpu.cluster_api.config import TransportConfig
+    from scalecube_cluster_tpu.native import load_framing
+    from scalecube_cluster_tpu.transport.tcp import TcpTransport
+
+    t = TcpTransport(TransportConfig())
+    encode, acc_cls, is_native = load_framing()
+    assert t._encode is encode
+    assert t._accumulator_cls is acc_cls

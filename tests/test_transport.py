@@ -102,6 +102,42 @@ async def test_per_connection_fifo_order():
 
 
 @pytest.mark.asyncio
+async def test_concurrent_senders_fifo_order():
+    """TransportSendOrderTest.java:41-207, multi-threaded-sender case: several
+    concurrent senders share the one cached connection; each sender's own
+    sequence must arrive in order (interleaving between senders is free)."""
+    a, b = await bind(), await bind()
+    try:
+        n_senders, n_msgs = 8, 100
+        stream = b.listen()
+
+        async def sender(tag: int):
+            for i in range(n_msgs):
+                await a.send(
+                    b.address,
+                    Message.create(qualifier="seq", data=(tag, i), sender=a.address),
+                )
+
+        received: list[tuple[int, int]] = []
+
+        async def collect():
+            async for msg in stream:
+                received.append(msg.data)
+                if len(received) == n_senders * n_msgs:
+                    return
+
+        collector = asyncio.create_task(collect())
+        await asyncio.gather(*(sender(t) for t in range(n_senders)))
+        await asyncio.wait_for(collector, timeout=10)
+        for tag in range(n_senders):
+            seq = [i for t, i in received if t == tag]
+            assert seq == list(range(n_msgs)), f"sender {tag} out of order"
+    finally:
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
 async def test_listen_completes_on_stop():
     """TransportTest.java:242-265 — listen() streams end when transport stops."""
     a = await bind()
