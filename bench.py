@@ -36,6 +36,11 @@ N_LADDER = (10240, 4096, 1024)
 PROBE_DEADLINE_S = 120
 PROBE_RETRIES = 3
 CHILD_DEADLINE_S = 420
+#: Hard budget on total wall time before the JSON line must be out — stops
+#: starting new children once exceeded, so a wedged backend can't push the
+#: guaranteed output past the driver's patience (probe + first child worst
+#: case still fits well under it).
+TOTAL_BUDGET_S = 1200
 
 
 def _measure(n_members: int, pallas: bool, chunk: int = 40, reps: int = 4) -> dict:
@@ -65,10 +70,12 @@ def _measure(n_members: int, pallas: bool, chunk: int = 40, reps: int = 4) -> di
 
     value = n_members * (reps * chunk / dt)
     return {
-        "metric": f"member_gossip_rounds_per_sec_n{n_members}",
+        "metric": "member_gossip_rounds_per_sec",
         "value": round(value, 1),
         "unit": "member·rounds/s",
         "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+        "n_members": n_members,
+        "pallas": pallas,
     }
 
 
@@ -96,16 +103,19 @@ def _probe() -> str | None:
             err = f"probe rc={res.returncode}: {res.stderr.strip()[-300:]}"
         except subprocess.TimeoutExpired:
             err = f"probe timed out after {PROBE_DEADLINE_S}s"
-        time.sleep(2**attempt)
+        if attempt + 1 < PROBE_RETRIES:
+            time.sleep(2**attempt)
     return err
 
 
-def _run_child(n: int, pallas: bool) -> dict | None:
+def _run_child(n: int, pallas: bool) -> tuple[dict | None, str]:
     """One measured config in a subprocess with a hard deadline.
 
     A fresh process per config also isolates backend state, so a wedged TPU
-    dispatch can only cost this config, not the whole benchmark.
+    dispatch can only cost this config, not the whole benchmark. Returns
+    ``(result, failure_detail)``.
     """
+    tag = f"n={n} pallas={int(pallas)}"
     try:
         res = subprocess.run(
             [sys.executable, __file__, "--child", str(n), str(int(pallas))],
@@ -114,32 +124,40 @@ def _run_child(n: int, pallas: bool) -> dict | None:
             timeout=CHILD_DEADLINE_S,
         )
     except subprocess.TimeoutExpired:
-        return None
+        return None, f"{tag}: timed out after {CHILD_DEADLINE_S}s"
     if res.returncode != 0:
-        return None
+        return None, f"{tag}: rc={res.returncode}: {res.stderr.strip()[-300:]}"
     for line in reversed(res.stdout.strip().splitlines()):
         line = line.strip()
         if line.startswith("{"):
             try:
-                return json.loads(line)
+                return json.loads(line), ""
             except json.JSONDecodeError:
-                return None
-    return None
+                return None, f"{tag}: unparseable stdout"
+    return None, f"{tag}: no JSON line in stdout"
 
 
 def main() -> None:
+    t_start = time.monotonic()
     result = None
     err = _probe()
+    last_fail = ""
+    out_of_budget = False
     if err is None:
         for n in N_LADDER:
-            result = _run_child(n, pallas=True)
-            if result is None:
-                # Pallas path wedged or failed to lower: same n, XLA path.
-                result = _run_child(n, pallas=False)
-            if result is not None:
+            for pallas in (True, False):
+                if time.monotonic() - t_start > TOTAL_BUDGET_S:
+                    out_of_budget = True
+                    last_fail = f"budget {TOTAL_BUDGET_S}s exhausted; " + last_fail
+                    break
+                result, fail = _run_child(n, pallas)
+                if result is not None:
+                    break
+                last_fail = fail
+            if result is not None or out_of_budget:
                 break
         if result is None:
-            err = "all benchmark configs failed or timed out"
+            err = f"all benchmark configs failed ({last_fail})"
     if result is None:
         result = {
             "metric": "member_gossip_rounds_per_sec",

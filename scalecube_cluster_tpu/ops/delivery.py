@@ -78,6 +78,53 @@ def fanout_permutations(rng, n, k):
     return perm.astype(jnp.int32), inv.astype(jnp.int32)
 
 
+#: Row-group size of the structured fan-out — the int32 sublane tile (8), so
+#: a sender group is exactly one aligned DMA window for the Pallas kernel.
+GROUP = 8
+
+
+def fanout_permutations_structured(rng, n, k, group=GROUP):
+    """Block-structured fan-out permutations (TPU-DMA-friendly).
+
+    Per channel c this samples a permutation ``ginv[c]`` of the ``n/group``
+    aligned row groups plus a per-(channel, receiver-group) rotation
+    ``rots[c, g]``; receiver j's c-th sender is::
+
+        inv[c, j] = group * ginv[c, j // group] + (j + rots[c, j // group]) % group
+
+    Still a bijection per channel — in-degree and out-degree are exactly k,
+    like :func:`fanout_permutations` — but every receiver group reads one
+    *aligned* ``(group, M)`` sender window, which the Pallas delivery kernel
+    (ops/pallas_tick.py) turns into a single large DMA instead of
+    ``group`` scattered row copies (Mosaic requires sublane-aligned DMA
+    destinations). The random group permutation carries the cluster-wide
+    mixing; the random rotations mix the within-group residues across ticks.
+    The reference's own fan-out is similarly structured rather than i.i.d.
+    (shuffled sliding window, GossipProtocolImpl.java:253-274).
+
+    Returns ``(inv, ginv, rots)`` — ``inv`` is ``[k, N]`` int32 as consumed
+    by :func:`permuted_delivery`; ``ginv`` ``[k, N/group]`` and ``rots``
+    ``[k, N/group]`` are the compact form the Pallas kernel prefetches.
+    """
+    ng = n // group
+    if ng * group != n:
+        raise ValueError(f"n={n} not a multiple of group={group}")
+    ks = jax.random.split(rng, k + 1)
+    ginv = jnp.stack(
+        [jax.random.permutation(ks[c], ng) for c in range(k)]
+    ).astype(jnp.int32)
+    rots = jax.random.randint(ks[k], (k, ng), 0, group, jnp.int32)
+    return inv_from_structured(ginv, rots, n, group), ginv, rots
+
+
+def inv_from_structured(ginv, rots, n, group=GROUP):
+    """Expand the compact structured form to full ``[k, N]`` sender indices."""
+    j = jnp.arange(n, dtype=jnp.int32)
+    g = j // group
+    inv = group * ginv[:, g] + (j[None, :] + rots[:, g]) % group
+    return inv.astype(jnp.int32)
+
+
 def permuted_delivery(rows, inv_perm, edge_ok):
     """Push delivery along permutation fan-out edges, receiver-side gathered.
 

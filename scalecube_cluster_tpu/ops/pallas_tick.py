@@ -1,0 +1,204 @@
+"""Fused Pallas TPU kernel: gossip delivery + membership merge in one pass.
+
+Stage-A fusion of the sim tick's dominant [N, N] work (PERF.md round-2
+analysis): the two-channel permutation delivery
+PLUS the ``merge_views`` lattice
+(ops/merge.py), which the XLA path materializes as ~6 separate [N, N]
+arrays (best_any, best_alive, their diag-excluded copies, the merge
+selects). Here the gathered sender windows are reduced and folded into the
+receiver's own row entirely in VMEM; HBM sees only:
+
+  read  3×rows windows + 1×local row   (4 × N² × 4 B)
+  write 1×merged row + the self-rumor column   (N² × 4 B + ε)
+
+The kernel also extracts the raw ``best_any`` diagonal (the strongest rumor
+delivered to each node about itself) before diagonal exclusion — the
+self-refutation trigger (onSelfMemberDetected,
+MembershipProtocolImpl.java:549-569) — so the caller never touches the full
+best channels at all.
+
+Semantics are asserted bit-equal to the XLA path (delivery + merge_views +
+dead-row freeze) by tests/test_pallas_tick.py over whole trajectories.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from scalecube_cluster_tpu.ops.delivery import GROUP
+from scalecube_cluster_tpu.ops.merge import DEAD_BIT, _EPOCH_SHIFT, is_alive_key
+
+#: Receiver groups per grid step (VMEM-bounded: 2 slots x f x gpb x (8, m)
+#: int32 windows + the in/out block pipelines must fit ~16 MB).
+GROUPS_PER_BLOCK = 2
+
+
+def _merge_rows(local, best_any, best_alive):
+    """ops/merge.py::merge_views on in-VMEM blocks (identical formula)."""
+    known = local >= 0
+    e_local = local >> _EPOCH_SHIFT
+    e_any = best_any >> _EPOCH_SHIFT
+    e_alive = best_alive >> _EPOCH_SHIFT
+    same = known & (best_any >= 0) & (e_any == e_local)
+    upd_same = same & (((local & DEAD_BIT) == 0) & (best_any > local))
+    intro = (best_alive >= 0) & (~known | (e_alive > e_local))
+    merged = jnp.where(upd_same, best_any, jnp.where(intro, best_alive, local))
+    return jnp.where(intro & (e_alive > e_any), best_alive, merged)
+
+
+def _kernel_factory(f: int, m: int, nb: int, gpb: int):
+    b = GROUP
+
+    def kernel(
+        ginv_ref,
+        rot_ref,
+        ok_ref,
+        alive_ref,
+        rows_ref,
+        local_ref,
+        out_ref,
+        self_ref,
+        scratch,
+        sems,
+    ):
+        i = pl.program_id(0)
+
+        def dma(block, slot, c, g):
+            return pltpu.make_async_copy(
+                rows_ref.at[pl.ds(ginv_ref[c, block * gpb + g] * b, b)],
+                scratch.at[slot, c, g],
+                sems.at[slot, c, g],
+            )
+
+        @pl.when(i == 0)
+        def _():
+            for c in range(f):
+                for g in range(gpb):
+                    dma(0, 0, c, g).start()
+
+        @pl.when(i + 1 < nb)
+        def _():
+            for c in range(f):
+                for g in range(gpb):
+                    dma(i + 1, (i + 1) % 2, c, g).start()
+
+        slot = i % 2
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (b, m), 1)
+        for g in range(gpb):
+            best_any = jnp.full((b, m), -1, jnp.int32)
+            best_alive = best_any
+            base = (i * gpb + g) * b
+            for c in range(f):
+                dma(i, slot, c, g).wait()
+                # Receiver row p's sender is window row (p + rot) % b:
+                # roll(x, s)[p] = x[(p - s) % b], so shift by b - rot.
+                rot = rot_ref[c, i * gpb + g]
+                chunk = pltpu.roll(scratch[slot, c, g], shift=b - rot, axis=0)
+                # Stack/reshape in int32 — Mosaic can't reshape sub-32-bit
+                # vectors.
+                ok_col = jnp.stack([ok_ref[c, base + r] for r in range(b)])
+                mask = ok_col.astype(jnp.int32).reshape(b, 1) != 0
+                contrib = jnp.where(mask, chunk, -1)
+                best_any = jnp.maximum(best_any, contrib)
+                best_alive = jnp.maximum(
+                    best_alive, jnp.where(is_alive_key(contrib), contrib, -1)
+                )
+            # Row r's own column is base + r: extract the self-rumor, then
+            # exclude the diagonal from the merge channels.
+            row_g = jax.lax.broadcasted_iota(jnp.int32, (b, m), 0) + base
+            on_diag = col_ids == row_g
+            self_vals = jnp.max(jnp.where(on_diag, best_any, -1), axis=1)
+            self_ref[g * b : (g + 1) * b, :] = jnp.broadcast_to(
+                self_vals.reshape(b, 1), (b, 128)
+            )
+            best_any = jnp.where(on_diag, -1, best_any)
+            best_alive = jnp.where(on_diag, -1, best_alive)
+
+            local = local_ref[g * b : (g + 1) * b, :]
+            merged = _merge_rows(local, best_any, best_alive)
+            # Dead receivers are frozen (their process isn't running).
+            alive_col = jnp.stack([alive_ref[base + r] for r in range(b)])
+            alive_mask = alive_col.astype(jnp.int32).reshape(b, 1) != 0
+            out_ref[g * b : (g + 1) * b, :] = jnp.where(alive_mask, merged, local)
+
+    return kernel
+
+
+def delivery_merge_pallas(
+    rows, local_view, ginv, rots, edge_ok, alive, interpret=None
+):
+    """Fused gossip delivery + merge. Returns ``(merged_view, self_rumor)``.
+
+    Args:
+      rows: ``[N, M]`` int32 young-masked payload rows (-1 = nothing).
+      local_view: ``[N, M]`` int32 — each receiver's current table (view1).
+      ginv, rots: structured fan-out (ops/delivery.py), ``[f, N/8]``.
+      edge_ok: ``[f, N]`` bool — edge delivers.
+      alive: ``[N]`` bool — receiver process liveness (dead rows frozen).
+      interpret: force interpreter mode (None = interpret off-TPU).
+
+    Returns:
+      ``merged`` ``[N, M]`` int32 and ``self_rumor`` ``[N]`` int32 (the raw
+      pre-exclusion best_any diagonal).
+    """
+    n, m = rows.shape
+    f = ginv.shape[0]
+    if n % GROUP != 0:
+        raise ValueError(f"n={n} not a multiple of {GROUP}")
+    if m % 128 != 0:
+        # Fallback: the unfused XLA ops (identical semantics).
+        from scalecube_cluster_tpu.ops.delivery import (
+            inv_from_structured,
+            permuted_delivery_two_channel,
+        )
+        from scalecube_cluster_tpu.ops.merge import merge_views
+
+        inv = inv_from_structured(ginv, rots, n)
+        best_any, best_alive = permuted_delivery_two_channel(
+            rows, is_alive_key, inv, edge_ok
+        )
+        self_rumor = jnp.diagonal(best_any)
+        diag = jnp.eye(n, dtype=bool)
+        merged, _ = merge_views(
+            local_view,
+            jnp.where(diag, -1, best_any),
+            jnp.where(diag, -1, best_alive),
+        )
+        return jnp.where(alive[:, None], merged, local_view), self_rumor
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    gpb = GROUPS_PER_BLOCK
+    while (n // GROUP) % gpb != 0:
+        gpb //= 2
+    nb = n // (GROUP * gpb)
+    block = gpb * GROUP
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # rows stay in HBM (windows)
+            pl.BlockSpec((block, m), lambda i, *_: (i, 0)),  # local rows
+        ],
+        out_specs=[
+            pl.BlockSpec((block, m), lambda i, *_: (i, 0)),
+            pl.BlockSpec((block, 128), lambda i, *_: (i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, f, gpb, GROUP, m), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, f, gpb)),
+        ],
+    )
+    merged, self_pad = pl.pallas_call(
+        _kernel_factory(f, m, nb, gpb),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, m), jnp.int32),
+            jax.ShapeDtypeStruct((n, 128), jnp.int32),
+        ],
+        interpret=interpret,
+    )(ginv, rots, edge_ok.astype(jnp.int32), alive.astype(jnp.int32), rows, local_view)
+    return merged, self_pad[:, 0]

@@ -76,6 +76,7 @@ def state_shardings(mesh: Mesh) -> SimState:
         alive=vec,
         useen=srow,
         uage=srow,
+        uinf=NamedSharding(mesh, P(AXIS, None, None)),
         tick=rep,
         rng=rep,
     )

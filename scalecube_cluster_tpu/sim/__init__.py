@@ -13,7 +13,12 @@ MembershipConfig.java:13-24).
 
 from scalecube_cluster_tpu.sim.checkpoint import load_checkpoint, save_checkpoint
 from scalecube_cluster_tpu.sim.faults import FaultPlan
-from scalecube_cluster_tpu.sim.monitor import cluster_summary, node_view
+from scalecube_cluster_tpu.sim.monitor import (
+    cluster_summary,
+    node_view,
+    user_gossip_slot_free,
+    user_gossip_swept,
+)
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import (
     SimState,
@@ -40,6 +45,8 @@ __all__ = [
     "leave",
     "load_checkpoint",
     "node_view",
+    "user_gossip_slot_free",
+    "user_gossip_swept",
     "restart",
     "run_chunked",
     "run_ticks",

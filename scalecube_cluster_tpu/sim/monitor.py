@@ -75,3 +75,28 @@ def cluster_summary(state: SimState) -> dict:
         "max_incarnation": int(inc.max()),
         "max_epoch": int(epoch.max()),
     }
+
+
+def user_gossip_swept(state: SimState, node: int, slot: int) -> bool:
+    """Host-side ``spread()`` completion signal: has ``node`` swept user-gossip
+    ``slot``?
+
+    Mirrors the reference, where the Mono returned by spread() resolves when
+    sweepGossips garbage-collects the rumor at the ORIGIN
+    (GossipProtocolImpl.java:299-302): the sim tick clears ``useen`` once the
+    slot's local age passes ``periods_to_sweep`` (sim/tick.py step 6). Call
+    after injecting at ``node``; True once the rumor aged out there.
+
+    This is origin-local, like the reference's future. Reusing the slot for a
+    NEW spread additionally requires every node to have swept its copy (late
+    infections sweep up to periods_to_spread later) — poll
+    :func:`user_gossip_slot_free` for that.
+    """
+    return not bool(state.useen[node, slot])
+
+
+def user_gossip_slot_free(state: SimState, slot: int) -> bool:
+    """True when no node still holds user-gossip ``slot`` — the safe point to
+    inject a new rumor into it (all copies swept, no stale dedup/infected
+    state anywhere)."""
+    return not bool(jax.device_get(state.useen[:, slot]).any())

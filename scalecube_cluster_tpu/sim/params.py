@@ -46,9 +46,16 @@ class SimParams:
     ping_req_timeout_ms: int = 500
     #: Number of user-gossip payload slots tracked by the sim.
     user_gossip_slots: int = 4
-    #: Use the fused Pallas delivery kernel (ops/pallas_delivery.py) instead
+    #: Use the fused Pallas delivery+merge kernel (ops/pallas_tick.py) instead
     #: of the XLA gather path. Off-TPU it runs interpreted (slow; tests only).
     pallas_delivery: bool = False
+    #: Track per-rumor infected sets for user gossip ([N, N, G] state) so
+    #: senders suppress pushes to known-infected peers and message counts can
+    #: be validated against the ClusterMath envelope (GossipState.java:17-38,
+    #: selectGossipsToSend GossipProtocolImpl.java:242-251). Costs O(N²G)
+    #: memory — validation scale only; the state must be built with a
+    #: matching ``track_infected`` (sim/state.py::init_full_view).
+    track_user_infected: bool = False
 
     def __post_init__(self):
         # Dtype envelopes of the state arrays (sim/state.py): rumor_age is

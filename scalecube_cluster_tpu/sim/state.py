@@ -20,6 +20,12 @@ play the roles of the reference's per-node objects:
 - ``alive[j]``         — ground truth: process j is up (host fault control)
 - ``useen/uage[j, g]`` — user-gossip dissemination state per payload slot g
                          (GossipProtocolImpl gossips map, :163-169)
+- ``uinf[i, j, g]``    — i knows j already has user-gossip g, so i stops
+                         pushing it to j (GossipState.infected,
+                         GossipState.java:17-38). Tracked at full [N, N, G]
+                         only when ``track_infected`` is requested (test /
+                         validation scale); otherwise a [N, 1, G] stub so the
+                         pytree shape is stable and benchmarks pay nothing.
 
 Host-side helpers (`kill`/`restart`/`inject_gossip`) are the NetworkEmulator-
 style control plane for churn scenarios; they run between jitted tick runs.
@@ -54,6 +60,7 @@ class SimState:
     alive: jax.Array  # [N] bool
     useen: jax.Array  # [N, G] bool
     uage: jax.Array  # [N, G] int32
+    uinf: jax.Array  # [N, N, G] bool (or [N, 1, G] stub when untracked)
     tick: jax.Array  # [] int32
     rng: jax.Array  # PRNG key
 
@@ -61,7 +68,7 @@ class SimState:
         return dataclasses.replace(self, **changes)
 
 
-def _blank(n: int, slots: int, seed: int) -> SimState:
+def _blank(n: int, slots: int, seed: int, track_infected: bool) -> SimState:
     return SimState(
         view=jnp.full((n, n), merge_ops.UNKNOWN_KEY, jnp.int32),
         rumor_age=jnp.full((n, n), AGE_STALE, jnp.int8),
@@ -71,18 +78,26 @@ def _blank(n: int, slots: int, seed: int) -> SimState:
         alive=jnp.ones((n,), bool),
         useen=jnp.zeros((n, slots), bool),
         uage=jnp.zeros((n, slots), jnp.int32),
+        uinf=jnp.zeros((n, n if track_infected else 1, slots), bool),
         tick=jnp.zeros((), jnp.int32),
         rng=jax.random.PRNGKey(seed),
     )
 
 
-def init_full_view(n: int, user_gossip_slots: int = 4, seed: int = 0) -> SimState:
+def init_full_view(
+    n: int,
+    user_gossip_slots: int = 4,
+    seed: int = 0,
+    track_infected: bool = False,
+) -> SimState:
     """Post-join steady state: everyone knows everyone ALIVE at incarnation 0.
 
     The standard starting point for convergence / failure studies (the state
     the reference reaches after ClusterTest.java:88-114's join phase).
+    ``track_infected`` sizes ``uinf`` for per-rumor suppression accounting
+    (SimParams.track_user_infected must match).
     """
-    state = _blank(n, user_gossip_slots, seed)
+    state = _blank(n, user_gossip_slots, seed, track_infected)
     alive_keys = merge_ops.encode_key(
         jnp.zeros((n, n), jnp.int32), jnp.zeros((n, n), jnp.int32)
     )
@@ -90,7 +105,11 @@ def init_full_view(n: int, user_gossip_slots: int = 4, seed: int = 0) -> SimStat
 
 
 def init_seeded(
-    n: int, seeds: jax.Array | list[int], user_gossip_slots: int = 4, seed: int = 0
+    n: int,
+    seeds: jax.Array | list[int],
+    user_gossip_slots: int = 4,
+    seed: int = 0,
+    track_infected: bool = False,
 ) -> SimState:
     """Cold join: node i knows only itself; seed addresses are config-known.
 
@@ -100,7 +119,7 @@ def init_seeded(
     always treats the seed mask as eligible partners, which reproduces the
     initial-sync join flow tick by tick.
     """
-    state = _blank(n, user_gossip_slots, seed)
+    state = _blank(n, user_gossip_slots, seed, track_infected)
     diag = jnp.eye(n, dtype=bool)
     self_key = merge_ops.encode_key(jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
     view = jnp.where(diag, self_key, merge_ops.UNKNOWN_KEY)
@@ -169,6 +188,14 @@ def restart(state: SimState, idx) -> SimState:
         rumor_age=state.rumor_age.at[idx, :].set(AGE_STALE).at[idx, idx].set(0),
         suspect_left=state.suspect_left.at[idx, :].set(0),
         useen=state.useen.at[idx, :].set(False),
+        # The restarted slot is a brand-new identity: it appears in nobody's
+        # infected set — neither its own knowledge (row idx) nor peers'
+        # knowledge about it (column idx, only present in tracked mode).
+        uinf=(
+            state.uinf.at[idx].set(False).at[:, idx].set(False)
+            if state.uinf.shape[1] == state.view.shape[0]
+            else state.uinf.at[idx].set(False)
+        ),
     )
 
 

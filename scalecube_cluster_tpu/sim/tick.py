@@ -10,36 +10,44 @@ branchless step suitable for `jax.lax.scan` + `jit` + sharding:
      (shuffled-round-robin becomes Gumbel sampling, ops/select.py), direct
      ping with loss/block-sampled round trip, indirect ping-req via k relays
      on direct failure (FailureDetectorImpl.java:160-208), DEST_GONE on epoch
-     mismatch (PingData.java:8-23) → SUSPECT / DEAD record updates.
-  2. Suspicion sweep: SUSPECT older than the suspicion timeout becomes DEAD
-     (MembershipProtocolImpl.onSuspicionTimeout, :637-647).
-  3. Gossip delivery, every tick: fan-out along per-tick random permutations
-     (ops/delivery.py::fanout_permutations — the TPU form of the reference's
-     shuffled sliding window, GossipProtocolImpl.java:253-274) carrying
-     membership rumors younger than periodsToSpread (selectGossipsToSend,
-     :242-251), folded receiver-side by gather + lattice max (ops/merge.py =
-     updateMembership/isOverrides).
-  4. SYNC anti-entropy (cond-gated to sync ticks / joining nodes): full-table
+     mismatch (PingData.java:8-23) → per-row (target, verdict-key, fire)
+     vectors applied to the view.
+  2. Gossip delivery, every tick: fan-out along per-tick block-structured
+     random permutations (ops/delivery.py::fanout_permutations_structured —
+     the TPU form of the reference's shuffled sliding window,
+     GossipProtocolImpl.java:253-274) carrying membership rumors younger than
+     periodsToSpread (selectGossipsToSend, :242-251), folded receiver-side by
+     gather + lattice max (ops/merge.py = updateMembership/isOverrides).
+     On TPU this step runs as one fused Pallas kernel
+     (ops/pallas_tick.py::delivery_merge_pallas) when
+     ``SimParams.pallas_delivery`` is set.
+  3. SYNC anti-entropy (cond-gated to sync ticks / joining nodes): full-table
      exchange with one partner both ways (onSync/onSyncAck,
      MembershipProtocolImpl.java:343-373).
+  4. Suspicion sweep *after* the merge: a still-SUSPECT record whose countdown
+     ran out becomes DEAD (MembershipProtocolImpl.onSuspicionTimeout,
+     :637-647); a record refreshed by this tick's merge cancels the pending
+     timeout, mirroring the reference's cancel-on-update (:534, 612-618).
   5. Self-refutation: a node seeing a SUSPECT/DEAD rumor about its own current
      epoch at inc >= its own bumps incarnation and re-announces ALIVE
      (onSelfMemberDetected, MembershipProtocolImpl.java:549-569), unless it
      voluntarily left (DEAD own-diagonal, sim/state.py::leave).
-  6. User-gossip dissemination with exactly-once first-seen accounting
-     (onGossipReq dedup, GossipProtocolImpl.java:171-183).
+  6. User-gossip dissemination with exactly-once first-seen accounting,
+     optional per-rumor infected-set suppression, and sweep/recycle
+     (onGossipReq dedup + sweepGossips, GossipProtocolImpl.java:171-183,
+     281-304).
 
 Documented deviations from the reference (protocol-equivalent at period
 granularity; the convergence tests are the oracle):
 
 - A whole ping→timeout→ping-req round resolves within its FD tick (the
   reference bounds it by pingInterval the same way); sub-tick timings vanish.
-- Gossip fan-out is a random permutation per tick: out-degree AND in-degree
-  are exactly `fanout`, and targets are drawn cluster-wide rather than from
-  the sender's live-member list. A message to a node the sender believes dead
-  is a no-op unless the target is actually alive — in which case it only
-  accelerates rumor refutation. The reference's sliding window regularizes
-  selection the same way over n/fanout periods.
+- Gossip fan-out is a block-structured random permutation per tick:
+  out-degree AND in-degree are exactly `fanout`, and targets are drawn
+  cluster-wide rather than from the sender's live-member list. A message to a
+  node the sender believes dead is a no-op unless the target is actually
+  alive — in which case it only accelerates rumor refutation. The reference's
+  sliding window regularizes selection the same way over n/fanout periods.
 - FD ALIVE results do not trigger the direct-SYNC nudge of
   MembershipProtocolImpl.java:385-397; refutation rides the gossiped SUSPECT
   rumor reaching the target instead — same outcome, ≤ spread-latency later.
@@ -47,6 +55,11 @@ granularity; the convergence tests are the oracle):
   approximating the one-shot initial sync to all seeds (start0, :222-257).
 - SYNC_ACK replies carry the partner's pre-merge table (one tick staler than
   the reference's merged reply).
+- A suspicion timeout expiring in the same period a refutation arrives loses
+  to the refutation (reference: racy, timer-thread vs update ordering); the
+  expired tombstone becomes visible to the node's own gossip the *next*
+  period, like the reference where the DEAD update waits for the next
+  doSpreadGossip.
 """
 
 from __future__ import annotations
@@ -59,8 +72,10 @@ from jax import lax
 
 from scalecube_cluster_tpu.cluster_api.member import MemberStatus
 from scalecube_cluster_tpu.ops.delivery import (
+    GROUP,
     deliver_rows_max,
     fanout_permutations,
+    fanout_permutations_structured,
     permuted_delivery,
     permuted_delivery_two_channel,
 )
@@ -86,6 +101,85 @@ _DEAD = int(MemberStatus.DEAD)
 _AGE_CAP = 1 << 20
 
 
+def _fd_vectors(params, state, plan, keys, cand, view0):
+    """One FD round as per-row vectors: ``(tgt, fd_key, fire, msgs)``.
+
+    The whole doPing/doPingReq flow (FailureDetectorImpl.java:126-209) runs
+    on [N]-sized data: each node's probe target, the ack-carried verdict key,
+    and whether a SUSPECT/DEAD record fires. The [N, N] application of the
+    verdict is left to the caller (one fused `where` — or the Pallas tick
+    kernel).
+    """
+    n = params.n
+    k_tgt, k_ping, k_relay = keys
+    col = jnp.arange(n, dtype=jnp.int32)
+    i_idx = col
+    alive = state.alive
+
+    tgt, tgt_valid = masked_random_choice(k_tgt, cand)
+    vkey = jnp.take_along_axis(view0, tgt[:, None], axis=1)[:, 0]
+    v_inc = decode_incarnation(vkey)
+    v_epoch = decode_epoch(vkey)
+
+    probing = alive & tgt_valid
+    pk1, pk2, pk3 = jax.random.split(k_ping, 3)
+    fwd_ok = link_pass(pk1, plan, i_idx, tgt)
+    ack_ok = link_pass(pk2, plan, tgt, i_idx)
+    # The whole ping->ack round trip races one pingTimeout timer.
+    rt_ok = round_trip_in_time(
+        pk3, plan, [(i_idx, tgt), (tgt, i_idx)], params.ping_timeout_ms
+    )
+    direct_reach = probing & alive[tgt] & fwd_ok & ack_ok & rt_ok
+
+    # Indirect probe via k relays: origin→relay→target→relay→origin, all
+    # four legs sampled (onPingReq transit + onTransitPingAck forwarding,
+    # FailureDetectorImpl.java:255-305).
+    relay_cand = cand & (col[None, :] != tgt[:, None])
+    kr1, rk1, rk2, rk3, rk4, rk5 = jax.random.split(k_relay, 6)
+    ridx, rvalid = masked_random_topk(kr1, relay_cand, params.ping_req_members)
+    leg_or = link_pass(rk1, plan, i_idx[:, None], ridx)  # origin->relay
+    leg_rt = link_pass(rk2, plan, ridx, tgt[:, None])  # relay->target
+    leg_tr = link_pass(rk3, plan, tgt[:, None], ridx)  # target->relay
+    leg_ro = link_pass(rk4, plan, ridx, i_idx[:, None])  # relay->origin
+    # All four legs race the remaining interval budget together.
+    path_ok = round_trip_in_time(
+        rk5,
+        plan,
+        [
+            (i_idx[:, None], ridx),
+            (ridx, tgt[:, None]),
+            (tgt[:, None], ridx),
+            (ridx, i_idx[:, None]),
+        ],
+        params.ping_req_timeout_ms,
+    )
+    relay_reach = (
+        rvalid
+        & alive[ridx]
+        & alive[tgt][:, None]
+        & leg_or
+        & leg_rt
+        & leg_tr
+        & leg_ro
+        & path_ok
+    )
+    reached = direct_reach | (probing & jnp.any(relay_reach, axis=1))
+
+    # Ack carries the responder's identity: epoch ahead of the viewed
+    # record means the old process is gone (AckType.DEST_GONE,
+    # PingData.java:8-23).
+    gone = reached & (state.epoch[tgt] != v_epoch)
+    fd_fire = (probing & ~reached) | gone
+    fd_key = encode_key(jnp.where(gone, _DEAD, _SUSPECT), v_inc, v_epoch)
+    # Same-epoch candidate by construction: plain lattice accept. SUSPECT
+    # at the viewed incarnation outranks ALIVE (rank bit); DEAD outranks
+    # both; an existing DEAD record stays sticky.
+    accept = (vkey >= 0) & overrides_same_epoch(fd_key, vkey)
+    fire = fd_fire & accept
+    msgs = jnp.sum(probing) + jnp.sum((probing & ~direct_reach)[:, None] & rvalid)
+    return tgt, fd_key, fire, msgs
+
+
 @partial(jax.jit, static_argnums=0, static_argnames=("collect",))
 def sim_tick(
     params: SimParams,
@@ -106,12 +200,16 @@ def sim_tick(
         mode — skips the convergence/count reductions).
     """
     n = params.n
+    if params.track_user_infected and state.uinf.shape[1] != n:
+        raise ValueError(
+            "track_user_infected needs state built with track_infected=True "
+            f"(uinf is {state.uinf.shape}, want ({n}, {n}, G))"
+        )
     t = state.tick + 1
     keys = jax.random.split(state.rng, 8)
     (rng_next, k_tgt, k_ping, k_relay, k_gsel, k_glink, k_ssel, k_slink) = keys
 
     view0 = state.view
-    status0 = decode_status(view0)
     alive = state.alive
     col = jnp.arange(n, dtype=jnp.int32)
     diag = jnp.eye(n, dtype=bool)
@@ -122,102 +220,42 @@ def sim_tick(
 
     # Live-member candidate sets: known, not seen DEAD, not self — the member
     # lists FD/sync draw from (FailureDetectorImpl.java:323-333).
+    status0 = decode_status(view0)
     cand = (view0 >= 0) & (status0 != _DEAD) & ~diag
 
     # ------------------------------------------------------------------ 1. FD
-    def fd_fire_phase(view0):
-        tgt, tgt_valid = masked_random_choice(k_tgt, cand)
-        vkey = jnp.take_along_axis(view0, tgt[:, None], axis=1)[:, 0]
-        v_inc = decode_incarnation(vkey)
-        v_epoch = decode_epoch(vkey)
-
-        probing = alive & tgt_valid
-        pk1, pk2, pk3 = jax.random.split(k_ping, 3)
-        fwd_ok = link_pass(pk1, plan, i_idx, tgt)
-        ack_ok = link_pass(pk2, plan, tgt, i_idx)
-        # The whole ping->ack round trip races one pingTimeout timer.
-        rt_ok = round_trip_in_time(
-            pk3, plan, [(i_idx, tgt), (tgt, i_idx)], params.ping_timeout_ms
+    def fd_fire_phase(_):
+        return _fd_vectors(
+            params, state, plan, (k_tgt, k_ping, k_relay), cand, view0
         )
-        direct_reach = probing & alive[tgt] & fwd_ok & ack_ok & rt_ok
 
-        # Indirect probe via k relays: origin→relay→target→relay→origin, all
-        # four legs sampled (onPingReq transit + onTransitPingAck forwarding,
-        # FailureDetectorImpl.java:255-305).
-        relay_cand = cand & (col[None, :] != tgt[:, None])
-        kr1, rk1, rk2, rk3, rk4, rk5 = jax.random.split(k_relay, 6)
-        ridx, rvalid = masked_random_topk(kr1, relay_cand, params.ping_req_members)
-        leg_or = link_pass(rk1, plan, i_idx[:, None], ridx)  # origin->relay
-        leg_rt = link_pass(rk2, plan, ridx, tgt[:, None])  # relay->target
-        leg_tr = link_pass(rk3, plan, tgt[:, None], ridx)  # target->relay
-        leg_ro = link_pass(rk4, plan, ridx, i_idx[:, None])  # relay->origin
-        # All four legs race the remaining interval budget together.
-        path_ok = round_trip_in_time(
-            rk5,
-            plan,
-            [
-                (i_idx[:, None], ridx),
-                (ridx, tgt[:, None]),
-                (tgt[:, None], ridx),
-                (ridx, i_idx[:, None]),
-            ],
-            params.ping_req_timeout_ms,
+    def fd_skip_phase(_):
+        return (
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), bool),
+            jnp.asarray(0, jnp.int32),
         )
-        relay_reach = (
-            rvalid
-            & alive[ridx]
-            & alive[tgt][:, None]
-            & leg_or
-            & leg_rt
-            & leg_tr
-            & leg_ro
-            & path_ok
-        )
-        reached = direct_reach | (probing & jnp.any(relay_reach, axis=1))
 
-        # Ack carries the responder's identity: epoch ahead of the viewed
-        # record means the old process is gone (AckType.DEST_GONE,
-        # PingData.java:8-23).
-        gone = reached & (state.epoch[tgt] != v_epoch)
-        fd_fire = (probing & ~reached) | gone
-        fd_key = encode_key(jnp.where(gone, _DEAD, _SUSPECT), v_inc, v_epoch)
-
-        onehot_tgt = col[None, :] == tgt[:, None]
-        fd_mat = jnp.where(onehot_tgt & fd_fire[:, None], fd_key[:, None], UNKNOWN_KEY)
-        # Same-epoch candidate by construction: plain lattice accept. SUSPECT
-        # at the viewed incarnation outranks ALIVE (rank bit); DEAD outranks
-        # both; an existing DEAD record stays sticky.
-        fd_accept = (fd_mat >= 0) & (view0 >= 0) & overrides_same_epoch(fd_mat, view0)
-        msgs = jnp.sum(probing) + jnp.sum((probing & ~direct_reach)[:, None] & rvalid)
-        return jnp.where(fd_accept, fd_mat, view0), fd_accept, msgs
-
-    def fd_skip_phase(view0):
-        return view0, jnp.zeros((n, n), bool), jnp.asarray(0, jnp.int32)
-
-    view1, changed, msgs_fd = lax.cond(do_fd, fd_fire_phase, fd_skip_phase, view0)
-
-    # ------------------------------------------------ 2. suspicion timeout
-    # Countdown form: the timer decrements once per tick after the tick that
-    # set it, so it hits 0 exactly suspicion_ticks later. Records that became
-    # SUSPECT this very tick (FD above) have no timer yet — was_susp guards.
-    was_susp = status0 == _SUSPECT
-    left0 = jnp.maximum(state.suspect_left.astype(jnp.int32) - 1, 0)
-    expired = (
-        alive[:, None]
-        & was_susp
-        & (decode_status(view1) == _SUSPECT)
-        & (left0 == 0)
+    fd_tgt, fd_key, fd_fire, msgs_fd = lax.cond(
+        do_fd, fd_fire_phase, fd_skip_phase, None
     )
-    dead_keys = encode_key(
-        jnp.full((n, n), _DEAD, jnp.int32),
-        decode_incarnation(view1),
-        decode_epoch(view1),
-    )
-    view1 = jnp.where(expired, dead_keys, view1)
-    changed = changed | expired
+    fd_mask = (col[None, :] == fd_tgt[:, None]) & fd_fire[:, None]
+    view1 = jnp.where(fd_mask, fd_key[:, None], view0)
 
-    # ------------------------------------------------- 3. gossip delivery
-    _, inv_perm = fanout_permutations(k_gsel, n, params.gossip_fanout)
+    # ------------------------------------------------- 2. gossip delivery
+    # Block-structured fan-out when n allows it (aligned DMA windows for the
+    # Pallas kernel — ops/delivery.py::fanout_permutations_structured); the
+    # unstructured permutations remain for odd n. Both delivery
+    # implementations consume the same sampled edges, so trajectories are
+    # bit-identical across the pallas_delivery switch.
+    structured = n % GROUP == 0
+    if structured:
+        inv_perm, ginv, rots = fanout_permutations_structured(
+            k_gsel, n, params.gossip_fanout
+        )
+    else:
+        _, inv_perm = fanout_permutations(k_gsel, n, params.gossip_fanout)
     lks = jax.random.split(k_glink, params.gossip_fanout)
     edge_ok = jnp.stack(
         [
@@ -226,28 +264,31 @@ def sim_tick(
         ]
     )
 
-    age0 = jnp.where(changed, 0, state.rumor_age)
+    age0 = jnp.where(fd_mask, 0, state.rumor_age)
     rows = jnp.where(age0 < params.periods_to_spread, view1, UNKNOWN_KEY)
-    if params.pallas_delivery:
-        from scalecube_cluster_tpu.ops.pallas_delivery import (
-            permuted_delivery_two_channel_pallas,
-        )
+    if params.pallas_delivery and structured:
+        from scalecube_cluster_tpu.ops.pallas_tick import delivery_merge_pallas
 
-        best_any, best_alive = permuted_delivery_two_channel_pallas(
-            rows, inv_perm, edge_ok
+        merged, self_rumor = delivery_merge_pallas(
+            rows, view1, ginv, rots, edge_ok, alive
         )
     else:
         best_any, best_alive = permuted_delivery_two_channel(
             rows, is_alive_key, inv_perm, edge_ok
         )
+        self_rumor = jnp.diagonal(best_any)  # strongest rumor about me
+        best_any_nd = jnp.where(diag, UNKNOWN_KEY, best_any)
+        best_alive_nd = jnp.where(diag, UNKNOWN_KEY, best_alive)
+        merged, _ = merge_views(view1, best_any_nd, best_alive_nd)
+        merged = jnp.where(alive[:, None], merged, view1)
 
-    # ------------------------------------------------- 4. SYNC anti-entropy
+    # ------------------------------------------------- 3. SYNC anti-entropy
     # Nodes that know nobody (fresh joiners/restarts) retry every tick — the
     # initial-sync path (start0, MembershipProtocolImpl.java:222-257).
     joining = (jnp.sum(cand, axis=1) == 0) & alive
 
     def sync_fire_phase(args):
-        best_any, best_alive = args
+        merged, self_rumor = args
         status1 = decode_status(view1)
         s_cand = (((view1 >= 0) & (status1 != _DEAD)) | seeds[None, :]) & ~diag
         prt, p_valid = masked_random_choice(k_ssel, s_cand)
@@ -256,13 +297,10 @@ def sim_tick(
         s_fwd = do_sync & p_valid & alive[prt] & link_pass(sk1, plan, i_idx, prt)
         s_rev = s_fwd & link_pass(sk2, plan, prt, i_idx)
 
+        best_any = deliver_rows_max(view1, prt[:, None], s_fwd[:, None], n)
         full_alive_rows = jnp.where(is_alive_key(view1), view1, UNKNOWN_KEY)
-        best_any = jnp.maximum(
-            best_any, deliver_rows_max(view1, prt[:, None], s_fwd[:, None], n)
-        )
-        best_alive = jnp.maximum(
-            best_alive,
-            deliver_rows_max(full_alive_rows, prt[:, None], s_fwd[:, None], n),
+        best_alive = deliver_rows_max(
+            full_alive_rows, prt[:, None], s_fwd[:, None], n
         )
         reply = view1[prt, :]  # SYNC_ACK: partner's full table to the caller
         best_any = jnp.maximum(best_any, jnp.where(s_rev[:, None], reply, UNKNOWN_KEY))
@@ -270,49 +308,48 @@ def sim_tick(
             best_alive,
             jnp.where(s_rev[:, None] & is_alive_key(reply), reply, UNKNOWN_KEY),
         )
-        return best_any, best_alive, jnp.sum(s_fwd) + jnp.sum(s_rev)
+        # A SYNC table may carry a rumor about the receiver itself — it feeds
+        # self-refutation like gossip rumors do.
+        self_rumor = jnp.maximum(self_rumor, jnp.diagonal(best_any))
+        best_any = jnp.where(diag, UNKNOWN_KEY, best_any)
+        best_alive = jnp.where(diag, UNKNOWN_KEY, best_alive)
+        # Fold SYNC tables into the already-gossip-merged view through the
+        # same lattice.
+        out, _ = merge_views(merged, best_any, best_alive)
+        out = jnp.where(alive[:, None], out, merged)
+        return out, self_rumor, jnp.sum(s_fwd) + jnp.sum(s_rev)
 
     def sync_skip_phase(args):
-        best_any, best_alive = args
-        return best_any, best_alive, jnp.asarray(0, jnp.int32)
+        merged, self_rumor = args
+        return merged, self_rumor, jnp.asarray(0, jnp.int32)
 
-    best_any, best_alive, msgs_sync = lax.cond(
+    merged, self_rumor, msgs_sync = lax.cond(
         do_sync_tick | jnp.any(joining),
         sync_fire_phase,
         sync_skip_phase,
-        (best_any, best_alive),
+        (merged, self_rumor),
     )
 
-    # Merge everything delivered off-diagonal through the lattice.
-    best_any_nd = jnp.where(diag, UNKNOWN_KEY, best_any)
-    best_alive_nd = jnp.where(diag, UNKNOWN_KEY, best_alive)
-    merged, mchanged = merge_views(view1, best_any_nd, best_alive_nd)
-    merged = jnp.where(alive[:, None], merged, view1)
-    mchanged = mchanged & alive[:, None]
-    changed = changed | mchanged
-
-    # --------------------------------------------------- 5. self-refutation
-    self_rumor = jnp.diagonal(best_any)  # strongest rumor about me this tick
-    own_key = jnp.diagonal(view1)
-    left = (own_key & DEAD_BIT) != 0
-    r_status = decode_status(self_rumor)
-    threat = (
-        alive
-        & ~left
-        & (self_rumor >= 0)
-        & (decode_epoch(self_rumor) == state.epoch)
-        & ((r_status == _SUSPECT) | (r_status == _DEAD))
-        & (decode_incarnation(self_rumor) >= state.inc_self)
-    )
-    inc_self = jnp.where(threat, decode_incarnation(self_rumor) + 1, state.inc_self)
-    own_new = encode_key(jnp.full((n,), _ALIVE, jnp.int32), inc_self, state.epoch)
-    view2 = jnp.where(diag & threat[:, None], own_new[:, None], merged)
-    changed = changed | (diag & threat[:, None])
+    # ---------------------- 4. suspicion sweep + aging + tombstones (fused)
+    # Countdown form: the timer decrements once per tick after the tick that
+    # armed it, so it hits 0 exactly suspicion_ticks later. ANY accepted
+    # override this tick (rearm below) cancels the pending timeout and — if
+    # the new record is still SUSPECT — schedules a fresh one, mirroring the
+    # reference's cancel+reschedule on update (:534, 612-635).
+    armed = state.suspect_left > 0
+    rearm = merged != view0
+    left0 = jnp.maximum(state.suspect_left.astype(jnp.int32) - 1, 0)
+    expired = alive[:, None] & armed & ~rearm & (left0 == 0) & (
+        (merged & DEAD_BIT) == 0
+    ) & ((merged & 1) != 0) & (merged >= 0)
+    dead_keys = (merged | DEAD_BIT) & ~jnp.int32(1)  # DEAD at same inc/epoch
+    view2 = jnp.where(expired, dead_keys, merged)
+    changed = (view2 != view0) & alive[:, None]
 
     rumor_age = jnp.where(
         changed,
         jnp.asarray(0, jnp.int8),
-        jnp.minimum(state.rumor_age, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
+        jnp.minimum(age0, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
     )
 
     # Tombstone expiry: the reference REMOVES an accepted DEAD record from the
@@ -331,21 +368,90 @@ def sim_tick(
     )
     view2 = jnp.where(tomb_expired, UNKNOWN_KEY, view2)
 
-    status2 = decode_status(view2)
-    is_susp = status2 == _SUSPECT
+    is_susp = ((view2 & 1) != 0) & ((view2 & DEAD_BIT) == 0) & (view2 >= 0)
     suspect_left = jnp.where(
-        is_susp & ~was_susp,
-        params.suspicion_ticks,
-        jnp.where(is_susp, left0, 0),
+        is_susp,
+        jnp.where(rearm | ~armed, params.suspicion_ticks, left0),
+        0,
     ).astype(jnp.int16)
     suspect_left = jnp.where(alive[:, None], suspect_left, state.suspect_left)
 
+    # --------------------------------------------------- 5. self-refutation
+    own_key = jnp.diagonal(view2)
+    left = (own_key & DEAD_BIT) != 0
+    r_status = decode_status(self_rumor)
+    threat = (
+        alive
+        & ~left
+        & (self_rumor >= 0)
+        & (decode_epoch(self_rumor) == state.epoch)
+        & ((r_status == _SUSPECT) | (r_status == _DEAD))
+        & (decode_incarnation(self_rumor) >= state.inc_self)
+    )
+    inc_self = jnp.where(threat, decode_incarnation(self_rumor) + 1, state.inc_self)
+    own_new = encode_key(jnp.full((n,), _ALIVE, jnp.int32), inc_self, state.epoch)
+    # Diagonal scatter (N elements) instead of an [N, N] where-pass.
+    view2 = view2.at[col, col].set(jnp.where(threat, own_new, own_key))
+    rumor_age = rumor_age.at[col, col].set(
+        jnp.where(threat, 0, jnp.diagonal(rumor_age))
+    )
+
     # ----------------------------------------------------- 6. user gossip
     urows = state.useen & (state.uage < params.periods_to_spread)
-    got = permuted_delivery(urows.astype(jnp.int32), inv_perm, edge_ok) > 0
+    nonself = inv_perm != col[None, :]  # [f, N]: sender != receiver
+    if params.track_user_infected:
+        # Per-rumor suppression (GossipState.infected, GossipState.java:17-38;
+        # selectGossipsToSend, GossipProtocolImpl.java:242-251): sender s
+        # skips slot g for peer j once j previously pushed g to s.
+        rcv = jnp.arange(n, dtype=jnp.int32)
+        sent_cols = []
+        uinf = state.uinf
+        for c in range(params.gossip_fanout):
+            s = inv_perm[c]
+            known = uinf[s, rcv, :]  # [N, G]: does sender s know receiver j has g?
+            sent_c = (
+                urows[s]
+                & ~known
+                & (alive[s] & nonself[c])[:, None]
+            )  # [N, G] — message content sent along edge c (loss-independent)
+            sent_cols.append(sent_c)
+        got = jnp.zeros_like(urows)
+        uinf_new = uinf
+        onehots = col[None, :] == inv_perm[:, :, None]  # [f, N(recv), N]
+        for c in range(params.gossip_fanout):
+            arrived = sent_cols[c] & edge_ok[c][:, None]  # [N, G]
+            got = got | arrived
+            # Receiver j marks sender inv_perm[c, j] infected for each slot
+            # that arrived (onGossipReq, GossipProtocolImpl.java:171-183).
+            uinf_new = uinf_new | (onehots[c][:, :, None] & arrived[:, None, :])
+        msgs_user = sum(jnp.sum(s, axis=0) for s in sent_cols)  # [G] sends
+    else:
+        got = permuted_delivery(urows.astype(jnp.int32), inv_perm, edge_ok) > 0
+        uinf_new = state.uinf
+        # Without suppression tracking, a send happens on every live non-self
+        # edge whose sender holds a young copy of the slot.
+        msgs_user = sum(
+            jnp.sum(
+                urows[inv_perm[c]] & (alive[inv_perm[c]] & nonself[c])[:, None],
+                axis=0,
+            )
+            for c in range(params.gossip_fanout)
+        )
     new_seen = state.useen | (got & alive[:, None])
     first_seen = new_seen & ~state.useen
     uage = jnp.where(first_seen, 0, jnp.minimum(state.uage + 1, _AGE_CAP))
+    # Sweep/recycle (sweepGossips, GossipProtocolImpl.java:281-304): a slot
+    # older than periods_to_sweep leaves the local gossip map, freeing it for
+    # reuse by a later spread. Safe against re-infection for the same reason
+    # the reference's dedup-map removal is: by the earliest sweep, every
+    # copy's age exceeds sweep - spread > spread, so nobody spreads it
+    # anymore. A host-side spread() future resolves via
+    # sim/monitor.py::user_gossip_swept.
+    swept = new_seen & (uage > params.periods_to_sweep)
+    new_seen = new_seen & ~swept
+    if params.track_user_infected:
+        # Sweeping drops the whole GossipState, infected set included.
+        uinf_new = uinf_new & ~swept[:, None, :]
 
     # ------------------------------------------------------------- metrics
     new_state = state.replace(
@@ -355,12 +461,14 @@ def sim_tick(
         inc_self=inc_self,
         useen=new_seen,
         uage=uage,
+        uinf=uinf_new,
         tick=t,
         rng=rng_next,
     )
     if not collect:
         return new_state, {"tick": t}
 
+    status2 = decode_status(view2)
     n_alive = jnp.sum(alive)
     truth_alive = alive[None, :] & (decode_epoch(view2) == state.epoch[None, :])
     ok_alive = truth_alive & (status2 == _ALIVE)
@@ -368,16 +476,23 @@ def sim_tick(
     match = jnp.where(alive[None, :], ok_alive, ok_dead) | diag
     viewer_conv = jnp.mean(match, axis=1)
     convergence = jnp.sum(viewer_conv * alive) / jnp.maximum(n_alive, 1)
+    # A membership-gossip MESSAGE exists only when the sender has something
+    # young to say (selectGossipsToSend returns non-empty,
+    # GossipProtocolImpl.java:242-251) — idle periods send nothing, so the
+    # count is comparable to ClusterMath.maxMessagesPerGossip
+    # (ClusterMath.java:53-67). Counted at the sender (loss doesn't unsend).
+    sender_active = jnp.any(age0 < params.periods_to_spread, axis=1)
+    msgs_gossip = sum(
+        jnp.sum(sender_active[inv_perm[c]] & alive[inv_perm[c]] & nonself[c])
+        for c in range(params.gossip_fanout)
+    )
     metrics = {
         "tick": t,
         "convergence": convergence,
         "n_alive": n_alive,
         "n_suspected": jnp.sum(is_susp & alive[:, None]),
-        # Real messages only: exclude permutation self-edges and sends to
-        # dead processes (the reference never delivers either).
-        "msgs_gossip": jnp.sum(
-            edge_ok & alive[None, :] & (inv_perm != col[None, :])
-        ),
+        "msgs_gossip": msgs_gossip,
+        "msgs_user": msgs_user,
         "msgs_fd": msgs_fd,
         "msgs_sync": msgs_sync,
         "gossip_coverage": jnp.sum(new_seen & alive[:, None], axis=0)

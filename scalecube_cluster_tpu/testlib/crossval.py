@@ -142,3 +142,188 @@ async def compare_dissemination(
         "max_abs_gap": float(np.max(np.abs(host.coverage - sim.coverage))),
         "mean_abs_gap": float(np.mean(np.abs(host.coverage - sim.coverage))),
     }
+
+
+# ---------------------------------------------------------------------------
+# Period-indexed gossip-only comparison (round-2 tightening, VERDICT item 5).
+#
+# The full-cluster comparison above samples the host curve on wall-clock
+# sleeps, which smears the curve whenever the event loop is loaded — the
+# dominant term in its 15-20% gaps. This harness removes both confounders:
+# only the gossip protocol runs (no FD/SYNC traffic), and the host curve is
+# sampled on the origin's own period counter, the exact x-axis the sim uses.
+# It also compares rumor-bearing MESSAGE COUNTS, which the sim now tracks
+# with reference-equivalent per-rumor suppression (sim/tick.py step 6).
+# ---------------------------------------------------------------------------
+
+
+async def host_gossip_mesh_run(
+    n: int,
+    loss_percent: float,
+    periods: int,
+    seed: int = 0,
+) -> tuple[np.ndarray, int]:
+    """Gossip-only mesh trial: ``(coverage[periods] by period, total sends)``.
+
+    Mirrors GossipProtocolTest.java:48-64's experiment setup (protocol
+    instances over emulator transports, no membership machinery).
+    """
+    import random
+
+    from scalecube_cluster_tpu.cluster.gossip import GossipProtocol
+    from scalecube_cluster_tpu.cluster_api.config import GossipConfig
+    from scalecube_cluster_tpu.cluster_api.member import Member
+    from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+    from scalecube_cluster_tpu.testlib.network_emulator import (
+        NetworkEmulatorTransport,
+    )
+    from scalecube_cluster_tpu.transport.tcp import TcpTransport
+
+    config = GossipConfig(gossip_interval=50, gossip_fanout=3, gossip_repeat_mult=3)
+    transports, members, protocols = [], [], []
+    for i in range(n):
+        t = NetworkEmulatorTransport(await TcpTransport.bind(), seed=seed * 1000 + i)
+        if loss_percent:
+            t.network_emulator.set_default_outbound_settings(loss_percent)
+        m = Member.create(t.address)
+        transports.append(t)
+        members.append(m)
+        protocols.append(
+            GossipProtocol(t, m, config, rng=random.Random(f"{seed}-{i}"))
+        )
+    got = [False] * n
+    got[0] = True
+    watchers = []
+
+    async def watch(idx, proto):
+        async for _ in proto.listen():
+            got[idx] = True
+
+    try:
+        for i, p in enumerate(protocols):
+            for m in members:
+                if m is not members[i]:
+                    p.on_membership_event(MembershipEvent.added(m))
+            p.start()
+            watchers.append(asyncio.ensure_future(watch(i, p)))
+        protocols[0].spread(Message.create(qualifier="xval", data="payload"))
+        coverage = np.zeros(periods)
+        origin = protocols[0]
+        p_seen = origin.period
+        filled = 0
+        while filled < periods:
+            await asyncio.sleep(0.002)
+            if origin.period > p_seen:
+                # Record one sample per elapsed origin period (period-indexed
+                # x-axis — immune to event-loop scheduling jitter).
+                for _ in range(origin.period - p_seen):
+                    if filled < periods:
+                        coverage[filled] = sum(got) / n
+                        filled += 1
+                p_seen = origin.period
+        sends = sum(
+            t.network_emulator.total_message_sent_count for t in transports
+        )
+        return coverage, sends
+    finally:
+        for w in watchers:
+            w.cancel()
+        for p in protocols:
+            p.stop()
+        await asyncio.gather(
+            *(t.stop() for t in transports), return_exceptions=True
+        )
+
+
+def sim_gossip_run(
+    n: int,
+    loss_percent: float,
+    periods: int,
+    trials: int = 5,
+    seed: int = 0,
+) -> tuple[np.ndarray, float]:
+    """Sim twin of :func:`host_gossip_mesh_run` with suppression tracking:
+    ``(mean coverage[periods], mean total rumor-bearing sends)``."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    from scalecube_cluster_tpu.sim import (
+        FaultPlan,
+        SimParams,
+        init_full_view,
+        inject_gossip,
+        run_ticks,
+    )
+    from scalecube_cluster_tpu.sim.state import seeds_mask
+
+    params = SimParams(
+        n=n,
+        gossip_fanout=3,
+        periods_to_spread=cluster_math_spread(n),
+        periods_to_sweep=2 * (cluster_math_spread(n) + 1),
+        # Disable FD/SYNC cadences: gossip-only, like the host mesh.
+        fd_period_ticks=10 * periods,
+        sync_period_ticks=10 * periods,
+        suspicion_ticks=10 * periods,
+        user_gossip_slots=1,
+        track_user_infected=True,
+    )
+    plan = FaultPlan.clean(n).with_loss(loss_percent)
+    seeds = seeds_mask(n, [0])
+    curves, sends = [], []
+    for trial in range(trials):
+        state = init_full_view(
+            n, user_gossip_slots=1, seed=seed + trial, track_infected=True
+        )
+        state = inject_gossip(state, 0, 0)
+        _, traces = run_ticks(params, state, plan, seeds, periods)
+        curves.append(np.asarray(jnp.stack(traces["gossip_coverage"])[:, 0]))
+        sends.append(float(np.sum(np.asarray(traces["msgs_user"])[:, 0])))
+    return np.mean(curves, axis=0), float(np.mean(sends))
+
+
+def cluster_math_spread(n: int) -> int:
+    from scalecube_cluster_tpu import cluster_math
+
+    return cluster_math.gossip_periods_to_spread(3, n)
+
+
+async def compare_gossip_mesh(
+    n: int, loss_percent: float, periods: int, trials: int = 3
+) -> dict:
+    """Period-indexed cross-backend comparison: curves + message counts."""
+    host_curves, host_sends = [], []
+    for trial in range(trials):
+        cov, sends = await host_gossip_mesh_run(
+            n, loss_percent, periods, seed=trial
+        )
+        host_curves.append(cov)
+        host_sends.append(sends)
+    host_cov = np.mean(host_curves, axis=0)
+    sim_cov, sim_sends = sim_gossip_run(
+        n, loss_percent, periods, trials=trials
+    )
+    host_sends_mean = float(np.mean(host_sends))
+    # Aligned gap: the host's first sends wait for its next period boundary
+    # (spread() enqueues; doSpreadGossip fires on the timer,
+    # GossipProtocolImpl.java:106-111) and listener delivery adds sub-period
+    # latency, so the host curve lags the sim's by 0-2 periods of pure
+    # phase offset. Comparing at the best small shift isolates curve SHAPE —
+    # the quantity the ±2% north-star target is about.
+    gaps = []
+    for shift in range(3):
+        a = host_cov[shift:]
+        b = sim_cov[: len(a)] if shift else sim_cov
+        gaps.append(float(np.mean(np.abs(a - b))))
+    return {
+        "host": DisseminationCurve.summarize(host_cov),
+        "sim": DisseminationCurve.summarize(sim_cov),
+        "mean_abs_gap": gaps[0],
+        "max_abs_gap": float(np.max(np.abs(host_cov - sim_cov))),
+        "aligned_mean_gap": min(gaps),
+        "align_shift": int(np.argmin(gaps)),
+        "host_sends": host_sends_mean,
+        "sim_sends": sim_sends,
+        "sends_ratio": sim_sends / host_sends_mean if host_sends_mean else np.inf,
+    }

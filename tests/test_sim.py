@@ -191,8 +191,10 @@ def test_user_gossip_under_loss():
     plan = FaultPlan.clean(n).with_loss(50.0)
     st, tr = run_ticks(p, st, plan, seeds_mask(n, [0]), 40)
     # The reference's worst tested grid: N=50, 50% loss still disseminates
-    # (GossipProtocolTest.java:48-64).
-    assert float(tr["gossip_coverage"][-1, 1]) == 1.0
+    # (GossipProtocolTest.java:48-64). Peak coverage (not the final tick):
+    # the 40-tick run crosses the sweep deadline, after which early-infected
+    # slots recycle and leave the coverage count.
+    assert float(jnp.max(tr["gossip_coverage"][:, 1])) == 1.0
 
 
 def test_delay_below_deadline_harmless_above_fatal():
@@ -214,10 +216,12 @@ def test_delay_below_deadline_harmless_above_fatal():
     st = init_full_view(n, user_gossip_slots=2)
     st, tr = run_ticks(p, st, heavy, sm, 80)
     assert int(tr["n_suspected"][-1]) > n  # widespread missed deadlines
-    # ...but gossip (no deadline) still disseminates fine.
+    # ...but gossip (no deadline) still disseminates fine. Peak coverage:
+    # the 25-tick window crosses the sweep deadline (18), after which
+    # early-infected slots recycle out of the coverage count.
     st = inject_gossip(st, 0, 0)
     st, tr = run_ticks(p, st, heavy, sm, 25)
-    assert float(tr["gossip_coverage"][-1, 0]) == 1.0
+    assert float(jnp.max(tr["gossip_coverage"][:, 0])) == 1.0
 
 
 def test_determinism():
@@ -292,3 +296,35 @@ def test_mesh2d_equals_single(shape):
             jax.device_get(tr_sh["convergence"]) == jax.device_get(tr_ref["convergence"])
         )
     )
+
+
+def test_user_gossip_message_counts_within_cluster_math_envelope():
+    """With per-rumor infected tracking on, total rumor-bearing sends for one
+    gossip stay within the ClusterMath ceiling AND below the unsuppressed
+    count — the sim twin of GossipProtocolTest.java:176-203 validating
+    maxMessagesPerGossipTotal (ClusterMath.java:53-67)."""
+    import dataclasses
+
+    from scalecube_cluster_tpu.sim.state import init_full_view as init
+
+    n = 48
+    spread = 12
+    window = 40  # run past spread so every send for this rumor is counted
+
+    def total_sends(track: bool) -> int:
+        p = small_params(
+            n, periods_to_spread=spread, periods_to_sweep=30
+        )
+        p = dataclasses.replace(p, track_user_infected=track)
+        st = init(n, user_gossip_slots=1, seed=2, track_infected=track)
+        st = inject_gossip(st, 0, 0)
+        st, tr = run_ticks(p, st, FaultPlan.clean(n), seeds_mask(n, [0]), window)
+        return int(jnp.sum(tr["msgs_user"][:, 0]))
+
+    ceiling = n * 3 * spread  # n × fanout × periodsToSpread (ClusterMath)
+    suppressed = total_sends(True)
+    unsuppressed = total_sends(False)
+    assert suppressed <= ceiling, f"{suppressed} exceeds envelope {ceiling}"
+    assert unsuppressed <= ceiling
+    # Suppression must actually suppress: strictly fewer sends.
+    assert suppressed < unsuppressed
