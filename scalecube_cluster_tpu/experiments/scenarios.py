@@ -2,7 +2,12 @@
 
 BASELINE.json names five configs; the first (3-node Alice/Bob/Carol join over
 real sockets) lives in examples/cluster_join.py on the host backend, the
-other four run here on the sim engine at any scale:
+other four run here on the dense sim engine. Scale envelope: the dense
+engine's int8 rumor-age representation requires
+``periods_to_sweep = 2*(repeat_mult*ceil_log2(n+1)+1) < 120`` (SimParams
+raises otherwise), which with LAN defaults (repeat_mult 3) caps the DENSE
+engine near n = 2^19; memory caps it sooner (~16k single-chip). Beyond that,
+the compact-rumor engine (sim/sparse.py) is the 100k-scale path:
 
 1. ``join_scenario``               — cold join of n members to s seeds
    (cluster-testlib 100-member in-process cluster analog)

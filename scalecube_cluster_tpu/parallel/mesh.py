@@ -92,3 +92,39 @@ def shard_plan(plan: FaultPlan, mesh: Mesh) -> FaultPlan:
     mat, _, _ = _specs(mesh)
     row = NamedSharding(mesh, mat)
     return jax.device_put(plan, FaultPlan(block=row, loss=row, mean_delay=row))
+
+
+def sparse_state_shardings(mesh: Mesh):
+    """A SparseState-shaped pytree of NamedShardings (sim/sparse.py).
+
+    The viewer axis shards across ``"members"``: ``view_T`` is subject-major
+    ``[N_subj, N_view]`` so each device holds all subjects × its viewers —
+    slab load/store (``view_T[j, :]`` rows) is then a device-local slice of
+    the row, and the working-set slab ``[N_view, S]`` shards its viewer rows
+    the same way. Slot tables are replicated (every device needs the full
+    subject↔slot mapping for its gathers).
+    """
+    from scalecube_cluster_tpu.sim.sparse import SparseState
+
+    row = NamedSharding(mesh, P(None, AXIS))  # view_T [subj, viewer]
+    slabrow = NamedSharding(mesh, P(AXIS, None))  # slab/age/susp [viewer, S]
+    vec = NamedSharding(mesh, P(AXIS))
+    rep = NamedSharding(mesh, P())
+    return SparseState(
+        view_T=row,
+        slot_subj=rep,
+        subj_slot=rep,
+        slab=slabrow,
+        age=slabrow,
+        susp=slabrow,
+        inc_self=vec,
+        epoch=vec,
+        alive=vec,
+        tick=rep,
+        rng=rep,
+    )
+
+
+def shard_sparse_state(state, mesh: Mesh):
+    """Place a host-built SparseState onto the mesh."""
+    return jax.device_put(state, sparse_state_shardings(mesh))

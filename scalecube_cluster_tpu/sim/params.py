@@ -60,6 +60,10 @@ class SimParams:
     def __post_init__(self):
         # Dtype envelopes of the state arrays (sim/state.py): rumor_age is
         # int8 saturating at AGE_STALE=120, suspect_left is an int16 countdown.
+        # With LAN defaults (repeat_mult 3) the sweep formula stays under 120
+        # up to n = 2^19 - 1 members; beyond that from_cluster_config raises
+        # here — by design, since the dense engine is memory-bound long
+        # before (use sim/sparse.py at that scale).
         if not self.periods_to_spread < self.periods_to_sweep < 120:
             raise ValueError(
                 "need periods_to_spread < periods_to_sweep < AGE_STALE=120"

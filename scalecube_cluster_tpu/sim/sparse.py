@@ -1,0 +1,575 @@
+"""Sparse (compact-rumor) engine: 100k-member SWIM on a bounded working set.
+
+The dense engine (sim/tick.py) touches all [N, N] state every tick, so its
+per-tick cost and memory scale O(N²) — fine to ~16k members on one chip,
+priced out at the BASELINE 100k target (SURVEY.md §7 hard part 4,
+ClusterMath.java:111-135 scale laws). This engine exploits the protocol
+fact that at any instant only a bounded set of subjects is being rumored
+about: every record either (a) changed within the last
+``periods_to_spread`` ticks somewhere, (b) has an armed suspicion timer, or
+(c) is inert and identical to the last write-back. Inert records never move.
+
+Representation:
+
+- ``view_T [N_subj, N_viewer] int32`` — the full membership tables,
+  subject-major so one subject's records are one contiguous row. STALE for
+  subjects currently loaded in the slab. Sharded over viewers (each device
+  holds all subjects × its viewers), so slab load/store is device-local.
+- slot table: ``slot_subj [S]`` (subject of slot, -1 free) and
+  ``subj_slot [N]`` (slot of subject, -1 inactive). S = ``slot_budget``.
+- working set ("the slab"), viewer-major for delivery/merge locality:
+  ``slab   [N_viewer, S] int32`` record keys,
+  ``age    [N_viewer, S] int8``  rumor ages (gossip young-mask),
+  ``susp   [N_viewer, S] int16`` suspicion countdowns (armed timers pin the
+  slot — suspicion outlives the rumor-young window).
+- dense per-member vectors as in the dense engine: ``inc_self``, ``epoch``,
+  ``alive``.
+
+Per tick (all reusing the dense engine's ops on [N, S] instead of [N, N]):
+slot free/alloc → slab load → gossip delivery + lattice merge
+(ops/delivery.py + ops/merge.py, M=S) → suspicion sweep → aging + tombstone
+demotion → self-refutation — plus cond-gated FD and own-record SYNC that
+generate activation requests.
+
+Documented deviations from the dense engine (and the reference), beyond
+those in sim/tick.py — the scenario tests are the fidelity oracle:
+
+- FD probe targets/relays are uniform random members, validity-checked
+  against the viewer's table, instead of Gumbel-top-k over the full
+  candidate matrix (O(N) vs O(N²) selection; same expected probe rate —
+  an invalid pick skips that node's round, rare in steady state).
+- SYNC exchanges only the partners' OWN records (O(1) payload), not full
+  tables (O(N) — the reference ships the entire table per SYNC,
+  SyncData.java:11-41, which is itself impractical at 100k members). Healing
+  still works: learning one re-introduced member is a table change, which
+  gossips cluster-wide and re-seeds anti-entropy; the joining path loads the
+  seed's table directly (host op), like initial sync.
+- The working set is bounded: at most ``alloc_cap`` subjects activate per
+  tick and at most ``slot_budget`` are active at once; overflow requests are
+  dropped and counted in the ``slot_overflow`` metric (the reference's
+  unbounded gossip map has the same practical bound — memory).
+- User-gossip slots are not modeled here (the dense engine covers them;
+  nothing about them is N²-bound).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.tree_util import register_dataclass
+
+from scalecube_cluster_tpu.cluster_api.member import MemberStatus
+from scalecube_cluster_tpu.ops.delivery import (
+    GROUP,
+    fanout_permutations_structured,
+)
+from scalecube_cluster_tpu.ops.merge import (
+    DEAD_BIT,
+    UNKNOWN_KEY,
+    decode_epoch,
+    decode_incarnation,
+    decode_status,
+    encode_key,
+    is_alive_key,
+    merge_views,
+    overrides_same_epoch,
+)
+from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass, round_trip_in_time
+from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.state import AGE_STALE
+
+_ALIVE = int(MemberStatus.ALIVE)
+_SUSPECT = int(MemberStatus.SUSPECT)
+_DEAD = int(MemberStatus.DEAD)
+
+
+@dataclass(frozen=True)
+class SparseParams:
+    """Static constants: the dense protocol constants + working-set bounds."""
+
+    base: SimParams
+    #: Max simultaneously active subjects (the slab width S).
+    slot_budget: int = 2048
+    #: Max subject activations per tick.
+    alloc_cap: int = 64
+
+    @classmethod
+    def for_n(cls, n: int, slot_budget: int = 2048, alloc_cap: int = 64, **kw):
+        return cls(
+            base=SimParams.from_cluster_config(n, **kw),
+            slot_budget=slot_budget,
+            alloc_cap=alloc_cap,
+        )
+
+
+@register_dataclass
+@dataclass
+class SparseState:
+    """Working-set state of an N-member sparse-engine cluster."""
+
+    view_T: jax.Array  # [N_subj, N_view] int32, subject-major, stale-if-active
+    slot_subj: jax.Array  # [S] int32 subject of slot, -1 free
+    subj_slot: jax.Array  # [N] int32 slot of subject, -1 inactive
+    slab: jax.Array  # [N_view, S] int32 working keys
+    age: jax.Array  # [N_view, S] int8
+    susp: jax.Array  # [N_view, S] int16
+    inc_self: jax.Array  # [N] int32
+    epoch: jax.Array  # [N] int32
+    alive: jax.Array  # [N] bool
+    tick: jax.Array  # [] int32
+    rng: jax.Array
+
+    def replace(self, **changes) -> "SparseState":
+        return dataclasses.replace(self, **changes)
+
+
+def init_sparse_full_view(n: int, slot_budget: int = 2048, seed: int = 0) -> SparseState:
+    """Post-join steady state, nothing active: the common 100k starting point."""
+    return SparseState(
+        view_T=jnp.full((n, n), encode_key(0, 0), jnp.int32),
+        slot_subj=jnp.full((slot_budget,), -1, jnp.int32),
+        subj_slot=jnp.full((n,), -1, jnp.int32),
+        slab=jnp.full((n, slot_budget), UNKNOWN_KEY, jnp.int32),
+        age=jnp.full((n, slot_budget), AGE_STALE, jnp.int8),
+        susp=jnp.zeros((n, slot_budget), jnp.int16),
+        inc_self=jnp.zeros((n,), jnp.int32),
+        epoch=jnp.zeros((n,), jnp.int32),
+        alive=jnp.ones((n,), bool),
+        tick=jnp.zeros((), jnp.int32),
+        rng=jax.random.PRNGKey(seed),
+    )
+
+
+def _activate_on_host(state: SparseState, subject: int) -> tuple[SparseState, int]:
+    """Host-side slot allocation for control-plane ops (kill/leave/restart).
+
+    Loads the subject's column into a free slot if not already active.
+    Returns ``(state, slot)``.
+    """
+    cur = int(state.subj_slot[subject])
+    if cur >= 0:
+        return state, cur
+    free = jnp.flatnonzero(state.slot_subj < 0, size=1, fill_value=-1)[0]
+    s = int(free)
+    if s < 0:
+        raise RuntimeError("slot budget exhausted for host op")
+    return (
+        state.replace(
+            slot_subj=state.slot_subj.at[s].set(subject),
+            subj_slot=state.subj_slot.at[subject].set(s),
+            slab=state.slab.at[:, s].set(state.view_T[subject, :]),
+            age=state.age.at[:, s].set(AGE_STALE),
+            susp=state.susp.at[:, s].set(0),
+        ),
+        s,
+    )
+
+
+def kill_sparse(state: SparseState, idx: int) -> SparseState:
+    """Hard-stop process ``idx`` (dense twin: sim/state.py::kill)."""
+    return state.replace(alive=state.alive.at[idx].set(False))
+
+
+def leave_sparse(state: SparseState, idx: int) -> SparseState:
+    """Graceful leave: self-DEAD at inc+1 rides normal gossip
+    (dense twin: sim/state.py::leave)."""
+    state, s = _activate_on_host(state, idx)
+    inc = state.inc_self[idx] + 1
+    dead_key = encode_key(jnp.asarray(_DEAD), inc, state.epoch[idx])
+    return state.replace(
+        inc_self=state.inc_self.at[idx].set(inc),
+        slab=state.slab.at[idx, s].set(dead_key),
+        age=state.age.at[idx, s].set(0),
+    )
+
+
+def restart_sparse(state: SparseState, idx: int) -> SparseState:
+    """Restart slot ``idx`` as a new identity (epoch bump), rejoining with a
+    seed-loaded table (the initial-sync outcome as a host op — dense twin:
+    sim/state.py::restart + the join SYNC)."""
+    n = state.view_T.shape[0]
+    new_epoch = state.epoch[idx] + 1
+    self_key = encode_key(jnp.asarray(_ALIVE), jnp.asarray(0), new_epoch)
+    # The restarted process forgets its table (fresh join: copy a live seed's
+    # view — here subject-major column idx across all subjects).
+    seed_viewer = int(jnp.argmax(state.alive))
+    state = state.replace(
+        alive=state.alive.at[idx].set(True),
+        epoch=state.epoch.at[idx].set(new_epoch),
+        inc_self=state.inc_self.at[idx].set(0),
+        view_T=state.view_T.at[:, idx].set(state.view_T[:, seed_viewer]),
+        slab=state.slab.at[idx, :].set(state.slab[seed_viewer, :]),
+        age=state.age.at[idx, :].set(AGE_STALE),
+        susp=state.susp.at[idx, :].set(0),
+    )
+    state, s = _activate_on_host(state, idx)
+    # Announce the new identity (ALIVE at the new epoch, young).
+    return state.replace(
+        slab=state.slab.at[idx, s].set(self_key),
+        age=state.age.at[idx, s].set(0),
+    )
+
+
+@partial(jax.jit, static_argnums=0, static_argnames=("collect",))
+def sparse_tick(
+    params: SparseParams,
+    state: SparseState,
+    plan: FaultPlan,
+    collect: bool = True,
+):
+    """One gossip period on the working set. Returns ``(state, metrics)``."""
+    p = params.base
+    n, S = p.n, params.slot_budget
+    if n % GROUP != 0:
+        raise ValueError("sparse engine needs n % 8 == 0 (structured fan-out)")
+    t = state.tick + 1
+    (rng_next, k_tgt, k_ping, k_relay, k_gsel, k_glink, k_ssel, k_slink) = (
+        jax.random.split(state.rng, 8)
+    )
+    col = jnp.arange(n, dtype=jnp.int32)
+    srange = jnp.arange(S, dtype=jnp.int32)
+    alive = state.alive
+
+    do_fd = (t % p.fd_period_ticks) == 0
+    do_sync = (t % p.sync_period_ticks) == 0
+
+    def my_record_of(viewer, subject):
+        """view[viewer, subject] through the slab indirection ([K]-sized)."""
+        s = state.subj_slot[subject]
+        from_slab = state.slab[viewer, jnp.where(s >= 0, s, 0)]
+        return jnp.where(s >= 0, from_slab, state.view_T[subject, viewer])
+
+    # ------------------------------------------------------------------ 1. FD
+    # Uniform target sampling ([N] work) instead of Gumbel-top-k over [N, N]
+    # (module docstring deviation 1).
+    def fd_fire_phase(_):
+        tgt = jax.random.randint(k_tgt, (n,), 0, n, jnp.int32)
+        vkey = my_record_of(col, tgt)
+        valid = (tgt != col) & (vkey >= 0) & ((vkey & DEAD_BIT) == 0)
+        probing = alive & valid
+        pk1, pk2, pk3 = jax.random.split(k_ping, 3)
+        fwd_ok = link_pass(pk1, plan, col, tgt)
+        ack_ok = link_pass(pk2, plan, tgt, col)
+        rt_ok = round_trip_in_time(
+            pk3, plan, [(col, tgt), (tgt, col)], p.ping_timeout_ms
+        )
+        direct = probing & alive[tgt] & fwd_ok & ack_ok & rt_ok
+
+        kr, rk1, rk2, rk3, rk4, rk5 = jax.random.split(k_relay, 6)
+        ridx = jax.random.randint(kr, (n, p.ping_req_members), 0, n, jnp.int32)
+        rkey = my_record_of(col[:, None], ridx)
+        rvalid = (
+            (ridx != col[:, None])
+            & (ridx != tgt[:, None])
+            & (rkey >= 0)
+            & ((rkey & DEAD_BIT) == 0)
+        )
+        legs = (
+            link_pass(rk1, plan, col[:, None], ridx)
+            & link_pass(rk2, plan, ridx, tgt[:, None])
+            & link_pass(rk3, plan, tgt[:, None], ridx)
+            & link_pass(rk4, plan, ridx, col[:, None])
+        )
+        path_ok = round_trip_in_time(
+            rk5,
+            plan,
+            [(col[:, None], ridx), (ridx, tgt[:, None]),
+             (tgt[:, None], ridx), (ridx, col[:, None])],
+            p.ping_req_timeout_ms,
+        )
+        relay = rvalid & alive[ridx] & alive[tgt][:, None] & legs & path_ok
+        reached = direct | (probing & jnp.any(relay, axis=1))
+        gone = reached & (state.epoch[tgt] != decode_epoch(vkey))
+        fd_key = encode_key(
+            jnp.where(gone, _DEAD, _SUSPECT),
+            decode_incarnation(vkey),
+            decode_epoch(vkey),
+        )
+        fire = ((probing & ~reached) | gone) & overrides_same_epoch(fd_key, vkey)
+        msgs = jnp.sum(probing) + jnp.sum((probing & ~direct)[:, None] & rvalid)
+        return tgt, fd_key, fire, msgs
+
+    def fd_skip_phase(_):
+        return (
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), bool),
+            jnp.asarray(0, jnp.int32),
+        )
+
+    fd_tgt, fd_key, fd_fire, msgs_fd = lax.cond(
+        do_fd, fd_fire_phase, fd_skip_phase, None
+    )
+
+    # ------------------------------------- 2. own-record SYNC (cond-gated)
+    # Partner uniform-random; exchange own records both directions
+    # (module docstring deviation 2). Produces per-node "learned" records
+    # about the partner subjects.
+    def sync_fire_phase(_):
+        prt = jax.random.randint(k_ssel, (n,), 0, n, jnp.int32)
+        ok = (
+            alive
+            & alive[prt]
+            & (prt != col)
+            & link_pass(k_slink, plan, col, prt)
+        )
+        # I learn the partner's own-record (their table row about themselves).
+        learned_key = encode_key(
+            jnp.full((n,), _ALIVE, jnp.int32), state.inc_self[prt], state.epoch[prt]
+        )
+        mine = my_record_of(col, prt)
+        # Accept test mirrors merge: same-epoch override or alive-introduction.
+        same = (mine >= 0) & (decode_epoch(mine) == decode_epoch(learned_key))
+        accept = ok & (
+            (same & overrides_same_epoch(learned_key, mine))
+            | (~same & ((mine < 0) | (decode_epoch(learned_key) > decode_epoch(mine))))
+        )
+        return prt, learned_key, accept, jnp.sum(ok) * 2
+
+    def sync_skip_phase(_):
+        return (
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), bool),
+            jnp.asarray(0, jnp.int32),
+        )
+
+    sy_subj, sy_key, sy_accept, msgs_sync = lax.cond(
+        do_sync, sync_fire_phase, sync_skip_phase, None
+    )
+
+    # -------------------------------------------- 3. slot free + allocation
+    # A slot stays pinned while any LIVE viewer still has (a) a young copy,
+    # (b) an armed suspicion, or (c) a DEAD tombstone that has not yet aged
+    # past the sweep deadline — (c) keeps the dense engine's
+    # second-chance-after-sweep heal path: the tombstone must demote to
+    # UNKNOWN on write-back, not persist in view_T forever. Dead viewers
+    # never pin (their rows are inert until restart).
+    active = state.slot_subj >= 0
+    own_row = col[:, None] == state.slot_subj[None, :]  # viewer == subject
+    dead_rec = ((state.slab & DEAD_BIT) != 0) & (state.slab >= 0)
+    stale_done = state.age.astype(jnp.int32) > p.periods_to_sweep
+    holding = (
+        (state.age < p.periods_to_spread)
+        | (state.susp > 0)
+        | (dead_rec & ~stale_done & ~own_row)
+    )
+    pinned = jnp.any(holding & alive[:, None], axis=0)
+    freeing = active & ~pinned
+    # Tombstone demotion on write-back: a DEAD record whose rumor fully aged
+    # out becomes UNKNOWN (the dense engine's tomb_expired, sim/tick.py) —
+    # except the subject's own row (a leaver keeps its own tombstone).
+    demote = dead_rec & stale_done & ~own_row
+    writeback = jnp.where(demote, UNKNOWN_KEY, state.slab)  # [N_view, S]
+    # Scatter freed slots' columns back into view_T rows (subject-major:
+    # one contiguous row per freed slot). Non-freeing slots route out of
+    # bounds and are dropped — freed subjects are unique, so no clobbering.
+    wb_subj = jnp.where(freeing, state.slot_subj, n)
+    view_T = state.view_T.at[wb_subj, :].set(writeback.T, mode="drop")
+    slot_subj = jnp.where(freeing, -1, state.slot_subj)
+    subj_slot = state.subj_slot.at[wb_subj].set(-1, mode="drop")
+
+    # Activation requests: FD-fired targets + SYNC-learned subjects.
+    req = jnp.zeros((n,), bool)
+    req = req.at[fd_tgt].max(fd_fire)
+    req = req.at[sy_subj].max(sy_accept)
+    req = req & (subj_slot < 0)
+    # Rank requests; grant the first alloc_cap into the first free slots.
+    cap = params.alloc_cap
+    req_rank = jnp.cumsum(req.astype(jnp.int32)) - 1  # rank among requests
+    granted = req & (req_rank < cap)
+    free_slots = jnp.flatnonzero(slot_subj < 0, size=cap, fill_value=S - 1)
+    n_free = jnp.sum(slot_subj < 0)
+    granted = granted & (req_rank < n_free)
+    new_subjects = jnp.flatnonzero(granted, size=cap, fill_value=0)
+    n_granted = jnp.sum(granted)
+    grant_valid = jnp.arange(cap) < jnp.minimum(n_granted, n_free)
+    slot_overflow = jnp.sum(req) - n_granted
+
+    # Invalid grants route out of bounds (dropped); valid targets are
+    # genuinely-free distinct slots, valid subjects distinct requests.
+    tgt_slots = jnp.where(grant_valid, free_slots, S)
+    grant_subj = jnp.where(grant_valid, new_subjects, n)
+    slot_subj = slot_subj.at[tgt_slots].set(new_subjects, mode="drop")
+    subj_slot = subj_slot.at[grant_subj].set(free_slots, mode="drop")
+    # Load the activated subjects' rows into their slab columns.
+    loaded = view_T[new_subjects, :]  # [cap, N_view]
+    slab = state.slab.at[:, tgt_slots].set(loaded.T, mode="drop")
+    age = state.age.at[:, tgt_slots].set(
+        jnp.asarray(AGE_STALE, jnp.int8), mode="drop"
+    )
+    susp = state.susp.at[:, tgt_slots].set(jnp.asarray(0, jnp.int16), mode="drop")
+    active = slot_subj >= 0
+
+    # ------------------------------ 4. apply FD verdicts + SYNC learnings
+    # Both are per-viewer single-subject updates routed through the slab.
+    def apply_point(slab, age, viewer, subject, key, fire):
+        s = subj_slot[subject]
+        ok = fire & (s >= 0)
+        s_safe = jnp.where(ok, s, 0)
+        old = slab[viewer, s_safe]
+        newv = jnp.where(ok, key, old)
+        slab = slab.at[viewer, s_safe].set(newv)
+        age = age.at[viewer, s_safe].set(
+            jnp.where(ok & (newv != old), 0, age[viewer, s_safe])
+        )
+        return slab, age
+
+    slab0 = slab
+    slab, age = apply_point(slab, age, col, fd_tgt, fd_key, fd_fire)
+    slab, age = apply_point(slab, age, col, sy_subj, sy_key, sy_accept)
+
+    # ------------------------------------------------- 5. gossip delivery
+    inv_perm, ginv, rots = fanout_permutations_structured(k_gsel, n, p.gossip_fanout)
+    lks = jax.random.split(k_glink, p.gossip_fanout)
+    edge_ok = jnp.stack(
+        [
+            alive[inv_perm[c]] & link_pass(lks[c], plan, inv_perm[c], col)
+            for c in range(p.gossip_fanout)
+        ]
+    )
+    young = age < p.periods_to_spread
+    rows = jnp.where(young & active[None, :], slab, UNKNOWN_KEY)
+    best_any = jnp.full((n, S), UNKNOWN_KEY, jnp.int32)
+    best_alive = best_any
+    for c in range(p.gossip_fanout):
+        contrib = jnp.where(edge_ok[c][:, None], rows[inv_perm[c]], UNKNOWN_KEY)
+        best_any = jnp.maximum(best_any, contrib)
+        best_alive = jnp.maximum(
+            best_alive, jnp.where(is_alive_key(contrib), contrib, UNKNOWN_KEY)
+        )
+    # Self-rumor channel (receiver == slot's subject), then exclusion.
+    own_col = col[:, None] == slot_subj[None, :]  # [N_view, S]
+    self_rumor = jnp.max(jnp.where(own_col, best_any, UNKNOWN_KEY), axis=1)
+    best_any = jnp.where(own_col, UNKNOWN_KEY, best_any)
+    best_alive = jnp.where(own_col, UNKNOWN_KEY, best_alive)
+    merged, _ = merge_views(slab, best_any, best_alive)
+    merged = jnp.where(active[None, :], merged, slab)
+    merged = jnp.where(alive[:, None], merged, slab)
+
+    # ------------------------- 6. suspicion sweep (cancel-on-update form)
+    armed = susp > 0
+    rearm = merged != slab0
+    left0 = jnp.maximum(susp.astype(jnp.int32) - 1, 0)
+    expired = (
+        alive[:, None]
+        & armed
+        & ~rearm
+        & (left0 == 0)
+        & ((merged & DEAD_BIT) == 0)
+        & ((merged & 1) != 0)
+        & (merged >= 0)
+    )
+    dead_keys = (merged | DEAD_BIT) & ~jnp.int32(1)
+    slab2 = jnp.where(expired, dead_keys, merged)
+    changed = (slab2 != slab0) & alive[:, None] & active[None, :]
+    age = jnp.where(
+        changed,
+        jnp.asarray(0, jnp.int8),
+        jnp.minimum(age, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
+    )
+    is_susp = ((slab2 & 1) != 0) & ((slab2 & DEAD_BIT) == 0) & (slab2 >= 0)
+    susp = jnp.where(
+        is_susp & active[None, :],
+        jnp.where(rearm | ~armed, p.suspicion_ticks, left0),
+        0,
+    ).astype(jnp.int16)
+    susp = jnp.where(alive[:, None], susp, state.susp)
+
+    # --------------------------------------------------- 7. self-refutation
+    r_status = decode_status(self_rumor)
+    own_slot = subj_slot[col]
+    has_own = own_slot >= 0
+    own_safe = jnp.where(has_own, own_slot, 0)
+    own_key = jnp.where(has_own, slab2[col, own_safe], encode_key(0, state.inc_self, state.epoch))
+    left_flag = (own_key & DEAD_BIT) != 0
+    threat = (
+        alive
+        & ~left_flag
+        & (self_rumor >= 0)
+        & (decode_epoch(self_rumor) == state.epoch)
+        & ((r_status == _SUSPECT) | (r_status == _DEAD))
+        & (decode_incarnation(self_rumor) >= state.inc_self)
+        & has_own  # subject is active by construction when rumored about
+    )
+    inc_self = jnp.where(threat, decode_incarnation(self_rumor) + 1, state.inc_self)
+    own_new = encode_key(jnp.full((n,), _ALIVE, jnp.int32), inc_self, state.epoch)
+    slab2 = slab2.at[col, own_safe].set(
+        jnp.where(threat, own_new, slab2[col, own_safe])
+    )
+    age = age.at[col, own_safe].set(
+        jnp.where(threat, 0, age[col, own_safe])
+    )
+
+    new_state = state.replace(
+        view_T=view_T,
+        slot_subj=slot_subj,
+        subj_slot=subj_slot,
+        slab=slab2,
+        age=age,
+        susp=susp,
+        inc_self=inc_self,
+        tick=t,
+        rng=rng_next,
+    )
+    if not collect:
+        return new_state, {"tick": t}
+    metrics = {
+        "tick": t,
+        "n_active_slots": jnp.sum(slot_subj >= 0),
+        "slot_overflow": slot_overflow,
+        "n_suspected": jnp.sum(is_susp & alive[:, None] & active[None, :]),
+        "msgs_fd": msgs_fd,
+        "msgs_sync": msgs_sync,
+        "msgs_gossip": sum(
+            jnp.sum(
+                jnp.any(rows[inv_perm[c]] >= 0, axis=1)
+                & alive[inv_perm[c]]
+                & (inv_perm[c] != col)
+            )
+            for c in range(p.gossip_fanout)
+        ),
+    }
+    return new_state, metrics
+
+
+@partial(
+    jax.jit, static_argnums=(0, 3), static_argnames=("collect",), donate_argnums=(1,)
+)
+def run_sparse_ticks(
+    params: SparseParams,
+    state: SparseState,
+    plan: FaultPlan,
+    n_ticks: int,
+    collect: bool = True,
+):
+    """``lax.scan`` driver, the sparse twin of sim/run.py::run_ticks.
+
+    The input state is DONATED (its buffers are reused for the output) — at
+    100k members the view_T alone is ~40 GB, so holding input + output
+    copies would double the footprint. Rebind the result over the input
+    (``st, tr = run_sparse_ticks(p, st, ...)``) and never touch the old
+    reference.
+    """
+
+    def step(carry, _):
+        return sparse_tick(params, carry, plan, collect=collect)
+
+    return lax.scan(step, state, None, length=n_ticks)
+
+
+def effective_view(state: SparseState) -> jax.Array:
+    """Materialize the logical [N_viewer, N_subject] view (slab overlaying
+    view_T) — test/introspection helper, O(N²); small n only."""
+    n = state.view_T.shape[0]
+    base = state.view_T.T  # [viewer, subject]
+    s = state.subj_slot  # [N_subj]
+    from_slab = jnp.where(
+        (s >= 0)[None, :], state.slab[:, jnp.clip(s, 0, None)], base
+    )
+    return from_slab

@@ -244,7 +244,6 @@ def sim_gossip_run(
 ) -> tuple[np.ndarray, float]:
     """Sim twin of :func:`host_gossip_mesh_run` with suppression tracking:
     ``(mean coverage[periods], mean total rumor-bearing sends)``."""
-    import dataclasses
 
     import jax.numpy as jnp
 

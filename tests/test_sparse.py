@@ -1,0 +1,176 @@
+"""Sparse-engine scenario fidelity (sim/sparse.py).
+
+The same reference scenarios the dense engine passes (tests/test_sim.py),
+run on the bounded-working-set engine: the oracle for the compact-rumor
+design's protocol equivalence (VERDICT round-1 item 3). Slot bookkeeping
+invariants are asserted alongside.
+"""
+
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu.ops.merge import decode_epoch, decode_status
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    effective_view,
+    init_sparse_full_view,
+    kill_sparse,
+    leave_sparse,
+    restart_sparse,
+    run_sparse_ticks,
+)
+from tests.test_sim import small_params
+
+ALIVE, SUSPECT, DEAD, UNKNOWN = 0, 1, 2, 3
+
+
+def sparse_params(n, slot_budget=64, **kw):
+    return SparseParams(
+        base=small_params(n, **kw), slot_budget=slot_budget, alloc_cap=16
+    )
+
+
+def statuses(state):
+    return decode_status(effective_view(state))
+
+
+def slot_invariants(state):
+    """slot_subj and subj_slot stay mutually consistent."""
+    slot_subj = state.slot_subj
+    subj_slot = state.subj_slot
+    for s, j in enumerate(slot_subj.tolist()):
+        if j >= 0:
+            assert int(subj_slot[j]) == s
+    for j, s in enumerate(subj_slot.tolist()):
+        if s >= 0:
+            assert int(slot_subj[s]) == j
+
+
+def test_steady_state_stays_converged_and_slots_drain():
+    n = 32
+    p = sparse_params(n)
+    st = init_sparse_full_view(n, p.slot_budget)
+    st, tr = run_sparse_ticks(p, st, FaultPlan.clean(n), 60)
+    assert bool(jnp.all(statuses(st) == ALIVE))
+    assert int(tr["slot_overflow"][-1]) == 0
+    slot_invariants(st)
+
+
+def test_kill_suspect_then_dead():
+    n = 24
+    p = sparse_params(n)
+    st = init_sparse_full_view(n, p.slot_budget)
+    st = kill_sparse(st, 5)
+    plan = FaultPlan.clean(n)
+
+    st, _ = run_sparse_ticks(
+        p, st, plan, p.base.fd_period_ticks * 6 + p.base.periods_to_spread
+    )
+    # run_sparse_ticks donates its input state: re-read arrays from the
+    # returned state each time, never keep references across runs.
+    col5 = statuses(st)[:, 5]
+    assert bool(jnp.all(jnp.where(st.alive, col5 == SUSPECT, True)))
+
+    st, _ = run_sparse_ticks(p, st, plan, p.base.suspicion_ticks + 12)
+    col5 = statuses(st)[:, 5]
+    assert bool(
+        jnp.all(jnp.where(st.alive, (col5 == DEAD) | (col5 == UNKNOWN), True))
+    )
+    slot_invariants(st)
+
+
+def test_lossy_network_no_false_deaths():
+    n = 32
+    p = sparse_params(n, suspicion_ticks=40, ping_req_members=3)
+    st = init_sparse_full_view(n, p.slot_budget)
+    plan = FaultPlan.clean(n).with_loss(20.0)
+    st, tr = run_sparse_ticks(p, st, plan, 250)
+    s = statuses(st)
+    false_dead = jnp.sum((s == DEAD) & st.alive[None, :])
+    assert int(false_dead) == 0
+    # Refutation fired under this much loss, and the working set stayed
+    # bounded with room to spare.
+    assert int(st.inc_self.max()) > 0
+    assert int(tr["n_active_slots"].max()) < p.slot_budget
+    assert int(tr["slot_overflow"].sum()) == 0
+
+
+def test_graceful_leave():
+    n = 24
+    p = sparse_params(n)
+    st = init_sparse_full_view(n, p.slot_budget)
+    st = leave_sparse(st, 2)
+    st, _ = run_sparse_ticks(p, st, FaultPlan.clean(n), 3)
+    st = kill_sparse(st, 2)
+    st, _ = run_sparse_ticks(p, st, FaultPlan.clean(n), p.base.periods_to_spread)
+    s = statuses(st)[:, 2]
+    assert bool(jnp.all(jnp.where(st.alive, (s == DEAD) | (s == UNKNOWN), True)))
+
+
+def test_restart_new_epoch_reintroduced():
+    n = 24
+    p = sparse_params(n)
+    plan = FaultPlan.clean(n)
+    st = init_sparse_full_view(n, p.slot_budget)
+    st = kill_sparse(st, 3)
+    st, _ = run_sparse_ticks(p, st, plan, p.base.suspicion_ticks + 40)
+
+    st = restart_sparse(st, 3)
+    st, _ = run_sparse_ticks(p, st, plan, 120)
+    eff = effective_view(st)
+    assert bool(jnp.all(decode_epoch(eff)[:, 3] == 1))
+    assert bool(jnp.all(decode_status(eff)[:, 3] == ALIVE))
+    slot_invariants(st)
+
+
+def test_sync_heals_partition_views():
+    """After a long split (simulated by directly diverging views), the
+    own-record SYNC re-introduces members through the alive channel."""
+    n = 16
+    p = sparse_params(n, sync_period_ticks=4)
+    st = init_sparse_full_view(n, p.slot_budget)
+    # Make viewers 0..7 see members 8..15 as UNKNOWN (post-tombstone state
+    # after a healed partition).
+    vT = st.view_T
+    vT = vT.at[8:, :8].set(-1)
+    st = st.replace(view_T=vT)
+    st, _ = run_sparse_ticks(p, st, FaultPlan.clean(n), 200)
+    assert bool(jnp.all(decode_status(effective_view(st)) == ALIVE))
+
+
+def test_dead_viewer_suspicion_does_not_pin_slot():
+    """A viewer killed while holding an armed suspicion must not pin the
+    subject's slot forever (round-2 review finding: slot-budget leak)."""
+    n = 24
+    p = sparse_params(n)
+    st = init_sparse_full_view(n, p.slot_budget)
+    st = kill_sparse(st, 5)
+    # Let FD fire and suspicions arm.
+    st, _ = run_sparse_ticks(p, st, FaultPlan.clean(n), p.base.fd_period_ticks * 3)
+    # Kill every remaining viewer's timer holder scenario: kill another node
+    # that holds an armed suspicion about 5.
+    st = kill_sparse(st, 6)
+    st, _ = run_sparse_ticks(
+        p, st, FaultPlan.clean(n),
+        p.base.suspicion_ticks + p.base.periods_to_sweep + 30,
+    )
+    # All rumor/suspicion activity about node 5 has drained from live
+    # viewers: the working set empties despite node 6's frozen timer.
+    assert int(jnp.sum(st.slot_subj >= 0)) == 0
+
+
+def test_tombstone_demotes_to_unknown_like_dense():
+    """After the sweep deadline a DEAD record writes back as UNKNOWN — the
+    dense engine's tomb_expired heal path (round-2 review finding)."""
+    n = 24
+    p = sparse_params(n)
+    st = init_sparse_full_view(n, p.slot_budget)
+    st = kill_sparse(st, 5)
+    st, _ = run_sparse_ticks(
+        p, st, FaultPlan.clean(n),
+        p.base.suspicion_ticks + p.base.periods_to_sweep + 60,
+    )
+    col5 = statuses(st)[:, 5]
+    live = st.alive
+    assert bool(jnp.all(jnp.where(live, col5 == UNKNOWN, True))), col5
+    assert int(jnp.sum(st.slot_subj >= 0)) == 0
