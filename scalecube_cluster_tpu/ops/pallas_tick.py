@@ -202,3 +202,299 @@ def delivery_merge_pallas(
         interpret=interpret,
     )(ginv, rots, edge_ok.astype(jnp.int32), alive.astype(jnp.int32), rows, local_view)
     return merged, self_pad[:, 0]
+
+
+# --------------------------------------------------------------------------
+# Stage B/C: the whole [N, N] tick in ONE kernel pass.
+# --------------------------------------------------------------------------
+
+#: Row-block of the fused tick kernel: 4 sender groups of GROUP=8 rows = 32,
+#: the int8 sublane tile — so the blocked rumor_age (int8) and suspect_left
+#: (int16, tile 16) inputs/outputs stay tile-aligned.
+TICK_BLOCK = 32
+#: Lane-block ceiling; the actual block is the largest divisor of n that is a
+#: multiple of 128 and <= this (VMEM budget ~6 MB at 5120).
+TICK_LANES_MAX = 5120
+
+
+def _tick_lanes(m: int) -> int:
+    mc = 0
+    for cand in range(128, min(m, TICK_LANES_MAX) + 1, 128):
+        if m % cand == 0:
+            mc = cand
+    return mc
+
+
+def _tick_kernel_factory(f, nb, mb, mc, spread, sweep, susp_ticks, age_stale):
+    b = GROUP
+    gpb = TICK_BLOCK // b  # 4 sender groups per row-block
+
+    def kernel(
+        ginv_ref,
+        rot_ref,
+        ok_ref,
+        alive_ref,
+        fdt_ref,
+        fdk_ref,
+        rows_ref,
+        view0_ref,
+        age_ref,
+        susp_ref,
+        view2_ref,
+        age2_ref,
+        susp2_ref,
+        rowsn_ref,
+        self_ref,
+        kcnt_ref,
+        scratch,
+        sems,
+    ):
+        i = pl.program_id(0)
+        j = pl.program_id(1)
+
+        def dma(bi, bj, slot, c, g):
+            return pltpu.make_async_copy(
+                rows_ref.at[
+                    pl.ds(ginv_ref[c, bi * gpb + g] * b, b), pl.ds(bj * mc, mc)
+                ],
+                scratch.at[slot, c, g],
+                sems.at[slot, c, g],
+            )
+
+        step = i * mb + j
+        nxt_j = jnp.where(j + 1 < mb, j + 1, 0)
+        nxt_i = jnp.where(j + 1 < mb, i, i + 1)
+
+        @pl.when(step == 0)
+        def _():
+            for c in range(f):
+                for g in range(gpb):
+                    dma(i, j, 0, c, g).start()
+
+        @pl.when(step + 1 < nb * mb)
+        def _():
+            for c in range(f):
+                for g in range(gpb):
+                    dma(nxt_i, nxt_j, (step + 1) % 2, c, g).start()
+
+        slot = step % 2
+        col_ids = jax.lax.broadcasted_iota(jnp.int32, (b, mc), 1) + j * mc
+
+        @pl.when(j == 0)
+        def _():
+            self_ref[...] = jnp.full_like(self_ref, -1)
+            kcnt_ref[...] = jnp.zeros_like(kcnt_ref)
+
+        for g in range(gpb):
+            base = (i * gpb + g) * b  # receiver rows of this group
+            best_any = jnp.full((b, mc), -1, jnp.int32)
+            best_alive = best_any
+            for c in range(f):
+                dma(i, j, slot, c, g).wait()
+                w = scratch[slot, c, g]
+                # FD fix-up on SENDER rows: a fired probe verdict is a fresh
+                # (young) rumor this very tick, so it joins the payload row
+                # before delivery (sim/tick.py: age0=0 at the fd cell).
+                sbase = ginv_ref[c, i * gpb + g] * b
+                s_tgt = jnp.stack(
+                    [fdt_ref[sbase + r] for r in range(b)]
+                ).reshape(b, 1)
+                s_key = jnp.stack(
+                    [fdk_ref[sbase + r] for r in range(b)]
+                ).reshape(b, 1)
+                w = jnp.where(col_ids == s_tgt, s_key, w)
+                rot = rot_ref[c, i * gpb + g]
+                chunk = pltpu.roll(w, shift=b - rot, axis=0)
+                ok_col = jnp.stack(
+                    [ok_ref[c, base + r] for r in range(b)]
+                ).astype(jnp.int32).reshape(b, 1)
+                contrib = jnp.where(ok_col != 0, chunk, -1)
+                best_any = jnp.maximum(best_any, contrib)
+                best_alive = jnp.maximum(
+                    best_alive, jnp.where(is_alive_key(contrib), contrib, -1)
+                )
+
+            rsl = slice(g * b, (g + 1) * b)
+            row_g = jax.lax.broadcasted_iota(jnp.int32, (b, mc), 0) + base
+            on_diag = col_ids == row_g
+            self_vals = jnp.max(jnp.where(on_diag, best_any, -1), axis=1)
+            self_ref[rsl, :] = jnp.maximum(
+                self_ref[rsl, :],
+                jnp.broadcast_to(self_vals.reshape(b, 1), (b, 128)),
+            )
+            best_any = jnp.where(on_diag, -1, best_any)
+            best_alive = jnp.where(on_diag, -1, best_alive)
+
+            # ---- receiver-local chain (sim/tick.py steps 1b, 2, 4 fused)
+            local = view0_ref[rsl, :]
+            r_tgt = jnp.stack(
+                [fdt_ref[base + r] for r in range(b)]
+            ).reshape(b, 1)
+            r_key = jnp.stack(
+                [fdk_ref[base + r] for r in range(b)]
+            ).reshape(b, 1)
+            cellm = col_ids == r_tgt
+            view1 = jnp.where(cellm, r_key, local)
+            age0 = jnp.where(cellm, 0, age_ref[rsl, :].astype(jnp.int32))
+
+            merged = _merge_rows(view1, best_any, best_alive)
+            alive_col = jnp.stack(
+                [alive_ref[base + r] for r in range(b)]
+            ).astype(jnp.int32).reshape(b, 1) != 0
+            merged = jnp.where(alive_col, merged, view1)
+
+            # Suspicion sweep + aging + tombstones. ``rearm``/``changed``
+            # compare against view0; the fd cell always changed (an accepted
+            # verdict strictly raises the key), so `| cellm` restores the
+            # view0 comparison without holding view0 and view1 both.
+            s_loc = susp_ref[rsl, :].astype(jnp.int32)
+            armed = s_loc > 0
+            rearm = (merged != view1) | cellm
+            left0 = jnp.maximum(s_loc - 1, 0)
+            expired = (
+                alive_col
+                & armed
+                & ~rearm
+                & (left0 == 0)
+                & ((merged & DEAD_BIT) == 0)
+                & ((merged & 1) != 0)
+                & (merged >= 0)
+            )
+            view2 = jnp.where(expired, (merged | DEAD_BIT) & ~jnp.int32(1), merged)
+            changed = ((view2 != view1) | cellm) & alive_col
+            age2 = jnp.where(changed, 0, jnp.minimum(age0, age_stale - 1) + 1)
+            tomb = (
+                ~on_diag
+                & ((view2 & DEAD_BIT) != 0)
+                & (view2 >= 0)
+                & (age2 > sweep)
+                & alive_col
+            )
+            view2 = jnp.where(tomb, -1, view2)
+            is_susp = ((view2 & 1) != 0) & ((view2 & DEAD_BIT) == 0) & (view2 >= 0)
+            susp2 = jnp.where(
+                is_susp, jnp.where(rearm | ~armed, susp_ticks, left0), 0
+            )
+            susp2 = jnp.where(alive_col, susp2, s_loc)
+
+            view2_ref[rsl, :] = view2
+            age2_ref[rsl, :] = age2.astype(jnp.int8)
+            susp2_ref[rsl, :] = susp2.astype(jnp.int16)
+            rowsn_ref[rsl, :] = jnp.where(age2 < spread, view2, -1)
+            cnt = jnp.sum(
+                ((view2 >= 0) & ((view2 & DEAD_BIT) == 0) & ~on_diag).astype(
+                    jnp.int32
+                ),
+                axis=1,
+            )
+            kcnt_ref[rsl, :] = kcnt_ref[rsl, :] + jnp.broadcast_to(
+                cnt.reshape(b, 1), (b, 128)
+            )
+
+    return kernel
+
+
+def tick_core_pallas(
+    rows,
+    view0,
+    age,
+    susp,
+    ginv,
+    rots,
+    edge_ok,
+    alive,
+    fd_tgt,
+    fd_key,
+    *,
+    spread,
+    sweep,
+    susp_ticks,
+    age_stale,
+    interpret=None,
+):
+    """The entire dense [N, N] tick core as one fused Pallas pass.
+
+    Fuses sim/tick.py's FD-verdict application, young-rumor payload masking,
+    gossip delivery (structured fan-out windows), membership merge, suspicion
+    sweep, rumor aging, tombstone demotion, next-tick payload (``rows``)
+    maintenance and the FD-candidate count — HBM traffic is one read of
+    ``{rows×f windows, view0, age, susp}`` and one write of
+    ``{view2, age2, susp2, rows_next}`` (~30 B/cell vs ~52 unfused).
+
+    Args:
+      rows: ``[N, M]`` int32 young-masked payload (state invariant:
+        ``where(age < spread, view0, -1)``).
+      view0/age/susp: current ``view``/``rumor_age``/``suspect_left``.
+      ginv, rots: structured fan-out (ops/delivery.py), ``[f, N/8]``.
+      edge_ok: ``[f, N]`` bool. alive: ``[N]`` bool.
+      fd_tgt: ``[N]`` int32 — fired probe target per row, ``-1`` when none
+        (pre-combined ``where(fire, tgt, -1)``).
+      fd_key: ``[N]`` int32 — the fired verdict key.
+      spread/sweep/susp_ticks: SimParams constants (static).
+      age_stale: sim/state.py::AGE_STALE (the int8 age saturation value) —
+        passed through so this module never duplicates it.
+
+    Returns:
+      ``(view2, age2, susp2, rows_next, self_rumor [N], known_cnt [N])`` —
+      all PRE-self-refutation; the caller applies the diagonal scatters
+      (sim/tick.py step 5).
+    """
+    n, m = rows.shape
+    f = ginv.shape[0]
+    if n % TICK_BLOCK != 0:
+        raise ValueError(f"n={n} not a multiple of {TICK_BLOCK}")
+    mc = _tick_lanes(m)
+    if mc == 0:
+        raise ValueError(f"m={m} has no 128-multiple divisor")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nb = n // TICK_BLOCK
+    mb = m // mc
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=6,
+        grid=(nb, mb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # rows stay in HBM (windows)
+            pl.BlockSpec((TICK_BLOCK, mc), lambda i, j, *_: (i, j)),
+            pl.BlockSpec((TICK_BLOCK, mc), lambda i, j, *_: (i, j)),
+            pl.BlockSpec((TICK_BLOCK, mc), lambda i, j, *_: (i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((TICK_BLOCK, mc), lambda i, j, *_: (i, j)),
+            pl.BlockSpec((TICK_BLOCK, mc), lambda i, j, *_: (i, j)),
+            pl.BlockSpec((TICK_BLOCK, mc), lambda i, j, *_: (i, j)),
+            pl.BlockSpec((TICK_BLOCK, mc), lambda i, j, *_: (i, j)),
+            pl.BlockSpec((TICK_BLOCK, 128), lambda i, j, *_: (i, 0)),
+            pl.BlockSpec((TICK_BLOCK, 128), lambda i, j, *_: (i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, f, TICK_BLOCK // GROUP, GROUP, mc), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, f, TICK_BLOCK // GROUP)),
+        ],
+    )
+    view2, age2, susp2, rows_next, self_pad, kcnt_pad = pl.pallas_call(
+        _tick_kernel_factory(f, nb, mb, mc, spread, sweep, susp_ticks, age_stale),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, m), jnp.int32),
+            jax.ShapeDtypeStruct((n, m), jnp.int8),
+            jax.ShapeDtypeStruct((n, m), jnp.int16),
+            jax.ShapeDtypeStruct((n, m), jnp.int32),
+            jax.ShapeDtypeStruct((n, 128), jnp.int32),
+            jax.ShapeDtypeStruct((n, 128), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        ginv,
+        rots,
+        edge_ok.astype(jnp.int32),
+        alive.astype(jnp.int32),
+        fd_tgt,
+        fd_key,
+        rows,
+        view0,
+        age,
+        susp,
+    )
+    return view2, age2, susp2, rows_next, self_pad[:, 0], kcnt_pad[:, 0]

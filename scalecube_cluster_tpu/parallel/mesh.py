@@ -4,8 +4,8 @@ Layout: every per-member array shards its **viewer axis** (axis 0) across the
 ``"members"`` mesh axis; subject axes stay replicated-size but local, so each
 device owns the full rows of its N/D viewers:
 
-- ``view / rumor_age / suspect_left / useen / uage``: ``P("members", None)``
-- ``inc_self / epoch / alive``: ``P("members")``
+- ``view / rumor_age / suspect_left / rows / useen / uage``: ``P("members", None)``
+- ``inc_self / epoch / alive / known_cnt``: ``P("members")``
 - ``tick / rng``: replicated
 
 Delivery (ops/delivery.py) scatters rows by destination — a cross-shard
@@ -71,6 +71,8 @@ def state_shardings(mesh: Mesh) -> SimState:
         view=row,
         rumor_age=row,
         suspect_left=row,
+        rows=row,
+        known_cnt=vec,
         inc_self=vec,
         epoch=vec,
         alive=vec,

@@ -97,13 +97,28 @@ class SparseParams:
     slot_budget: int = 2048
     #: Max subject activations per tick.
     alloc_cap: int = 64
+    #: Slot free/write-back cadence in ticks. The write-back scatter touches
+    #: the whole [N, N] ``view_T`` (XLA materializes a fresh copy of the
+    #: operand — at 24k members that single op costs more than the rest of
+    #: the tick combined), so it runs cond-gated every this-many ticks;
+    #: between write-backs, done slots simply stay pinned a little longer.
+    #: Protocol values are unchanged — only slot availability timing shifts.
+    writeback_period: int = 1
 
     @classmethod
-    def for_n(cls, n: int, slot_budget: int = 2048, alloc_cap: int = 64, **kw):
+    def for_n(
+        cls,
+        n: int,
+        slot_budget: int = 2048,
+        alloc_cap: int = 64,
+        writeback_period: int = 1,
+        **kw,
+    ):
         return cls(
             base=SimParams.from_cluster_config(n, **kw),
             slot_budget=slot_budget,
             alloc_cap=alloc_cap,
+            writeback_period=writeback_period,
         )
 
 
@@ -318,16 +333,28 @@ def sparse_tick(
             & (prt != col)
             & link_pass(k_slink, plan, col, prt)
         )
-        # I learn the partner's own-record (their table row about themselves).
-        learned_key = encode_key(
-            jnp.full((n,), _ALIVE, jnp.int32), state.inc_self[prt], state.epoch[prt]
-        )
+        # I learn the partner's ACTUAL own-record — which may be a leave
+        # tombstone (DEAD at the bumped incarnation, sim/sparse.py::
+        # leave_sparse); synthesizing ALIVE here would resurrect graceful
+        # leavers cluster-wide.
+        learned_key = my_record_of(prt, prt)
         mine = my_record_of(col, prt)
-        # Accept test mirrors merge: same-epoch override or alive-introduction.
-        same = (mine >= 0) & (decode_epoch(mine) == decode_epoch(learned_key))
+        # Accept test mirrors the merge lattice (ops/merge.py::merge_views):
+        # same-epoch records fight by key; unknown/newer-epoch identities may
+        # only be introduced by an ALIVE record.
+        known_l = learned_key >= 0
+        same = (
+            (mine >= 0)
+            & known_l
+            & (decode_epoch(mine) == decode_epoch(learned_key))
+        )
+        intro = (
+            known_l
+            & is_alive_key(learned_key)
+            & ((mine < 0) | (decode_epoch(learned_key) > decode_epoch(mine)))
+        )
         accept = ok & (
-            (same & overrides_same_epoch(learned_key, mine))
-            | (~same & ((mine < 0) | (decode_epoch(learned_key) > decode_epoch(mine))))
+            (same & overrides_same_epoch(learned_key, mine)) | (~same & intro)
         )
         return prt, learned_key, accept, jnp.sum(ok) * 2
 
@@ -360,17 +387,28 @@ def sparse_tick(
         | (dead_rec & ~stale_done & ~own_row)
     )
     pinned = jnp.any(holding & alive[:, None], axis=0)
-    freeing = active & ~pinned
+    # Frees happen only on write-back ticks (SparseParams.writeback_period):
+    # the full-table scatter below is the one op that touches all of view_T,
+    # so it must not run every tick.
+    do_wb = (t % params.writeback_period) == 0
+    freeing = active & ~pinned & do_wb
     # Tombstone demotion on write-back: a DEAD record whose rumor fully aged
     # out becomes UNKNOWN (the dense engine's tomb_expired, sim/tick.py) —
     # except the subject's own row (a leaver keeps its own tombstone).
-    demote = dead_rec & stale_done & ~own_row
-    writeback = jnp.where(demote, UNKNOWN_KEY, state.slab)  # [N_view, S]
-    # Scatter freed slots' columns back into view_T rows (subject-major:
-    # one contiguous row per freed slot). Non-freeing slots route out of
-    # bounds and are dropped — freed subjects are unique, so no clobbering.
     wb_subj = jnp.where(freeing, state.slot_subj, n)
-    view_T = state.view_T.at[wb_subj, :].set(writeback.T, mode="drop")
+
+    def apply_writeback(view_T):
+        demote = dead_rec & stale_done & ~own_row
+        writeback = jnp.where(demote, UNKNOWN_KEY, state.slab)  # [N_view, S]
+        # Scatter freed slots' columns back into view_T rows (subject-major:
+        # one contiguous row per freed slot). Non-freeing slots route out of
+        # bounds and are dropped — freed subjects are unique, so no
+        # clobbering.
+        return view_T.at[wb_subj, :].set(writeback.T, mode="drop")
+
+    view_T = lax.cond(
+        jnp.any(freeing), apply_writeback, lambda vt: vt, state.view_T
+    )
     slot_subj = jnp.where(freeing, -1, state.slot_subj)
     subj_slot = state.subj_slot.at[wb_subj].set(-1, mode="drop")
 
@@ -397,13 +435,24 @@ def sparse_tick(
     grant_subj = jnp.where(grant_valid, new_subjects, n)
     slot_subj = slot_subj.at[tgt_slots].set(new_subjects, mode="drop")
     subj_slot = subj_slot.at[grant_subj].set(free_slots, mode="drop")
-    # Load the activated subjects' rows into their slab columns.
-    loaded = view_T[new_subjects, :]  # [cap, N_view]
-    slab = state.slab.at[:, tgt_slots].set(loaded.T, mode="drop")
-    age = state.age.at[:, tgt_slots].set(
-        jnp.asarray(AGE_STALE, jnp.int8), mode="drop"
+
+    # Load the activated subjects' rows into their slab columns — cond-gated:
+    # the column scatters rewrite the whole [N, S] slab/age/susp arrays, and
+    # most steady-state ticks grant nothing.
+    def apply_loads(args):
+        slab, age, susp = args
+        loaded = view_T[new_subjects, :]  # [cap, N_view]
+        slab = slab.at[:, tgt_slots].set(loaded.T, mode="drop")
+        age = age.at[:, tgt_slots].set(jnp.asarray(AGE_STALE, jnp.int8), mode="drop")
+        susp = susp.at[:, tgt_slots].set(jnp.asarray(0, jnp.int16), mode="drop")
+        return slab, age, susp
+
+    slab, age, susp = lax.cond(
+        n_granted > 0,
+        apply_loads,
+        lambda args: args,
+        (state.slab, state.age, state.susp),
     )
-    susp = state.susp.at[:, tgt_slots].set(jnp.asarray(0, jnp.int16), mode="drop")
     active = slot_subj >= 0
 
     # ------------------------------ 4. apply FD verdicts + SYNC learnings

@@ -18,6 +18,16 @@ play the roles of the reference's per-node objects:
                          random Member id a restarted process mints
                          (Member.java:25-27, ops/merge.py epoch rationale)
 - ``alive[j]``         — ground truth: process j is up (host fault control)
+- ``rows[i, j]``       — DERIVED: the young-masked gossip payload
+                         ``where(rumor_age < periods_to_spread, view, -1)``,
+                         maintained by the tick so the per-tick payload
+                         build (selectGossipsToSend,
+                         GossipProtocolImpl.java:242-251) costs no extra
+                         [N, N] pass. Init-time ages are 0 or AGE_STALE, so
+                         ``age == 0`` decides membership without params.
+- ``known_cnt[i]``     — DERIVED: count of known non-DEAD non-self records
+                         in i's table (the FD/SYNC candidate-list size);
+                         0 ⇒ i is joining and retries its join SYNC.
 - ``useen/uage[j, g]`` — user-gossip dissemination state per payload slot g
                          (GossipProtocolImpl gossips map, :163-169)
 - ``uinf[i, j, g]``    — i knows j already has user-gossip g, so i stops
@@ -55,6 +65,8 @@ class SimState:
     view: jax.Array  # [N, N] int32 priority keys
     rumor_age: jax.Array  # [N, N] int8, saturates at AGE_STALE
     suspect_left: jax.Array  # [N, N] int16 countdown, 0 = no timer
+    rows: jax.Array  # [N, N] int32 derived young payload (see module doc)
+    known_cnt: jax.Array  # [N] int32 derived candidate counts
     inc_self: jax.Array  # [N] int32
     epoch: jax.Array  # [N] int32
     alive: jax.Array  # [N] bool
@@ -73,6 +85,8 @@ def _blank(n: int, slots: int, seed: int, track_infected: bool) -> SimState:
         view=jnp.full((n, n), merge_ops.UNKNOWN_KEY, jnp.int32),
         rumor_age=jnp.full((n, n), AGE_STALE, jnp.int8),
         suspect_left=jnp.zeros((n, n), jnp.int16),
+        rows=jnp.full((n, n), merge_ops.UNKNOWN_KEY, jnp.int32),
+        known_cnt=jnp.zeros((n,), jnp.int32),
         inc_self=jnp.zeros((n,), jnp.int32),
         epoch=jnp.zeros((n,), jnp.int32),
         alive=jnp.ones((n,), bool),
@@ -101,7 +115,11 @@ def init_full_view(
     alive_keys = merge_ops.encode_key(
         jnp.zeros((n, n), jnp.int32), jnp.zeros((n, n), jnp.int32)
     )
-    return state.replace(view=alive_keys)
+    # Ages start at AGE_STALE: nothing is young (rows stays all-UNKNOWN);
+    # every record is a known non-DEAD candidate except self.
+    return state.replace(
+        view=alive_keys, known_cnt=jnp.full((n,), n - 1, jnp.int32)
+    )
 
 
 def init_seeded(
@@ -123,8 +141,14 @@ def init_seeded(
     diag = jnp.eye(n, dtype=bool)
     self_key = merge_ops.encode_key(jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
     view = jnp.where(diag, self_key, merge_ops.UNKNOWN_KEY)
-    # Own record starts fresh so the join SYNC spreads it immediately.
-    return state.replace(view=view, rumor_age=jnp.where(diag, 0, state.rumor_age))
+    # Own record starts fresh so the join SYNC spreads it immediately; it is
+    # the only young record, hence the only rows entry. known_cnt stays 0
+    # (self is not a candidate) — exactly the joining condition.
+    return state.replace(
+        view=view,
+        rumor_age=jnp.where(diag, 0, state.rumor_age),
+        rows=jnp.where(diag, self_key, merge_ops.UNKNOWN_KEY),
+    )
 
 
 def seeds_mask(n: int, seeds: list[int]) -> jax.Array:
@@ -157,6 +181,7 @@ def leave(state: SimState, idx) -> SimState:
         inc_self=state.inc_self.at[idx].set(inc),
         view=state.view.at[idx, idx].set(dead_key),
         rumor_age=state.rumor_age.at[idx, idx].set(0),
+        rows=state.rows.at[idx, idx].set(dead_key),
     )
 
 
@@ -187,6 +212,12 @@ def restart(state: SimState, idx) -> SimState:
         view=state.view.at[idx, :].set(row),
         rumor_age=state.rumor_age.at[idx, :].set(AGE_STALE).at[idx, idx].set(0),
         suspect_left=state.suspect_left.at[idx, :].set(0),
+        # Fresh table: only the (young) own record is payload; no candidates.
+        rows=state.rows.at[idx, :]
+        .set(merge_ops.UNKNOWN_KEY)
+        .at[idx, idx]
+        .set(row[idx]),
+        known_cnt=state.known_cnt.at[idx].set(0),
         useen=state.useen.at[idx, :].set(False),
         # The restarted slot is a brand-new identity: it appears in nobody's
         # infected set — neither its own knowledge (row idx) nor peers'
@@ -230,6 +261,9 @@ def update_metadata(state: SimState, idx) -> SimState:
         view=state.view.at[idx, idx].set(key),
         rumor_age=state.rumor_age.at[idx, idx].set(
             jnp.where(left, state.rumor_age[idx, idx], 0)
+        ),
+        rows=state.rows.at[idx, idx].set(
+            jnp.where(left, state.rows[idx, idx], key)
         ),
     )
 

@@ -18,9 +18,6 @@ branchless step suitable for `jax.lax.scan` + `jit` + sharding:
      GossipProtocolImpl.java:253-274) carrying membership rumors younger than
      periodsToSpread (selectGossipsToSend, :242-251), folded receiver-side by
      gather + lattice max (ops/merge.py = updateMembership/isOverrides).
-     On TPU this step runs as one fused Pallas kernel
-     (ops/pallas_tick.py::delivery_merge_pallas) when
-     ``SimParams.pallas_delivery`` is set.
   3. SYNC anti-entropy (cond-gated to sync ticks / joining nodes): full-table
      exchange with one partner both ways (onSync/onSyncAck,
      MembershipProtocolImpl.java:343-373).
@@ -36,6 +33,26 @@ branchless step suitable for `jax.lax.scan` + `jit` + sharding:
      optional per-rumor infected-set suppression, and sweep/recycle
      (onGossipReq dedup + sweepGossips, GossipProtocolImpl.java:171-183,
      281-304).
+
+Execution structure (round-2 fusion): the tick core (steps 1b/2/4 plus the
+young-payload and candidate-count maintenance) runs as ONE of two
+`lax.cond` branches —
+
+  * **fast path** (common case: no SYNC due, nobody joining): the whole
+    [N, N] core is a single fused Pallas kernel
+    (ops/pallas_tick.py::tick_core_pallas) when ``params.pallas_delivery``
+    and n % 32 == 0, else the equivalent XLA chain. HBM traffic ~30 B/cell.
+  * **slow path** (SYNC tick or a joining node): the unfused XLA chain with
+    the full-table SYNC exchange folded between merge and suspicion sweep.
+
+Both branches maintain two derived state invariants so per-tick XLA
+pre-passes disappear:
+
+  * ``state.rows``       = ``where(rumor_age < periods_to_spread, view, -1)``
+    — next tick's gossip payload (selectGossipsToSend precomputed).
+  * ``state.known_cnt``  = per-viewer count of known non-DEAD non-self
+    records — the FD/SYNC candidate count (pingMembers list size), whence
+    ``joining`` (empty table ⇒ retry join SYNC) without an [N, N] reduce.
 
 Documented deviations from the reference (protocol-equivalent at period
 granularity; the convergence tests are the oracle):
@@ -212,19 +229,19 @@ def sim_tick(
     view0 = state.view
     alive = state.alive
     col = jnp.arange(n, dtype=jnp.int32)
-    diag = jnp.eye(n, dtype=bool)
     i_idx = col  # row index == sender/receiver identity for link sampling
 
     do_fd = (t % params.fd_period_ticks) == 0
     do_sync_tick = (t % params.sync_period_ticks) == 0
 
-    # Live-member candidate sets: known, not seen DEAD, not self — the member
-    # lists FD/sync draw from (FailureDetectorImpl.java:323-333).
-    status0 = decode_status(view0)
-    cand = (view0 >= 0) & (status0 != _DEAD) & ~diag
-
     # ------------------------------------------------------------------ 1. FD
+    # The candidate matrix (the member list FD draws from,
+    # FailureDetectorImpl.java:323-333) is built INSIDE the cond: the [N, N]
+    # pass only runs on ping ticks.
     def fd_fire_phase(_):
+        diag = jnp.eye(n, dtype=bool)
+        status0 = decode_status(view0)
+        cand = (view0 >= 0) & (status0 != _DEAD) & ~diag
         return _fd_vectors(
             params, state, plan, (k_tgt, k_ping, k_relay), cand, view0
         )
@@ -240,15 +257,11 @@ def sim_tick(
     fd_tgt, fd_key, fd_fire, msgs_fd = lax.cond(
         do_fd, fd_fire_phase, fd_skip_phase, None
     )
-    fd_mask = (col[None, :] == fd_tgt[:, None]) & fd_fire[:, None]
-    view1 = jnp.where(fd_mask, fd_key[:, None], view0)
+    # Mask-combined form consumed by both core paths: -1 = "no verdict".
+    fd_tgtm = jnp.where(fd_fire, fd_tgt, -1)
 
-    # ------------------------------------------------- 2. gossip delivery
-    # Block-structured fan-out when n allows it (aligned DMA windows for the
-    # Pallas kernel — ops/delivery.py::fanout_permutations_structured); the
-    # unstructured permutations remain for odd n. Both delivery
-    # implementations consume the same sampled edges, so trajectories are
-    # bit-identical across the pallas_delivery switch.
+    # Gossip fan-out edges for this tick (shared by both core paths and the
+    # user-gossip phase).
     structured = n % GROUP == 0
     if structured:
         inv_perm, ginv, rots = fanout_permutations_structured(
@@ -256,6 +269,7 @@ def sim_tick(
         )
     else:
         _, inv_perm = fanout_permutations(k_gsel, n, params.gossip_fanout)
+        ginv = rots = None
     lks = jax.random.split(k_glink, params.gossip_fanout)
     edge_ok = jnp.stack(
         [
@@ -264,15 +278,33 @@ def sim_tick(
         ]
     )
 
-    age0 = jnp.where(fd_mask, 0, state.rumor_age)
-    rows = jnp.where(age0 < params.periods_to_spread, view1, UNKNOWN_KEY)
-    if params.pallas_delivery and structured:
-        from scalecube_cluster_tpu.ops.pallas_tick import delivery_merge_pallas
+    # A node whose table knows nobody retries its join SYNC every tick (the
+    # initial-sync path, start0, MembershipProtocolImpl.java:222-257) —
+    # read off the maintained candidate count instead of an [N, N] reduce.
+    joining = (state.known_cnt == 0) & alive
+    need_slow = do_sync_tick | jnp.any(joining)
 
-        merged, self_rumor = delivery_merge_pallas(
-            rows, view1, ginv, rots, edge_ok, alive
-        )
-    else:
+    # The fused kernel needs 32-row blocks AND a 128-multiple lane split of
+    # m = n (ops/pallas_tick.py::_tick_lanes); anything else falls back to
+    # the bit-identical XLA chain.
+    use_fused = (
+        params.pallas_delivery and structured and n % 128 == 0 and n == view0.shape[1]
+    )
+
+    # ------------------------------------------- 2+4. tick core (two paths)
+    def _core_xla(with_sync):
+        """Unfused core; ``with_sync`` folds the SYNC exchange in.
+
+        Bit-identical to tick_core_pallas when with_sync=False (asserted by
+        tests/test_pallas_tick.py).
+        """
+        diag = jnp.eye(n, dtype=bool)
+        fd_mask = col[None, :] == fd_tgtm[:, None]
+        view1 = jnp.where(fd_mask, fd_key[:, None], view0)
+        # state.rows is last tick's young payload; a fired FD verdict is
+        # fresh (age 0), so it joins the payload unconditionally.
+        rows = jnp.where(fd_mask, fd_key[:, None], state.rows)
+
         best_any, best_alive = permuted_delivery_two_channel(
             rows, is_alive_key, inv_perm, edge_ok
         )
@@ -282,99 +314,138 @@ def sim_tick(
         merged, _ = merge_views(view1, best_any_nd, best_alive_nd)
         merged = jnp.where(alive[:, None], merged, view1)
 
-    # ------------------------------------------------- 3. SYNC anti-entropy
-    # Nodes that know nobody (fresh joiners/restarts) retry every tick — the
-    # initial-sync path (start0, MembershipProtocolImpl.java:222-257).
-    joining = (jnp.sum(cand, axis=1) == 0) & alive
+        if with_sync:
+            # ------------------------------------- 3. SYNC anti-entropy
+            status1 = decode_status(view1)
+            s_cand = (((view1 >= 0) & (status1 != _DEAD)) | seeds[None, :]) & ~diag
+            prt, p_valid = masked_random_choice(k_ssel, s_cand)
+            do_sync = (do_sync_tick | joining) & alive
+            sk1, sk2 = jax.random.split(k_slink)
+            s_fwd = (
+                do_sync & p_valid & alive[prt] & link_pass(sk1, plan, i_idx, prt)
+            )
+            s_rev = s_fwd & link_pass(sk2, plan, prt, i_idx)
 
-    def sync_fire_phase(args):
-        merged, self_rumor = args
-        status1 = decode_status(view1)
-        s_cand = (((view1 >= 0) & (status1 != _DEAD)) | seeds[None, :]) & ~diag
-        prt, p_valid = masked_random_choice(k_ssel, s_cand)
-        do_sync = (do_sync_tick | joining) & alive
-        sk1, sk2 = jax.random.split(k_slink)
-        s_fwd = do_sync & p_valid & alive[prt] & link_pass(sk1, plan, i_idx, prt)
-        s_rev = s_fwd & link_pass(sk2, plan, prt, i_idx)
+            best_any_s = deliver_rows_max(view1, prt[:, None], s_fwd[:, None], n)
+            full_alive_rows = jnp.where(is_alive_key(view1), view1, UNKNOWN_KEY)
+            best_alive_s = deliver_rows_max(
+                full_alive_rows, prt[:, None], s_fwd[:, None], n
+            )
+            reply = view1[prt, :]  # SYNC_ACK: partner's full table
+            best_any_s = jnp.maximum(
+                best_any_s, jnp.where(s_rev[:, None], reply, UNKNOWN_KEY)
+            )
+            best_alive_s = jnp.maximum(
+                best_alive_s,
+                jnp.where(s_rev[:, None] & is_alive_key(reply), reply, UNKNOWN_KEY),
+            )
+            # A SYNC table may carry a rumor about the receiver itself — it
+            # feeds self-refutation like gossip rumors do.
+            self_rumor = jnp.maximum(self_rumor, jnp.diagonal(best_any_s))
+            best_any_s = jnp.where(diag, UNKNOWN_KEY, best_any_s)
+            best_alive_s = jnp.where(diag, UNKNOWN_KEY, best_alive_s)
+            out, _ = merge_views(merged, best_any_s, best_alive_s)
+            merged = jnp.where(alive[:, None], out, merged)
+            msgs_sync = jnp.sum(s_fwd) + jnp.sum(s_rev)
+        else:
+            msgs_sync = jnp.asarray(0, jnp.int32)
 
-        best_any = deliver_rows_max(view1, prt[:, None], s_fwd[:, None], n)
-        full_alive_rows = jnp.where(is_alive_key(view1), view1, UNKNOWN_KEY)
-        best_alive = deliver_rows_max(
-            full_alive_rows, prt[:, None], s_fwd[:, None], n
+        # ------------------ 4. suspicion sweep + aging + tombstones (fused)
+        # Countdown form: the timer decrements once per tick after the tick
+        # that armed it, so it hits 0 exactly suspicion_ticks later. ANY
+        # accepted override this tick (rearm below) cancels the pending
+        # timeout and — if the new record is still SUSPECT — schedules a
+        # fresh one, mirroring the reference's cancel+reschedule on update
+        # (:534, 612-635).
+        age0 = jnp.where(fd_mask, jnp.asarray(0, jnp.int8), state.rumor_age)
+        armed = state.suspect_left > 0
+        rearm = merged != view0
+        left0 = jnp.maximum(state.suspect_left.astype(jnp.int32) - 1, 0)
+        expired = alive[:, None] & armed & ~rearm & (left0 == 0) & (
+            (merged & DEAD_BIT) == 0
+        ) & ((merged & 1) != 0) & (merged >= 0)
+        dead_keys = (merged | DEAD_BIT) & ~jnp.int32(1)  # DEAD, same inc/epoch
+        view2 = jnp.where(expired, dead_keys, merged)
+        changed = (view2 != view0) & alive[:, None]
+
+        rumor_age = jnp.where(
+            changed,
+            jnp.asarray(0, jnp.int8),
+            jnp.minimum(age0, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
         )
-        reply = view1[prt, :]  # SYNC_ACK: partner's full table to the caller
-        best_any = jnp.maximum(best_any, jnp.where(s_rev[:, None], reply, UNKNOWN_KEY))
-        best_alive = jnp.maximum(
-            best_alive,
-            jnp.where(s_rev[:, None] & is_alive_key(reply), reply, UNKNOWN_KEY),
+
+        # Tombstone expiry: the reference REMOVES an accepted DEAD record
+        # from the table right away (onDeadMemberDetected,
+        # MembershipProtocolImpl.java:571-587) while the rumor keeps
+        # circulating until swept. The dense view keeps the DEAD key as the
+        # circulating tombstone and demotes it to UNKNOWN once it stops
+        # spreading (age > periodsToSweep, ClusterMath.java:99-102) — after
+        # which a refuted/restarted member's ALIVE record can re-introduce it
+        # via the best_alive channel, exactly like the reference's r0 == null
+        # accept.
+        tomb_expired = (
+            ~diag
+            & ((view2 & DEAD_BIT) != 0)
+            & (view2 >= 0)
+            & (rumor_age > params.periods_to_sweep)
+            & alive[:, None]
         )
-        # A SYNC table may carry a rumor about the receiver itself — it feeds
-        # self-refutation like gossip rumors do.
-        self_rumor = jnp.maximum(self_rumor, jnp.diagonal(best_any))
-        best_any = jnp.where(diag, UNKNOWN_KEY, best_any)
-        best_alive = jnp.where(diag, UNKNOWN_KEY, best_alive)
-        # Fold SYNC tables into the already-gossip-merged view through the
-        # same lattice.
-        out, _ = merge_views(merged, best_any, best_alive)
-        out = jnp.where(alive[:, None], out, merged)
-        return out, self_rumor, jnp.sum(s_fwd) + jnp.sum(s_rev)
+        view2 = jnp.where(tomb_expired, UNKNOWN_KEY, view2)
 
-    def sync_skip_phase(args):
-        merged, self_rumor = args
-        return merged, self_rumor, jnp.asarray(0, jnp.int32)
+        is_susp = ((view2 & 1) != 0) & ((view2 & DEAD_BIT) == 0) & (view2 >= 0)
+        suspect_left = jnp.where(
+            is_susp,
+            jnp.where(rearm | ~armed, params.suspicion_ticks, left0),
+            0,
+        ).astype(jnp.int16)
+        suspect_left = jnp.where(alive[:, None], suspect_left, state.suspect_left)
 
-    merged, self_rumor, msgs_sync = lax.cond(
-        do_sync_tick | jnp.any(joining),
-        sync_fire_phase,
-        sync_skip_phase,
-        (merged, self_rumor),
+        rows_next = jnp.where(
+            rumor_age < params.periods_to_spread, view2, UNKNOWN_KEY
+        )
+        known_cnt = jnp.sum(
+            ((view2 >= 0) & ((view2 & DEAD_BIT) == 0) & ~diag).astype(jnp.int32),
+            axis=1,
+        )
+        return view2, rumor_age, suspect_left, rows_next, known_cnt, self_rumor, msgs_sync
+
+    def core_fast(_):
+        if use_fused:
+            from scalecube_cluster_tpu.ops.pallas_tick import tick_core_pallas
+
+            view2, age2, susp2, rows_next, self_rumor, known_cnt = tick_core_pallas(
+                state.rows,
+                view0,
+                state.rumor_age,
+                state.suspect_left,
+                ginv,
+                rots,
+                edge_ok,
+                alive,
+                fd_tgtm,
+                fd_key,
+                spread=params.periods_to_spread,
+                sweep=params.periods_to_sweep,
+                susp_ticks=params.suspicion_ticks,
+                age_stale=AGE_STALE,
+            )
+            return (
+                view2,
+                age2,
+                susp2,
+                rows_next,
+                known_cnt,
+                self_rumor,
+                jnp.asarray(0, jnp.int32),
+            )
+        return _core_xla(with_sync=False)
+
+    def core_slow(_):
+        return _core_xla(with_sync=True)
+
+    (view2, rumor_age, suspect_left, rows_next, known_cnt, self_rumor, msgs_sync) = (
+        lax.cond(need_slow, core_slow, core_fast, None)
     )
-
-    # ---------------------- 4. suspicion sweep + aging + tombstones (fused)
-    # Countdown form: the timer decrements once per tick after the tick that
-    # armed it, so it hits 0 exactly suspicion_ticks later. ANY accepted
-    # override this tick (rearm below) cancels the pending timeout and — if
-    # the new record is still SUSPECT — schedules a fresh one, mirroring the
-    # reference's cancel+reschedule on update (:534, 612-635).
-    armed = state.suspect_left > 0
-    rearm = merged != view0
-    left0 = jnp.maximum(state.suspect_left.astype(jnp.int32) - 1, 0)
-    expired = alive[:, None] & armed & ~rearm & (left0 == 0) & (
-        (merged & DEAD_BIT) == 0
-    ) & ((merged & 1) != 0) & (merged >= 0)
-    dead_keys = (merged | DEAD_BIT) & ~jnp.int32(1)  # DEAD at same inc/epoch
-    view2 = jnp.where(expired, dead_keys, merged)
-    changed = (view2 != view0) & alive[:, None]
-
-    rumor_age = jnp.where(
-        changed,
-        jnp.asarray(0, jnp.int8),
-        jnp.minimum(age0, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
-    )
-
-    # Tombstone expiry: the reference REMOVES an accepted DEAD record from the
-    # table right away (onDeadMemberDetected, MembershipProtocolImpl.java:571-587)
-    # while the rumor keeps circulating until swept. The dense view keeps the
-    # DEAD key as the circulating tombstone and demotes it to UNKNOWN once it
-    # stops spreading (age > periodsToSweep, ClusterMath.java:99-102) — after
-    # which a refuted/restarted member's ALIVE record can re-introduce it via
-    # the best_alive channel, exactly like the reference's r0 == null accept.
-    tomb_expired = (
-        ~diag
-        & ((view2 & DEAD_BIT) != 0)
-        & (view2 >= 0)
-        & (rumor_age > params.periods_to_sweep)
-        & alive[:, None]
-    )
-    view2 = jnp.where(tomb_expired, UNKNOWN_KEY, view2)
-
-    is_susp = ((view2 & 1) != 0) & ((view2 & DEAD_BIT) == 0) & (view2 >= 0)
-    suspect_left = jnp.where(
-        is_susp,
-        jnp.where(rearm | ~armed, params.suspicion_ticks, left0),
-        0,
-    ).astype(jnp.int16)
-    suspect_left = jnp.where(alive[:, None], suspect_left, state.suspect_left)
 
     # --------------------------------------------------- 5. self-refutation
     own_key = jnp.diagonal(view2)
@@ -390,10 +461,13 @@ def sim_tick(
     )
     inc_self = jnp.where(threat, decode_incarnation(self_rumor) + 1, state.inc_self)
     own_new = encode_key(jnp.full((n,), _ALIVE, jnp.int32), inc_self, state.epoch)
-    # Diagonal scatter (N elements) instead of an [N, N] where-pass.
+    # Diagonal scatters (N elements each) instead of [N, N] where-passes.
     view2 = view2.at[col, col].set(jnp.where(threat, own_new, own_key))
     rumor_age = rumor_age.at[col, col].set(
         jnp.where(threat, 0, jnp.diagonal(rumor_age))
+    )
+    rows_next = rows_next.at[col, col].set(
+        jnp.where(threat, own_new, jnp.diagonal(rows_next))
     )
 
     # ----------------------------------------------------- 6. user gossip
@@ -458,6 +532,8 @@ def sim_tick(
         view=view2,
         rumor_age=rumor_age,
         suspect_left=suspect_left,
+        rows=rows_next,
+        known_cnt=known_cnt,
         inc_self=inc_self,
         useen=new_seen,
         uage=uage,
@@ -468,6 +544,8 @@ def sim_tick(
     if not collect:
         return new_state, {"tick": t}
 
+    diag = jnp.eye(n, dtype=bool)
+    is_susp2 = ((view2 & 1) != 0) & ((view2 & DEAD_BIT) == 0) & (view2 >= 0)
     status2 = decode_status(view2)
     n_alive = jnp.sum(alive)
     truth_alive = alive[None, :] & (decode_epoch(view2) == state.epoch[None, :])
@@ -481,7 +559,9 @@ def sim_tick(
     # GossipProtocolImpl.java:242-251) — idle periods send nothing, so the
     # count is comparable to ClusterMath.maxMessagesPerGossip
     # (ClusterMath.java:53-67). Counted at the sender (loss doesn't unsend).
-    sender_active = jnp.any(age0 < params.periods_to_spread, axis=1)
+    # "Young to say" == the sender's payload row is non-empty: state.rows is
+    # exactly the young-masked table, plus a fired FD verdict this tick.
+    sender_active = jnp.any(state.rows >= 0, axis=1) | (fd_tgtm >= 0)
     msgs_gossip = sum(
         jnp.sum(sender_active[inv_perm[c]] & alive[inv_perm[c]] & nonself[c])
         for c in range(params.gossip_fanout)
@@ -490,7 +570,7 @@ def sim_tick(
         "tick": t,
         "convergence": convergence,
         "n_alive": n_alive,
-        "n_suspected": jnp.sum(is_susp & alive[:, None]),
+        "n_suspected": jnp.sum(is_susp2 & alive[:, None]),
         "msgs_gossip": msgs_gossip,
         "msgs_user": msgs_user,
         "msgs_fd": msgs_fd,

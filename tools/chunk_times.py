@@ -1,0 +1,37 @@
+"""Per-chunk wall times of the bench loop — find where bench.py's time goes."""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import dataclasses
+
+import jax
+
+from scalecube_cluster_tpu.sim import FaultPlan, SimParams, init_full_view, run_ticks
+from scalecube_cluster_tpu.sim.state import seeds_mask
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 10240
+pallas = bool(int(sys.argv[2])) if len(sys.argv) > 2 else True
+chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 40
+
+print("devices:", jax.devices(), file=sys.stderr)
+params = SimParams.from_cluster_config(n)
+if pallas:
+    params = dataclasses.replace(params, pallas_delivery=True)
+state = init_full_view(n)
+plan = FaultPlan.clean(n).with_loss(5.0)
+seeds = seeds_mask(n, [0, 1])
+
+t0 = time.perf_counter()
+for rep in range(6):
+    state, _ = run_ticks(params, state, plan, seeds, chunk, collect=False)
+    tick = int(state.tick)
+    t1 = time.perf_counter()
+    print(
+        f"chunk {rep}: {t1 - t0:7.3f}s  ({(t1 - t0) / chunk * 1e3:7.2f} ms/tick)"
+        f"  tick={tick}"
+    )
+    t0 = t1

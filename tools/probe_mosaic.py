@@ -1,0 +1,215 @@
+"""Mosaic capability probes for the fused tick kernel (ops/pallas_tick.py).
+
+Each probe is tiny and prints PASS/FAIL — run on the real TPU to verify the
+lowering constraints before committing to a kernel design:
+
+  1. int8 / int16 blocked inputs+outputs with elementwise converts/compares
+  2. 2D-sliced async copy (row window x lane slice) out of an ANY-memory ref
+  3. revisited output block accumulated across the innermost grid dim
+  4. SMEM scalar-prefetch dynamic loads + iota compare (fd cell mask)
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import traceback
+
+# PYTHONPATH breaks the axon plugin (see tools/profile_tick.py); self-insert.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def probe(name):
+    def deco(fn):
+        @functools.wraps(fn)
+        def run():
+            try:
+                fn()
+                print(f"PASS {name}")
+                return True
+            except Exception:
+                print(f"FAIL {name}")
+                traceback.print_exc(limit=3)
+                return False
+
+        return run
+
+    return deco
+
+
+@probe("int8/int16 blocked io + elementwise")
+def p_smallint():
+    n, m = 64, 256
+
+    def kernel(a8_ref, a16_ref, v_ref, o8_ref, o16_ref, ov_ref):
+        a8 = a8_ref[...]
+        a16 = a16_ref[...]
+        v = v_ref[...]
+        young = a8.astype(jnp.int32) < 7
+        o8_ref[...] = jnp.where(young, 0, jnp.minimum(a8, 119) + 1).astype(jnp.int8)
+        dec = jnp.maximum(a16.astype(jnp.int32) - 1, 0)
+        o16_ref[...] = jnp.where(young, 150, dec).astype(jnp.int16)
+        ov_ref[...] = jnp.where(young, v, -1)
+
+    a8 = jax.random.randint(jax.random.PRNGKey(0), (n, m), 0, 120).astype(jnp.int8)
+    a16 = jax.random.randint(jax.random.PRNGKey(1), (n, m), 0, 400).astype(jnp.int16)
+    v = jax.random.randint(jax.random.PRNGKey(2), (n, m), -1, 1 << 20, jnp.int32)
+    o8, o16, ov = pl.pallas_call(
+        kernel,
+        grid=(2,),
+        in_specs=[
+            pl.BlockSpec((32, m), lambda i: (i, 0)),
+            pl.BlockSpec((32, m), lambda i: (i, 0)),
+            pl.BlockSpec((32, m), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((32, m), lambda i: (i, 0)),
+            pl.BlockSpec((32, m), lambda i: (i, 0)),
+            pl.BlockSpec((32, m), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, m), jnp.int8),
+            jax.ShapeDtypeStruct((n, m), jnp.int16),
+            jax.ShapeDtypeStruct((n, m), jnp.int32),
+        ],
+    )(a8, a16, v)
+    young = a8.astype(jnp.int32) < 7
+    np.testing.assert_array_equal(
+        np.asarray(o8),
+        np.asarray(jnp.where(young, 0, jnp.minimum(a8, 119) + 1).astype(jnp.int8)),
+    )
+    np.testing.assert_array_equal(
+        np.asarray(o16),
+        np.asarray(
+            jnp.where(
+                young, 150, jnp.maximum(a16.astype(jnp.int32) - 1, 0)
+            ).astype(jnp.int16)
+        ),
+    )
+    np.testing.assert_array_equal(np.asarray(ov), np.asarray(jnp.where(young, v, -1)))
+
+
+@probe("2D-sliced window DMA from ANY ref")
+def p_window2d():
+    n, m, mc = 64, 512, 256
+
+    def kernel(idx_ref, rows_ref, o_ref, scratch, sem):
+        j = pl.program_id(0)
+        g = idx_ref[j]
+        pltpu.make_async_copy(
+            rows_ref.at[pl.ds(g * 8, 8), pl.ds(j * mc, mc)], scratch, sem
+        ).start()
+        pltpu.make_async_copy(
+            rows_ref.at[pl.ds(g * 8, 8), pl.ds(j * mc, mc)], scratch, sem
+        ).wait()
+        o_ref[...] = jnp.tile(scratch[...], (4, 1))
+
+    rows = jnp.arange(n * m, dtype=jnp.int32).reshape(n, m)
+    idx = jnp.asarray([3, 1], jnp.int32)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(2,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec((32, mc), lambda j, *_: (0, j)),
+            scratch_shapes=[
+                pltpu.VMEM((8, mc), jnp.int32),
+                pltpu.SemaphoreType.DMA,
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((32, m), jnp.int32),
+    )(idx, rows)
+    for j, g in enumerate([3, 1]):
+        np.testing.assert_array_equal(
+            np.asarray(out[:8, j * mc : (j + 1) * mc]),
+            np.asarray(rows[g * 8 : g * 8 + 8, j * mc : (j + 1) * mc]),
+        )
+
+
+@probe("revisited accumulator output over inner grid dim")
+def p_accum():
+    n, m, mc = 32, 512, 128
+
+    def kernel(x_ref, acc_ref):
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _():
+            acc_ref[...] = jnp.zeros_like(acc_ref)
+
+        part = jnp.sum(x_ref[...], axis=1, keepdims=True)  # (32, 1)
+        acc_ref[...] += jnp.broadcast_to(part, acc_ref.shape)
+
+    x = jax.random.randint(jax.random.PRNGKey(0), (n, m), 0, 5, jnp.int32)
+    acc = pl.pallas_call(
+        kernel,
+        grid=(1, m // mc),
+        in_specs=[pl.BlockSpec((n, mc), lambda i, j: (i, j))],
+        out_specs=pl.BlockSpec((n, 128), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, 128), jnp.int32),
+    )(x)
+    np.testing.assert_array_equal(
+        np.asarray(acc[:, 0]), np.asarray(jnp.sum(x, axis=1))
+    )
+
+
+@probe("SMEM dynamic scalar loads + fd cell mask")
+def p_fdmask():
+    n, m = 64, 256
+
+    def kernel(fdt_ref, fdk_ref, v_ref, o_ref):
+        i = pl.program_id(0)
+        base = i * 32
+        tgt = jnp.stack([fdt_ref[base + r] for r in range(32)]).reshape(32, 1)
+        key = jnp.stack([fdk_ref[base + r] for r in range(32)]).reshape(32, 1)
+        cols = jax.lax.broadcasted_iota(jnp.int32, (32, m), 1)
+        mask = cols == tgt
+        o_ref[...] = jnp.where(mask, key, v_ref[...])
+
+    fdt = jax.random.randint(jax.random.PRNGKey(0), (n,), -1, m, jnp.int32)
+    fdk = jax.random.randint(jax.random.PRNGKey(1), (n,), 0, 1 << 20, jnp.int32)
+    v = jax.random.randint(jax.random.PRNGKey(2), (n, m), -1, 1 << 20, jnp.int32)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(2,),
+            in_specs=[pl.BlockSpec((32, m), lambda i, *_: (i, 0))],
+            out_specs=pl.BlockSpec((32, m), lambda i, *_: (i, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((n, m), jnp.int32),
+    )(fdt, fdk, v)
+    cols = jnp.arange(m)[None, :]
+    expect = jnp.where(cols == fdt[:, None], fdk[:, None], v)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(expect))
+
+
+@probe("roll on rotated window (existing kernel dep)")
+def p_roll():
+    m = 256
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = pltpu.roll(x_ref[...], shift=3, axis=0)
+
+    x = jnp.arange(8 * m, dtype=jnp.int32).reshape(8, m)
+    out = pl.pallas_call(
+        kernel,
+        in_specs=[pl.BlockSpec((8, m), lambda: (0, 0))],
+        out_specs=pl.BlockSpec((8, m), lambda: (0, 0)),
+        out_shape=jax.ShapeDtypeStruct((8, m), jnp.int32),
+    )(x)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(jnp.roll(x, 3, axis=0)))
+
+
+if __name__ == "__main__":
+    print("devices:", jax.devices(), file=sys.stderr)
+    results = [p() for p in (p_smallint, p_window2d, p_accum, p_fdmask, p_roll)]
+    sys.exit(0 if all(results) else 1)

@@ -1,0 +1,156 @@
+"""Profile the dense sim tick on the real TPU: where does the time go?
+
+Methodology (the only one that measures truly on this box): each piece is
+jitted as a 20-iteration `lax.scan` whose carry is the piece's own output,
+called repeatedly with the previous call's result fed back in, and synced by
+fetching one element OF THE LARGE OUTPUT (the tick-counter trick undercounts:
+over the axon tunnel each output buffer has its own ready event, so a small
+output can be fetched while the big arrays are still streaming).
+
+Usage: python tools/profile_tick.py [n]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+# Repo-root import WITHOUT PYTHONPATH: setting PYTHONPATH=/root/repo breaks
+# the axon TPU plugin's registration (its helper subprocess inherits the env
+# and fails), while having the root on sys.path in-process is harmless.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from scalecube_cluster_tpu.ops.delivery import (
+    fanout_permutations_structured,
+    permuted_delivery_two_channel,
+)
+from scalecube_cluster_tpu.ops.merge import is_alive_key, merge_views
+from scalecube_cluster_tpu.ops.pallas_tick import delivery_merge_pallas
+from scalecube_cluster_tpu.ops.select import masked_random_choice, masked_random_topk
+from scalecube_cluster_tpu.sim import FaultPlan, SimParams, init_full_view, run_ticks
+from scalecube_cluster_tpu.sim.state import seeds_mask
+
+N = int(sys.argv[1]) if len(sys.argv) > 1 else 10240
+ITERS = 20
+CALLS = 5
+
+
+def scan_self(body):
+    """jit(20-iter scan) with carry = the piece's output pytree."""
+
+    def g(c):
+        def f(c, _):
+            return body(c), None
+
+        out, _ = lax.scan(f, c, None, length=ITERS)
+        return out
+
+    return jax.jit(g)
+
+
+def timed(name, fn, init):
+    carry = fn(init)  # compile + warmup
+    jax.block_until_ready(carry)
+    best = float("inf")
+    for _ in range(CALLS):
+        t0 = time.perf_counter()
+        carry = fn(carry)
+        # fetch from the largest leaf — its ready event gates the whole call
+        leaves = sorted(jax.tree_util.tree_leaves(carry), key=lambda a: -a.size)
+        _ = int(jnp.asarray(leaves[0]).ravel()[0])
+        best = min(best, time.perf_counter() - t0)
+    print(f"{name:44s} {best/ITERS*1e3:8.2f} ms/iter")
+
+
+def main():
+    n = N
+    print("devices:", jax.devices(), file=sys.stderr)
+    params = SimParams.from_cluster_config(n)
+    state = init_full_view(n)
+    plan = FaultPlan.clean(n).with_loss(5.0)
+    seeds = seeds_mask(n, [0, 1])
+    key = jax.random.PRNGKey(0)
+
+    # full tick loops, chunked-feedback style (ground truth)
+    for pal in (True, False):
+        p = dataclasses.replace(params, pallas_delivery=pal)
+
+        def full(s, p=p):
+            s2, _ = run_ticks(p, s, plan, seeds, ITERS, collect=False)
+            return s2
+
+        s = full(state)
+        jax.block_until_ready(s)
+        best = float("inf")
+        for _ in range(CALLS):
+            t0 = time.perf_counter()
+            s = full(s)
+            _ = int(jnp.asarray(s.view).ravel()[0])
+            best = min(best, time.perf_counter() - t0)
+        print(f"{'full tick (pallas=' + str(pal) + ')':44s} {best/ITERS*1e3:8.2f} ms/iter")
+
+    view = state.view
+    age = state.rumor_age
+    inv, ginv, rots = fanout_permutations_structured(key, n, params.gossip_fanout)
+    edge_ok = jnp.ones((params.gossip_fanout, n), bool)
+    alive = state.alive
+    rows = jnp.where(age < params.periods_to_spread, view, -1)
+    diag = jnp.eye(n, dtype=bool)
+
+    timed(
+        "pre-mask: fd where + age0 + rows",
+        scan_self(
+            lambda v: jnp.where(
+                jnp.where(age < 90, 0, age) < params.periods_to_spread, v, -1
+            )
+        ),
+        view,
+    )
+
+    timed(
+        "pallas delivery+merge kernel",
+        scan_self(
+            lambda v: delivery_merge_pallas(rows, v, ginv, rots, edge_ok, alive)[0]
+        ),
+        view,
+    )
+
+    def xla_dm(v):
+        ba, bal = permuted_delivery_two_channel(rows, is_alive_key, inv, edge_ok)
+        m, _ = merge_views(v, jnp.where(diag, -1, ba), jnp.where(diag, -1, bal))
+        return m
+
+    timed("XLA delivery+merge", scan_self(xla_dm), view)
+
+    def post(v):
+        armed = jnp.zeros((n, n), bool)
+        rearm = v != view
+        left0 = jnp.zeros((n, n), jnp.int32)
+        expired = armed & ~rearm & (left0 == 0) & ((v & (1 << 21)) == 0)
+        v2 = jnp.where(expired, v | 4, v)
+        ra = jnp.where(rearm, 0, jnp.minimum(age, 110) + 1)
+        tomb = ~diag & ((v2 & (1 << 21)) != 0) & (ra > 38)
+        return jnp.where(tomb, -1, v2) + ra.astype(jnp.int32) * 0
+
+    timed("post-chain (approx)", scan_self(post), view)
+
+    def fd_select(v):
+        cand = (v >= 0) & ~diag
+        tgt, _ = masked_random_choice(key, cand)
+        ridx, _ = masked_random_topk(key, cand, params.ping_req_members)
+        return v + tgt[:, None] * 0 + ridx.sum() * 0
+
+    timed("fd selection (choice+topk) [per fd tick]", scan_self(fd_select), view)
+
+    timed("elementwise copy-add", scan_self(lambda v: v + 1), view)
+
+
+if __name__ == "__main__":
+    main()

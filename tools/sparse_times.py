@@ -1,0 +1,43 @@
+"""Per-chunk wall times of the sparse engine on the TPU.
+
+Usage: python tools/sparse_times.py [n] [S] [chunk]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    kill_sparse,
+    run_sparse_ticks,
+)
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 49152
+S = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 40
+wb = int(sys.argv[4]) if len(sys.argv) > 4 else 16
+
+print("devices:", jax.devices(), file=sys.stderr)
+params = SparseParams.for_n(n, slot_budget=S, writeback_period=wb)
+state = init_sparse_full_view(n, slot_budget=S)
+state = kill_sparse(state, 7)  # one real failure so FD/suspicion does work
+plan = FaultPlan.clean(n).with_loss(5.0)
+
+t0 = time.perf_counter()
+for rep in range(6):
+    state, _ = run_sparse_ticks(params, state, plan, chunk, collect=False)
+    tick = int(state.tick)
+    t1 = time.perf_counter()
+    ms = (t1 - t0) / chunk * 1e3
+    print(
+        f"chunk {rep}: {t1 - t0:7.3f}s  ({ms:7.2f} ms/tick)"
+        f"  tick={tick}  -> {n / ms * 1e3:,.0f} member·rounds/s"
+    )
+    t0 = t1
