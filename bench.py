@@ -1,25 +1,34 @@
 """Headline benchmark: member-gossip-rounds per second on one chip.
 
-Simulates a dense SWIM cluster (sim/) at the largest member count that fits
-single-chip HBM dense, under LAN protocol ratios with 5% packet loss — the
-BASELINE.json "1k-member SWIM sim, 5% packet loss + suspicion" config scaled
-up. One tick advances every member one gossip round (plus the FD/SYNC work on
-their cadence), so throughput = n_members × ticks/sec, measured against the
-driver's north-star 1M member-gossip-rounds/sec (BASELINE.json north_star).
+Simulates a SWIM cluster under LAN protocol ratios with 5% packet loss and
+one genuinely-failed member — the BASELINE.json "1k-member SWIM sim, 5%
+packet loss + suspicion" config scaled up. One tick advances every member
+one gossip round (plus the FD/SYNC work on their cadence), so throughput =
+n_members × ticks/sec, measured against the driver's north-star 1M
+member-gossip-rounds/sec (BASELINE.json north_star).
 
-Hardened per VERDICT.md round-1 item 1: this script ALWAYS prints exactly one
-JSON line on stdout, no matter what the TPU tunnel does.
+Two engines climb the ladder largest-first:
+
+- ``sparse`` — the compact-rumor working-set engine (sim/sparse.py),
+  O(N·S) per tick: the scale path (SURVEY.md §7 hard part 4). Runs with
+  host-boundary slot frees (in_scan_writeback=False) and a compact uniform
+  fault plan so a single chip holds ~49k members.
+- ``dense`` — the full [N, N] engine (sim/tick.py) with the fused Pallas
+  tick-core kernel (ops/pallas_tick.py), the validation-scale engine.
+
+Hardened per VERDICT.md round-1 item 1: this script ALWAYS prints exactly
+one JSON line on stdout, no matter what the TPU tunnel does.
 
 - A tiny probe op with a hard deadline runs first, retried with backoff; if
   the backend never comes up, the JSON line carries an ``"error"`` field.
 - Each measured config runs in a subprocess with its own deadline, so a
   mid-dispatch hang (the round-1 failure mode: BENCH_r01.json rc=1, later
-  re-runs hanging >4 min) is converted into a fallback down an n-ladder.
+  re-runs hanging >4 min) is converted into a fallback down the ladder.
 - Timing syncs via a host fetch of the tick counter — jax.block_until_ready
   can report ready prematurely over this box's tunneled-TPU transport.
 
 Usage: ``python bench.py`` (driver mode — one JSON line) or
-``python bench.py --child <n> <pallas>`` (internal single-config worker).
+``python bench.py --child <engine> <n>`` (internal single-config worker).
 """
 
 from __future__ import annotations
@@ -31,8 +40,22 @@ import sys
 import time
 
 BASELINE_MEMBER_ROUNDS_PER_SEC = 1_000_000.0
-#: Largest-first ladder of member counts; first one that lands a number wins.
-N_LADDER = (10240, 4096, 1024)
+#: Largest-first ladder of (engine, n_members); first one that lands wins.
+#: 32768 is the single-chip ceiling: above it XLA's compile of the sparse
+#: scan degenerates (>>8 min at 40960/49152, measured) even though the
+#: arrays would fit HBM — a child would burn its whole deadline, so bigger
+#: configs are not attempted. ``dense-xla`` rungs keep a measurement
+#: landing even if the fused Pallas kernel ever fails to lower on the
+#: target chip.
+LADDER = (
+    ("sparse", 32768),
+    ("sparse", 16384),
+    ("dense", 10240),
+    ("dense-xla", 10240),
+    ("dense", 4096),
+    ("dense-xla", 4096),
+    ("dense-xla", 1024),
+)
 PROBE_DEADLINE_S = 120
 PROBE_RETRIES = 3
 CHILD_DEADLINE_S = 420
@@ -43,18 +66,18 @@ CHILD_DEADLINE_S = 420
 TOTAL_BUDGET_S = 1200
 
 
-def _measure(n_members: int, pallas: bool, chunk: int = 40, reps: int = 4) -> dict:
-    """Run the sim benchmark in-process and return the result dict."""
+def _measure_dense(
+    n_members: int, pallas: bool = True, chunk: int = 40, reps: int = 4
+) -> float:
     from scalecube_cluster_tpu.sim import FaultPlan, SimParams, init_full_view, run_ticks
-    from scalecube_cluster_tpu.sim.state import seeds_mask
+    from scalecube_cluster_tpu.sim.state import kill, seeds_mask
+    import dataclasses
 
-    params = SimParams.from_cluster_config(n_members)
-    if pallas:
-        import dataclasses
-
-        params = dataclasses.replace(params, pallas_delivery=True)
-    state = init_full_view(n_members)
-    plan = FaultPlan.clean(n_members).with_loss(5.0)
+    params = dataclasses.replace(
+        SimParams.from_cluster_config(n_members), pallas_delivery=pallas
+    )
+    state = kill(init_full_view(n_members), 7)
+    plan = FaultPlan.uniform(loss_percent=5.0)
     seeds = seeds_mask(n_members, [0, 1])
 
     # Warmup: compile + reach protocol steady state. int() is the host fetch
@@ -67,15 +90,50 @@ def _measure(n_members: int, pallas: bool, chunk: int = 40, reps: int = 4) -> di
         state, _ = run_ticks(params, state, plan, seeds, chunk, collect=False)
         int(state.tick)
     dt = time.perf_counter() - t0
+    return n_members * (reps * chunk / dt)
 
-    value = n_members * (reps * chunk / dt)
+
+def _measure_sparse(n_members: int, chunk: int = 48, reps: int = 4) -> float:
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+        kill_sparse,
+        run_sparse_chunked,
+    )
+
+    params = SparseParams.for_n(n_members, in_scan_writeback=False)
+    state = kill_sparse(
+        init_sparse_full_view(n_members, params.slot_budget), 7
+    )
+    plan = FaultPlan.uniform(loss_percent=5.0)
+
+    state, _ = run_sparse_chunked(params, state, plan, chunk, chunk, collect=False)
+    int(state.tick)
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, _ = run_sparse_chunked(
+            params, state, plan, chunk, chunk, collect=False
+        )
+        int(state.tick)
+    dt = time.perf_counter() - t0
+    return n_members * (reps * chunk / dt)
+
+
+def _measure(engine: str, n_members: int) -> dict:
+    """Run one benchmark config in-process and return the result dict."""
+    if engine == "sparse":
+        value = _measure_sparse(n_members)
+    else:
+        value = _measure_dense(n_members, pallas=(engine == "dense"))
     return {
         "metric": "member_gossip_rounds_per_sec",
         "value": round(value, 1),
         "unit": "member·rounds/s",
         "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
         "n_members": n_members,
-        "pallas": pallas,
+        "engine": engine,
     }
 
 
@@ -108,17 +166,17 @@ def _probe() -> str | None:
     return err
 
 
-def _run_child(n: int, pallas: bool) -> tuple[dict | None, str]:
+def _run_child(engine: str, n: int) -> tuple[dict | None, str]:
     """One measured config in a subprocess with a hard deadline.
 
     A fresh process per config also isolates backend state, so a wedged TPU
     dispatch can only cost this config, not the whole benchmark. Returns
     ``(result, failure_detail)``.
     """
-    tag = f"n={n} pallas={int(pallas)}"
+    tag = f"{engine} n={n}"
     try:
         res = subprocess.run(
-            [sys.executable, __file__, "--child", str(n), str(int(pallas))],
+            [sys.executable, __file__, "--child", engine, str(n)],
             capture_output=True,
             text=True,
             timeout=CHILD_DEADLINE_S,
@@ -142,20 +200,15 @@ def main() -> None:
     result = None
     err = _probe()
     last_fail = ""
-    out_of_budget = False
     if err is None:
-        for n in N_LADDER:
-            for pallas in (True, False):
-                if time.monotonic() - t_start > TOTAL_BUDGET_S:
-                    out_of_budget = True
-                    last_fail = f"budget {TOTAL_BUDGET_S}s exhausted; " + last_fail
-                    break
-                result, fail = _run_child(n, pallas)
-                if result is not None:
-                    break
-                last_fail = fail
-            if result is not None or out_of_budget:
+        for engine, n in LADDER:
+            if time.monotonic() - t_start > TOTAL_BUDGET_S:
+                last_fail = f"budget {TOTAL_BUDGET_S}s exhausted; " + last_fail
                 break
+            result, fail = _run_child(engine, n)
+            if result is not None:
+                break
+            last_fail = fail
         if result is None:
             err = f"all benchmark configs failed ({last_fail})"
     if result is None:
@@ -171,7 +224,7 @@ def main() -> None:
 
 if __name__ == "__main__":
     if len(sys.argv) == 4 and sys.argv[1] == "--child":
-        print(json.dumps(_measure(int(sys.argv[2]), bool(int(sys.argv[3])))))
+        print(json.dumps(_measure(sys.argv[2], int(sys.argv[3]))))
     else:
         os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
         main()

@@ -168,6 +168,91 @@ def churn_benchmark(
     }
 
 
+def sparse_scale_scenario(
+    n: int = 32768, ticks_per_phase: int | None = None
+) -> dict:
+    """Failure detection at compact-rumor scale (the 100k-path scenario,
+    sim/sparse.py): kill one member of an n-member cluster, drive until every
+    live viewer holds SUSPECT, then until suspicion expires it DEAD/UNKNOWN.
+
+    n = 32768 is the measured single-chip ceiling (PERF.md); the same
+    engine sharded 8-way holds the BASELINE 100k config
+    (__graft_entry__.dryrun_sparse).
+    """
+    import time
+
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+        kill_sparse,
+        run_sparse_chunked,
+    )
+
+    params = SparseParams.for_n(n, in_scan_writeback=False)
+    p = params.base
+    state = kill_sparse(init_sparse_full_view(n, params.slot_budget), 7)
+    plan = FaultPlan.uniform(loss_percent=5.0)
+
+    @jax.jit
+    def col_status(state, j):
+        # One subject's records across all viewers through the slab
+        # indirection — [N]-sized, instead of materializing the [N, N]
+        # effective view at 32k (4+ GB of eager temporaries).
+        s = state.subj_slot[j]
+        col = jnp.where(
+            s >= 0, state.slab[:, jnp.maximum(s, 0)], state.view_T[j, :]
+        )
+        return decode_status(col)
+
+    chunk = 48
+
+    def ceil_chunks(ticks):
+        # Whole chunks only: a ragged tail would recompile the scan for the
+        # remainder length (run_sparse_chunked's n_ticks is a static arg).
+        return -(-ticks // chunk) * chunk
+
+    # Warmup chunk: compiles the scan AND advances the protocol — its ticks
+    # count toward phase 1, its wall time does not count toward throughput
+    # (PERF.md methodology: steady-state chunks only).
+    state, _ = run_sparse_chunked(params, state, plan, chunk, chunk=chunk)
+    int(state.tick)
+    t0 = time.perf_counter()
+    phase1 = max(
+        ceil_chunks(ticks_per_phase or (p.fd_period_ticks * 8 + p.periods_to_spread))
+        - chunk,
+        chunk,
+    )
+    state, traces = run_sparse_chunked(params, state, plan, phase1, chunk=chunk)
+    dead_col = col_status(state, 7)
+    suspected = float(
+        jnp.sum((dead_col != int(MemberStatus.ALIVE)) & state.alive)
+        / jnp.sum(state.alive)
+    )
+    phase2 = ceil_chunks(
+        ticks_per_phase or (p.suspicion_ticks + p.periods_to_sweep + 60)
+    )
+    state, traces = run_sparse_chunked(params, state, plan, phase2, chunk=chunk)
+    dt = time.perf_counter() - t0
+    dead_col = col_status(state, 7)
+    removed = float(
+        jnp.sum(
+            ((dead_col == int(MemberStatus.DEAD))
+             | (dead_col == int(MemberStatus.UNKNOWN)))
+            & state.alive
+        )
+        / jnp.sum(state.alive)
+    )
+    total_ticks = phase1 + phase2  # timed ticks only (warmup excluded)
+    return {
+        "scenario": "sparse_scale_failure",
+        "n": n,
+        "suspected_frac_after_spread": round(suspected, 4),
+        "removed_frac_after_timeout": round(removed, 4),
+        "active_slots": int(jnp.sum(state.slot_subj >= 0)),
+        "member_rounds_per_sec": round(n * total_ticks / dt, 1),
+    }
+
+
 def run_all(scale: str = "small") -> list[dict]:
     """Run the grid. ``scale``: small (CI/CPU), large (one TPU chip)."""
     if scale not in ("small", "large"):
@@ -178,6 +263,7 @@ def run_all(scale: str = "small") -> list[dict]:
             lambda: lossy_suspicion_scenario(n=256, ticks=300),
             lambda: partition_recovery_scenario(n=256),
             lambda: churn_benchmark(n=256, churn_per_chunk=2, ticks=200),
+            lambda: sparse_scale_scenario(n=256),
         ]
     else:
         grid = [
@@ -185,6 +271,7 @@ def run_all(scale: str = "small") -> list[dict]:
             lambda: lossy_suspicion_scenario(n=1000),
             lambda: partition_recovery_scenario(n=10_000),
             lambda: churn_benchmark(n=8192, churn_per_chunk=16),
+            lambda: sparse_scale_scenario(n=32768),
         ]
     results = []
     for fn in grid:

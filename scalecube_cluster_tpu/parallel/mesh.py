@@ -90,7 +90,11 @@ def shard_state(state: SimState, mesh: Mesh) -> SimState:
 
 
 def shard_plan(plan: FaultPlan, mesh: Mesh) -> FaultPlan:
-    """Fault matrices shard like the view matrices."""
+    """Fault matrices shard like the view matrices; compact uniform plans
+    ([1, 1] matrices, sim/faults.py) replicate instead."""
+    if plan.block.shape[0] == 1:
+        rep = NamedSharding(mesh, P())
+        return jax.device_put(plan, FaultPlan(block=rep, loss=rep, mean_delay=rep))
     mat, _, _ = _specs(mesh)
     row = NamedSharding(mesh, mat)
     return jax.device_put(plan, FaultPlan(block=row, loss=row, mean_delay=row))

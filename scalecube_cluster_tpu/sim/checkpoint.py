@@ -45,8 +45,32 @@ def save_checkpoint(path: str | Path, state: SimState, params: SimParams) -> Non
 
 
 def load_checkpoint(path: str | Path) -> tuple[SimState, SimParams]:
-    """Load a snapshot; arrays come back on the default device."""
+    """Load a snapshot; arrays come back on the default device.
+
+    Snapshots written before the derived fields ``rows``/``known_cnt``
+    existed (sim/state.py) are reconstructed from ``view``/``rumor_age`` and
+    the saved params — they are pure functions of the persistent state.
+    """
     with np.load(_normalize(path)) as data:
         params = SimParams(**json.loads(bytes(data["__params__"]).decode()))
-        state = SimState(**{name: jax.numpy.asarray(data[name]) for name in _FIELDS})
+        arrays = {
+            name: jax.numpy.asarray(data[name])
+            for name in _FIELDS
+            if name in data
+        }
+        jnp = jax.numpy
+        if "rows" not in arrays:
+            arrays["rows"] = jnp.where(
+                arrays["rumor_age"] < params.periods_to_spread, arrays["view"], -1
+            )
+        if "known_cnt" not in arrays:
+            view = arrays["view"]
+            diag = jnp.eye(view.shape[0], dtype=bool)
+            from scalecube_cluster_tpu.ops.merge import DEAD_BIT
+
+            arrays["known_cnt"] = jnp.sum(
+                ((view >= 0) & ((view & DEAD_BIT) == 0) & ~diag).astype(jnp.int32),
+                axis=1,
+            )
+        state = SimState(**arrays)
     return state, params

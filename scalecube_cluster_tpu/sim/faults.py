@@ -46,22 +46,40 @@ from jax.tree_util import register_dataclass
 @register_dataclass
 @dataclass
 class FaultPlan:
-    """Per-directed-link fault settings over an N-member cluster."""
+    """Per-directed-link fault settings over an N-member cluster.
 
-    block: jax.Array  # [N, N] bool
-    loss: jax.Array  # [N, N] float32 in [0, 1)
-    mean_delay: jax.Array  # [N, N] float32 ms (0 = no delay)
+    Matrices are consulted per (src, dst) edge and may be **compact**: a
+    ``[1, 1]`` matrix means "the same setting on every link" (lookups clamp
+    indices into range). Uniform-fault scenarios — every benchmark and the
+    loss/delay grids — carry 24 bytes instead of 3 O(N²) matrices, which at
+    32k+ members is the difference between fitting HBM and not
+    (the three dense matrices cost ~9.7 GB at n=32768, twice the state).
+    """
+
+    block: jax.Array  # [N, N] (or [1, 1]) bool
+    loss: jax.Array  # [N, N] (or [1, 1]) float32 in [0, 1)
+    mean_delay: jax.Array  # [N, N] (or [1, 1]) float32 ms (0 = no delay)
 
     def replace(self, **changes) -> "FaultPlan":
         return dataclasses.replace(self, **changes)
 
     @classmethod
     def clean(cls, n: int) -> "FaultPlan":
-        """No faults (the emulator's initial state)."""
+        """No faults (the emulator's initial state), dense per-link form."""
         return cls(
             block=jnp.zeros((n, n), bool),
             loss=jnp.zeros((n, n), jnp.float32),
             mean_delay=jnp.zeros((n, n), jnp.float32),
+        )
+
+    @classmethod
+    def uniform(cls, loss_percent: float = 0.0, mean_delay_ms: float = 0.0):
+        """Compact whole-cluster plan: same loss/delay on every link, no
+        blocks. O(1) memory — use for benchmarks and uniform grids."""
+        return cls(
+            block=jnp.zeros((1, 1), bool),
+            loss=jnp.full((1, 1), loss_percent / 100.0, jnp.float32),
+            mean_delay=jnp.full((1, 1), mean_delay_ms, jnp.float32),
         )
 
     def with_loss(self, percent: float) -> "FaultPlan":
@@ -76,16 +94,30 @@ class FaultPlan:
 
     def block_outbound(self, src, dst) -> "FaultPlan":
         """Block link(s) src→dst (blockOutbound, NetworkEmulator.java:87-110)."""
+        if self.block.shape[0] == 1:
+            raise ValueError(
+                "per-link blocks need a dense plan (FaultPlan.clean(n))"
+            )
         return self.replace(block=self.block.at[src, dst].set(True))
 
     def partition(self, group_a, group_b) -> "FaultPlan":
         """Symmetric partition between two member groups (the reference's
         block-both-directions pattern, MembershipProtocolTest.java:94-180)."""
+        if self.block.shape[0] == 1:
+            raise ValueError("partitions need a dense plan (FaultPlan.clean(n))")
         a = jnp.asarray(group_a, jnp.int32)
         b = jnp.asarray(group_b, jnp.int32)
         block = self.block.at[a[:, None], b[None, :]].set(True)
         block = block.at[b[:, None], a[None, :]].set(True)
         return self.replace(block=block)
+
+
+def _edge_lookup(mat: jax.Array, src: jax.Array, dst: jax.Array) -> jax.Array:
+    """``mat[src, dst]`` honoring the compact [1, 1] uniform layout (indices
+    clamp into range, so every edge reads the single setting)."""
+    s = jnp.minimum(src, mat.shape[0] - 1)
+    d = jnp.minimum(dst, mat.shape[1] - 1)
+    return mat[s, d]
 
 
 def link_pass(
@@ -98,8 +130,8 @@ def link_pass(
     delay are a separate per-path draw (:func:`round_trip_in_time`).
     ``src``/``dst`` are broadcast-compatible int32 index arrays.
     """
-    blocked = plan.block[src, dst]
-    loss = plan.loss[src, dst]
+    blocked = _edge_lookup(plan.block, src, dst)
+    loss = _edge_lookup(plan.loss, src, dst)
     u = jax.random.uniform(rng, jnp.shape(blocked))
     return ~blocked & (u >= loss)
 
@@ -125,7 +157,7 @@ def round_trip_in_time(
         theta = (sum of leg mean delays) / k.
     """
     k = len(legs)
-    mean_total = sum(plan.mean_delay[s, d] for s, d in legs)
+    mean_total = sum(_edge_lookup(plan.mean_delay, s, d) for s, d in legs)
     theta = mean_total / k
     has_delay = theta > 0
     x = deadline_ms / jnp.where(has_delay, theta, 1.0)

@@ -104,6 +104,14 @@ class SparseParams:
     #: between write-backs, done slots simply stay pinned a little longer.
     #: Protocol values are unchanged — only slot availability timing shifts.
     writeback_period: int = 1
+    #: When False the tick NEVER touches view_T (frees/write-backs happen
+    #: host-side between scan chunks via :func:`writeback_free`). Inside a
+    #: `lax.scan` even a cond-gated scatter costs a resident copy of the
+    #: [N, N] operand (XLA cond outputs cannot alias operands when one
+    #: branch writes), which out-of-memories n >= 32k on one chip; the
+    #: host-boundary route keeps exactly ONE view_T buffer live (donated
+    #: in-place scatter). Semantics = writeback_period == chunk length.
+    in_scan_writeback: bool = True
 
     @classmethod
     def for_n(
@@ -112,6 +120,7 @@ class SparseParams:
         slot_budget: int = 2048,
         alloc_cap: int = 64,
         writeback_period: int = 1,
+        in_scan_writeback: bool = True,
         **kw,
     ):
         return cls(
@@ -119,6 +128,7 @@ class SparseParams:
             slot_budget=slot_budget,
             alloc_cap=alloc_cap,
             writeback_period=writeback_period,
+            in_scan_writeback=in_scan_writeback,
         )
 
 
@@ -377,40 +387,48 @@ def sparse_tick(
     # second-chance-after-sweep heal path: the tombstone must demote to
     # UNKNOWN on write-back, not persist in view_T forever. Dead viewers
     # never pin (their rows are inert until restart).
-    active = state.slot_subj >= 0
-    own_row = col[:, None] == state.slot_subj[None, :]  # viewer == subject
-    dead_rec = ((state.slab & DEAD_BIT) != 0) & (state.slab >= 0)
-    stale_done = state.age.astype(jnp.int32) > p.periods_to_sweep
-    holding = (
-        (state.age < p.periods_to_spread)
-        | (state.susp > 0)
-        | (dead_rec & ~stale_done & ~own_row)
-    )
-    pinned = jnp.any(holding & alive[:, None], axis=0)
-    # Frees happen only on write-back ticks (SparseParams.writeback_period):
-    # the full-table scatter below is the one op that touches all of view_T,
-    # so it must not run every tick.
-    do_wb = (t % params.writeback_period) == 0
-    freeing = active & ~pinned & do_wb
-    # Tombstone demotion on write-back: a DEAD record whose rumor fully aged
-    # out becomes UNKNOWN (the dense engine's tomb_expired, sim/tick.py) —
-    # except the subject's own row (a leaver keeps its own tombstone).
-    wb_subj = jnp.where(freeing, state.slot_subj, n)
+    if params.in_scan_writeback:
+        active = state.slot_subj >= 0
+        own_row = col[:, None] == state.slot_subj[None, :]  # viewer == subject
+        dead_rec = ((state.slab & DEAD_BIT) != 0) & (state.slab >= 0)
+        stale_done = state.age.astype(jnp.int32) > p.periods_to_sweep
+        holding = (
+            (state.age < p.periods_to_spread)
+            | (state.susp > 0)
+            | (dead_rec & ~stale_done & ~own_row)
+        )
+        pinned = jnp.any(holding & alive[:, None], axis=0)
+        # Frees happen only on write-back ticks (writeback_period): the
+        # full-table scatter below is the one op that touches all of view_T,
+        # so it must not run every tick.
+        do_wb = (t % params.writeback_period) == 0
+        freeing = active & ~pinned & do_wb
+        # Tombstone demotion on write-back: a DEAD record whose rumor fully
+        # aged out becomes UNKNOWN (the dense engine's tomb_expired,
+        # sim/tick.py) — except the subject's own row (a leaver keeps its
+        # own tombstone).
+        wb_subj = jnp.where(freeing, state.slot_subj, n)
 
-    def apply_writeback(view_T):
-        demote = dead_rec & stale_done & ~own_row
-        writeback = jnp.where(demote, UNKNOWN_KEY, state.slab)  # [N_view, S]
-        # Scatter freed slots' columns back into view_T rows (subject-major:
-        # one contiguous row per freed slot). Non-freeing slots route out of
-        # bounds and are dropped — freed subjects are unique, so no
-        # clobbering.
-        return view_T.at[wb_subj, :].set(writeback.T, mode="drop")
+        def apply_writeback(view_T):
+            demote = dead_rec & stale_done & ~own_row
+            writeback = jnp.where(demote, UNKNOWN_KEY, state.slab)
+            # Scatter freed slots' columns back into view_T rows
+            # (subject-major: one contiguous row per freed slot).
+            # Non-freeing slots route out of bounds and are dropped —
+            # freed subjects are unique, so no clobbering.
+            return view_T.at[wb_subj, :].set(writeback.T, mode="drop")
 
-    view_T = lax.cond(
-        jnp.any(freeing), apply_writeback, lambda vt: vt, state.view_T
-    )
-    slot_subj = jnp.where(freeing, -1, state.slot_subj)
-    subj_slot = state.subj_slot.at[wb_subj].set(-1, mode="drop")
+        view_T = lax.cond(
+            jnp.any(freeing), apply_writeback, lambda vt: vt, state.view_T
+        )
+        slot_subj = jnp.where(freeing, -1, state.slot_subj)
+        subj_slot = state.subj_slot.at[wb_subj].set(-1, mode="drop")
+    else:
+        # Host-boundary mode: view_T is read-only inside the scan (one
+        # resident buffer); :func:`writeback_free` runs between chunks.
+        view_T = state.view_T
+        slot_subj = state.slot_subj
+        subj_slot = state.subj_slot
 
     # Activation requests: FD-fired targets + SYNC-learned subjects.
     req = jnp.zeros((n,), bool)
@@ -456,22 +474,30 @@ def sparse_tick(
     active = slot_subj >= 0
 
     # ------------------------------ 4. apply FD verdicts + SYNC learnings
-    # Both are per-viewer single-subject updates routed through the slab.
-    def apply_point(slab, age, viewer, subject, key, fire):
-        s = subj_slot[subject]
-        ok = fire & (s >= 0)
-        s_safe = jnp.where(ok, s, 0)
-        old = slab[viewer, s_safe]
-        newv = jnp.where(ok, key, old)
-        slab = slab.at[viewer, s_safe].set(newv)
-        age = age.at[viewer, s_safe].set(
-            jnp.where(ok & (newv != old), 0, age[viewer, s_safe])
-        )
-        return slab, age
-
+    # Both are per-viewer single-slot updates; as fused [N, S] where-passes
+    # (cell mask = the viewer's row at the subject's slot) rather than
+    # scatters — an XLA scatter re-materializes the whole slab/age operand,
+    # which costs more than the rest of the tick at 24k+ members. A fired
+    # verdict / accepted SYNC learning always strictly changes the record
+    # (both accept tests require a lattice override), so the age resets
+    # unconditionally at the written cell.
     slab0 = slab
-    slab, age = apply_point(slab, age, col, fd_tgt, fd_key, fd_fire)
-    slab, age = apply_point(slab, age, col, sy_subj, sy_key, sy_accept)
+    fd_slot = jnp.where(fd_fire & (subj_slot[fd_tgt] >= 0), subj_slot[fd_tgt], -1)
+    sy_slot = jnp.where(
+        sy_accept & (subj_slot[sy_subj] >= 0), subj_slot[sy_subj], -1
+    )
+    cell_fd = srange[None, :] == fd_slot[:, None]
+    cell_sy = srange[None, :] == sy_slot[:, None]
+    # SYNC wins a same-cell collision (it was applied second before).
+    slab = jnp.where(
+        cell_sy, sy_key[:, None], jnp.where(cell_fd, fd_key[:, None], slab)
+    )
+    # NOT redundant with step 6's changed-driven reset: the young-mask of
+    # THIS tick's delivery (step 5) reads this age, so the fresh verdict
+    # must already be young to gossip out in the same period — exactly the
+    # reference, where the FD event's record update precedes the next
+    # doSpreadGossip (MembershipProtocolImpl.java:376-404).
+    age = jnp.where(cell_sy | cell_fd, jnp.asarray(0, jnp.int8), age)
 
     # ------------------------------------------------- 5. gossip delivery
     inv_perm, ginv, rots = fanout_permutations_structured(k_gsel, n, p.gossip_fanout)
@@ -610,6 +636,66 @@ def run_sparse_ticks(
         return sparse_tick(params, carry, plan, collect=collect)
 
     return lax.scan(step, state, None, length=n_ticks)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(1,))
+def writeback_free(params: SparseParams, state: SparseState) -> SparseState:
+    """Free done slots and write them back to ``view_T`` — the host-boundary
+    twin of the in-scan cond write-back (same pin rule, same tombstone
+    demotion). With the state DONATED, the view_T scatter happens in place:
+    exactly one [N, N] buffer stays live, which is what lets 32k+ members
+    run on a single chip (see SparseParams.in_scan_writeback).
+    """
+    p = params.base
+    n = p.n
+    col = jnp.arange(n, dtype=jnp.int32)
+    alive = state.alive
+    active = state.slot_subj >= 0
+    own_row = col[:, None] == state.slot_subj[None, :]
+    dead_rec = ((state.slab & DEAD_BIT) != 0) & (state.slab >= 0)
+    stale_done = state.age.astype(jnp.int32) > p.periods_to_sweep
+    holding = (
+        (state.age < p.periods_to_spread)
+        | (state.susp > 0)
+        | (dead_rec & ~stale_done & ~own_row)
+    )
+    pinned = jnp.any(holding & alive[:, None], axis=0)
+    freeing = active & ~pinned
+    wb_subj = jnp.where(freeing, state.slot_subj, n)
+    demote = dead_rec & stale_done & ~own_row
+    writeback = jnp.where(demote, UNKNOWN_KEY, state.slab)
+    return state.replace(
+        view_T=state.view_T.at[wb_subj, :].set(writeback.T, mode="drop"),
+        slot_subj=jnp.where(freeing, -1, state.slot_subj),
+        subj_slot=state.subj_slot.at[wb_subj].set(-1, mode="drop"),
+    )
+
+
+def run_sparse_chunked(
+    params: SparseParams,
+    state: SparseState,
+    plan: FaultPlan,
+    n_ticks: int,
+    chunk: int = 48,
+    collect: bool = True,
+):
+    """Scan in chunks with host-boundary slot frees between them.
+
+    The big-n driver: build ``params`` with ``in_scan_writeback=False`` so
+    the scan holds a single view_T buffer, then frees amortize to once per
+    ``chunk`` ticks. Returns ``(state, last_chunk_traces)``.
+    """
+    if params.in_scan_writeback:
+        raise ValueError("use in_scan_writeback=False with the chunked runner")
+    done = 0
+    traces = {}
+    while done < n_ticks:
+        state, traces = run_sparse_ticks(
+            params, state, plan, min(chunk, n_ticks - done), collect=collect
+        )
+        state = writeback_free(params, state)
+        done += chunk
+    return state, traces
 
 
 def effective_view(state: SparseState) -> jax.Array:

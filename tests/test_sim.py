@@ -237,6 +237,26 @@ def test_determinism():
     assert bool(jnp.all(outs[0][1] == outs[1][1]))
 
 
+def test_uniform_plan_equals_dense_plan():
+    """The compact [1,1] FaultPlan (O(1) memory, sim/faults.py) drives the
+    exact same trajectory as its dense equivalent — same loss draws, same
+    convergence (the big-n benchmark correctness precondition)."""
+    n = 16
+    p = small_params(n)
+    sm = seeds_mask(n, [0])
+    outs = []
+    for plan in (
+        FaultPlan.clean(n).with_loss(10.0).with_mean_delay(100.0),
+        FaultPlan.uniform(loss_percent=10.0, mean_delay_ms=100.0),
+    ):
+        st = init_full_view(n, user_gossip_slots=2, seed=7)
+        st = kill(st, 3)
+        st, tr = run_ticks(p, st, plan, sm, 50)
+        outs.append((st.view, tr["convergence"]))
+    assert bool(jnp.all(outs[0][0] == outs[1][0]))
+    assert bool(jnp.all(outs[0][1] == outs[1][1]))
+
+
 @pytest.mark.parametrize("n_dev", [8])
 def test_sharded_equals_single(n_dev):
     """Sharding the member axis over 8 virtual devices must not change the

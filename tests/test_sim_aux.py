@@ -44,6 +44,30 @@ def test_checkpoint_roundtrip_is_exact(tmp_path):
     assert bool(jnp.all(tr_a["convergence"] == tr_b["convergence"]))
 
 
+def test_checkpoint_backfills_derived_fields(tmp_path):
+    """Snapshots from before rows/known_cnt existed load via reconstruction
+    (they are pure functions of view/rumor_age + params)."""
+    import numpy as np
+
+    n = 16
+    p = small_params(n)
+    plan, sm = FaultPlan.clean(n).with_loss(10.0), seeds_mask(n, [0])
+    st = init_full_view(n, user_gossip_slots=2, seed=3)
+    st, _ = run_ticks(p, st, plan, sm, 20)
+    save_checkpoint(tmp_path / "snap.npz", st, p)
+
+    # Strip the derived fields, as an old-format archive would lack them.
+    with np.load(tmp_path / "snap.npz") as data:
+        stripped = {
+            k: data[k] for k in data.files if k not in ("rows", "known_cnt")
+        }
+    np.savez(tmp_path / "old.npz", **stripped)
+
+    loaded, _ = load_checkpoint(tmp_path / "old.npz")
+    assert bool(jnp.all(loaded.rows == st.rows))
+    assert bool(jnp.all(loaded.known_cnt == st.known_cnt))
+
+
 def test_monitor_views():
     n = 10
     p = small_params(n)

@@ -22,7 +22,7 @@ params = SimParams.from_cluster_config(n)
 if pallas:
     params = dataclasses.replace(params, pallas_delivery=True)
 state = init_full_view(n)
-plan = FaultPlan.clean(n).with_loss(5.0)
+plan = FaultPlan.uniform(loss_percent=5.0)
 seeds = seeds_mask(n, [0, 1])
 
 t0 = time.perf_counter()

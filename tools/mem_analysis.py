@@ -1,0 +1,38 @@
+"""AOT memory analysis of run_sparse_ticks at a given n — what holds HBM?
+
+Usage: python tools/mem_analysis.py [n] [S] [chunk]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    run_sparse_ticks,
+)
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+S = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 48
+
+print("devices:", jax.devices(), file=sys.stderr)
+params = SparseParams.for_n(n, slot_budget=S, in_scan_writeback=False)
+state = jax.eval_shape(lambda: init_sparse_full_view(n, slot_budget=S))
+plan = jax.eval_shape(lambda: FaultPlan.clean(n))
+
+lowered = run_sparse_ticks.lower(params, state, plan, chunk, collect=False)
+try:
+    compiled = lowered.compile()
+    ma = compiled.memory_analysis()
+    print(ma)
+except Exception as e:
+    print("compile failed:", str(e)[:600])
+    # Fall back: count big buffers in the optimized HLO's buffer assignment.
+    txt = lowered.as_text()
+    print("HLO size:", len(txt))

@@ -16,23 +16,22 @@ from scalecube_cluster_tpu.sim.sparse import (
     SparseParams,
     init_sparse_full_view,
     kill_sparse,
-    run_sparse_ticks,
+    run_sparse_chunked,
 )
 
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 49152
 S = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
-chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 40
-wb = int(sys.argv[4]) if len(sys.argv) > 4 else 16
+chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 48
 
 print("devices:", jax.devices(), file=sys.stderr)
-params = SparseParams.for_n(n, slot_budget=S, writeback_period=wb)
+params = SparseParams.for_n(n, slot_budget=S, in_scan_writeback=False)
 state = init_sparse_full_view(n, slot_budget=S)
 state = kill_sparse(state, 7)  # one real failure so FD/suspicion does work
-plan = FaultPlan.clean(n).with_loss(5.0)
+plan = FaultPlan.uniform(loss_percent=5.0)
 
 t0 = time.perf_counter()
 for rep in range(6):
-    state, _ = run_sparse_ticks(params, state, plan, chunk, collect=False)
+    state, _ = run_sparse_chunked(params, state, plan, chunk, chunk, collect=False)
     tick = int(state.tick)
     t1 = time.perf_counter()
     ms = (t1 - t0) / chunk * 1e3
