@@ -24,8 +24,10 @@ one JSON line on stdout, no matter what the TPU tunnel does.
 - Each measured config runs in a subprocess with its own deadline, so a
   mid-dispatch hang (the round-1 failure mode: BENCH_r01.json rc=1, later
   re-runs hanging >4 min) is converted into a fallback down the ladder.
-- Timing syncs via a host fetch of the tick counter — jax.block_until_ready
-  can report ready prematurely over this box's tunneled-TPU transport.
+- Timing syncs via a one-element host fetch off a LARGE output buffer —
+  jax.block_until_ready and small-output fetches can both report ready
+  prematurely over this box's tunneled-TPU transport (each output buffer's
+  ready event completes independently).
 
 Usage: ``python bench.py`` (driver mode — one JSON line) or
 ``python bench.py --child <engine> <n>`` (internal single-config worker).
@@ -80,15 +82,17 @@ def _measure_dense(
     plan = FaultPlan.uniform(loss_percent=5.0)
     seeds = seeds_mask(n_members, [0, 1])
 
-    # Warmup: compile + reach protocol steady state. int() is the host fetch
-    # that actually synchronizes (see module docstring).
+    # Warmup: compile + reach protocol steady state. The element fetch off
+    # the LARGE view buffer is the host sync: one element waits for that
+    # whole buffer's ready event, and intermediate chunks are serialized by
+    # the feed-back data dependency (see module docstring).
     state, _ = run_ticks(params, state, plan, seeds, chunk, collect=False)
-    int(state.tick)
+    int(state.view[0, 0])
 
     t0 = time.perf_counter()
     for _ in range(reps):
         state, _ = run_ticks(params, state, plan, seeds, chunk, collect=False)
-        int(state.tick)
+        int(state.view[0, 0])
     dt = time.perf_counter() - t0
     return n_members * (reps * chunk / dt)
 
@@ -109,14 +113,14 @@ def _measure_sparse(n_members: int, chunk: int = 48, reps: int = 4) -> float:
     plan = FaultPlan.uniform(loss_percent=5.0)
 
     state, _ = run_sparse_chunked(params, state, plan, chunk, chunk, collect=False)
-    int(state.tick)
+    int(state.view_T[0, 0])
 
     t0 = time.perf_counter()
     for _ in range(reps):
         state, _ = run_sparse_chunked(
             params, state, plan, chunk, chunk, collect=False
         )
-        int(state.tick)
+        int(state.view_T[0, 0])
     dt = time.perf_counter() - t0
     return n_members * (reps * chunk / dt)
 

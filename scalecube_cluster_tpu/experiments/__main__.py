@@ -1,7 +1,26 @@
-"""``python -m scalecube_cluster_tpu.experiments [small|large]``."""
+"""``python -m scalecube_cluster_tpu.experiments [small|large] [--out FILE]``.
 
+Runs the BASELINE scenario grid (scenarios.py) and prints one JSON line per
+scenario; ``--out`` additionally appends the lines to FILE so a TPU run's
+results can be committed verbatim (VERDICT round-1 item 10).
+"""
+
+import json
 import sys
 
 from scalecube_cluster_tpu.experiments.scenarios import run_all
 
-run_all(sys.argv[1] if len(sys.argv) > 1 else "small")
+args = [a for a in sys.argv[1:]]
+out = None
+if "--out" in args:
+    i = args.index("--out")
+    if i + 1 >= len(args):
+        sys.exit("usage: ... [small|large] [--out FILE]  (--out needs a path)")
+    out = args[i + 1]
+    del args[i : i + 2]
+
+results = run_all(args[0] if args else "small")
+if out:
+    with open(out, "a") as fh:
+        for r in results:
+            fh.write(json.dumps(r) + "\n")

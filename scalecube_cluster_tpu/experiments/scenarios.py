@@ -211,11 +211,13 @@ def sparse_scale_scenario(
         # remainder length (run_sparse_chunked's n_ticks is a static arg).
         return -(-ticks // chunk) * chunk
 
-    # Warmup chunk: compiles the scan AND advances the protocol — its ticks
-    # count toward phase 1, its wall time does not count toward throughput
-    # (PERF.md methodology: steady-state chunks only).
+    # Warmup chunk: compiles the scan AND the status probe, and advances the
+    # protocol — its ticks count toward phase 1, its wall time does not
+    # count toward throughput (PERF.md methodology: steady-state chunks
+    # only). The large-buffer element fetch is the host sync.
     state, _ = run_sparse_chunked(params, state, plan, chunk, chunk=chunk)
-    int(state.tick)
+    col_status(state, 7)
+    int(state.view_T[0, 0])
     t0 = time.perf_counter()
     phase1 = max(
         ceil_chunks(ticks_per_phase or (p.fd_period_ticks * 8 + p.periods_to_spread))
@@ -232,6 +234,7 @@ def sparse_scale_scenario(
         ticks_per_phase or (p.suspicion_ticks + p.periods_to_sweep + 60)
     )
     state, traces = run_sparse_chunked(params, state, plan, phase2, chunk=chunk)
+    int(state.view_T[0, 0])
     dt = time.perf_counter() - t0
     dead_col = col_status(state, 7)
     removed = float(

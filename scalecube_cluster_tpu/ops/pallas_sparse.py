@@ -1,0 +1,271 @@
+"""Fused Pallas core for the compact-rumor engine (sim/sparse.py).
+
+One kernel pass covers the sparse tick's [N, S] hot section — young-payload
+masking, structured-fan-out gossip delivery, membership merge (ops/merge.py
+lattice), suspicion sweep, rumor aging — reading each state array once:
+
+  read  f×{slab,age} sender windows + local {slab, age, susp}
+  write {slab2, age2, susp2} + the [N] self-rumor column
+
+The XLA chain it replaces materializes rows/best_any/best_alive/merged and
+the suspicion intermediates separately (~2.5× the traffic, plus gather
+latency); bit-parity with that chain is asserted over whole trajectories by
+tests/test_sparse.py::test_pallas_core_matches_xla.
+
+Window structure: the sparse fan-out uses 32-row sender groups
+(fanout_permutations_structured(group=32)) so the int8 age windows are
+tile-aligned (int8 sublane = 32); receiver blocks are the same 32 rows.
+Per-receiver scalars ride two packed SMEM int32 vectors (edge-ok bits +
+alive bit; fd/sync point-update slots) to keep scalar-prefetch memory small
+at 32k members.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from scalecube_cluster_tpu.ops.merge import DEAD_BIT, is_alive_key
+from scalecube_cluster_tpu.ops.pallas_tick import _merge_rows
+
+#: Sender-group/receiver-block size (int8 sublane tile).
+SPARSE_GROUP = 32
+#: Bit of the packed flags word holding the receiver's process liveness.
+ALIVE_BIT = 7
+#: Slot indices pack +1 into 12-bit fields of one int32 (0 = no update).
+SLOT_BITS = 12
+SLOT_MASK = (1 << SLOT_BITS) - 1
+
+
+def pack_flags(edge_ok, alive):
+    """``[f, N]`` bool edge-ok + ``[N]`` bool alive → packed ``[N]`` int32."""
+    f = edge_ok.shape[0]
+    word = alive.astype(jnp.int32) << ALIVE_BIT
+    for c in range(f):
+        word = word | (edge_ok[c].astype(jnp.int32) << c)
+    return word
+
+
+def pack_slots(fd_slot, sy_slot):
+    """Two ``[N]`` int32 slot vectors (-1 = none) → packed ``[N]`` int32."""
+    return (fd_slot + 1) | ((sy_slot + 1) << SLOT_BITS)
+
+
+def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale):
+    b = SPARSE_GROUP
+
+    def kernel(
+        ginv_ref,
+        rot_ref,
+        flags_ref,
+        slots_ref,
+        slab_hbm_ref,
+        age_hbm_ref,
+        subj_ref,
+        slab_ref,
+        age_ref,
+        susp_ref,
+        slab2_ref,
+        age2_ref,
+        susp2_ref,
+        self_ref,
+        wslab,
+        wage,
+        sems,
+    ):
+        i = pl.program_id(0)
+
+        def dma(block, slot, c):
+            base = ginv_ref[c, block] * b
+            return (
+                pltpu.make_async_copy(
+                    slab_hbm_ref.at[pl.ds(base, b)], wslab.at[slot, c], sems.at[slot, c, 0]
+                ),
+                pltpu.make_async_copy(
+                    age_hbm_ref.at[pl.ds(base, b)], wage.at[slot, c], sems.at[slot, c, 1]
+                ),
+            )
+
+        @pl.when(i == 0)
+        def _():
+            for c in range(f):
+                for copy in dma(0, 0, c):
+                    copy.start()
+
+        @pl.when(i + 1 < nb)
+        def _():
+            for c in range(f):
+                for copy in dma(i + 1, (i + 1) % 2, c):
+                    copy.start()
+
+        slot = i % 2
+        lane_ids = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+        subj_lane = subj_ref[0:1, :]  # (1, s) slot_subj
+        active_lane = subj_lane >= 0
+
+        flags = jnp.stack([flags_ref[i * b + r] for r in range(b)]).reshape(b, 1)
+        slots = jnp.stack([slots_ref[i * b + r] for r in range(b)]).reshape(b, 1)
+
+        best_any = jnp.full((b, s), -1, jnp.int32)
+        best_alive = best_any
+        for c in range(f):
+            for copy in dma(i, slot, c):
+                copy.wait()
+            rot = rot_ref[c, i]
+            w = pltpu.roll(wslab[slot, c], shift=b - rot, axis=0)
+            wa = pltpu.roll(wage[slot, c], shift=b - rot, axis=0)
+            young_w = wa.astype(jnp.int32) < spread
+            payload = jnp.where(young_w & active_lane, w, -1)
+            ok = ((flags >> c) & 1) != 0
+            contrib = jnp.where(ok, payload, -1)
+            best_any = jnp.maximum(best_any, contrib)
+            best_alive = jnp.maximum(
+                best_alive, jnp.where(is_alive_key(contrib), contrib, -1)
+            )
+
+        # Self-rumor channel (receiver == slot's subject), then exclusion.
+        row_ids = jax.lax.broadcasted_iota(jnp.int32, (b, s), 0) + i * b
+        own = subj_lane == row_ids
+        self_vals = jnp.max(jnp.where(own, best_any, -1), axis=1)
+        self_ref[...] = jnp.broadcast_to(self_vals.reshape(b, 1), (b, 128))
+        best_any = jnp.where(own, -1, best_any)
+        best_alive = jnp.where(own, -1, best_alive)
+
+        local = slab_ref[...]
+        merged = _merge_rows(local, best_any, best_alive)
+        merged = jnp.where(active_lane, merged, local)
+        alive_row = ((flags >> ALIVE_BIT) & 1) != 0
+        merged = jnp.where(alive_row, merged, local)
+
+        # Suspicion sweep + aging (sim/sparse.py step 6). ``rearm``/
+        # ``changed`` compare against the PRE-point-update slab; a point
+        # update always strictly raises the key, so `| point_cell` restores
+        # that comparison from the post-update local block.
+        fd_s = (slots & SLOT_MASK) - 1
+        sy_s = ((slots >> SLOT_BITS) & SLOT_MASK) - 1
+        point_cell = (lane_ids == fd_s) | (lane_ids == sy_s)
+        s_loc = susp_ref[...].astype(jnp.int32)
+        armed = s_loc > 0
+        rearm = (merged != local) | point_cell
+        left0 = jnp.maximum(s_loc - 1, 0)
+        expired = (
+            alive_row
+            & armed
+            & ~rearm
+            & (left0 == 0)
+            & ((merged & DEAD_BIT) == 0)
+            & ((merged & 1) != 0)
+            & (merged >= 0)
+        )
+        slab2 = jnp.where(expired, (merged | DEAD_BIT) & ~jnp.int32(1), merged)
+        changed = ((slab2 != local) | point_cell) & alive_row & active_lane
+        age0 = age_ref[...].astype(jnp.int32)
+        age2 = jnp.where(changed, 0, jnp.minimum(age0, age_stale - 1) + 1)
+        is_susp = ((slab2 & 1) != 0) & ((slab2 & DEAD_BIT) == 0) & (slab2 >= 0)
+        susp2 = jnp.where(
+            is_susp & active_lane,
+            jnp.where(rearm | ~armed, susp_ticks, left0),
+            0,
+        )
+        susp2 = jnp.where(alive_row, susp2, s_loc)
+
+        slab2_ref[...] = slab2
+        age2_ref[...] = age2.astype(jnp.int8)
+        susp2_ref[...] = susp2.astype(jnp.int16)
+
+    return kernel
+
+
+def sparse_core_pallas(
+    slab,
+    age,
+    susp,
+    slot_subj,
+    ginv,
+    rots,
+    edge_ok,
+    alive,
+    fd_slot,
+    sy_slot,
+    *,
+    spread,
+    susp_ticks,
+    age_stale,
+    interpret=None,
+):
+    """Fused sparse tick core. Returns ``(slab2, age2, susp2, self_rumor)``.
+
+    Args:
+      slab/age/susp: post-point-update working set ``[N, S]``.
+      slot_subj: ``[S]`` int32 subject of each slot (-1 free).
+      ginv, rots: structured fan-out with ``group=SPARSE_GROUP``,
+        ``[f, N/32]``.
+      edge_ok: ``[f, N]`` bool. alive: ``[N]`` bool.
+      fd_slot/sy_slot: ``[N]`` int32 — this tick's point-update slot per
+        viewer (-1 = none), for the rearm/changed correction.
+      spread/susp_ticks/age_stale: protocol constants (static; tombstone
+        sweep happens at write-back, not in the tick).
+    """
+    n, s = slab.shape
+    f = ginv.shape[0]
+    if n % SPARSE_GROUP != 0:
+        raise ValueError(f"n={n} not a multiple of {SPARSE_GROUP}")
+    if s % 128 != 0:
+        raise ValueError(f"S={s} not a multiple of 128")
+    if s >= 1 << SLOT_BITS:
+        # pack_slots stores slot+1 in a 12-bit field; a bigger slot budget
+        # would silently corrupt the packed point updates.
+        raise ValueError(f"S={s} must be < {1 << SLOT_BITS} (packed slots)")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nb = n // SPARSE_GROUP
+    b = SPARSE_GROUP
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # slab windows
+            pl.BlockSpec(memory_space=pl.ANY),  # age windows
+            pl.BlockSpec((8, s), lambda i, *_: (0, 0)),  # slot_subj lanes
+            pl.BlockSpec((b, s), lambda i, *_: (i, 0)),
+            pl.BlockSpec((b, s), lambda i, *_: (i, 0)),
+            pl.BlockSpec((b, s), lambda i, *_: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((b, s), lambda i, *_: (i, 0)),
+            pl.BlockSpec((b, s), lambda i, *_: (i, 0)),
+            pl.BlockSpec((b, s), lambda i, *_: (i, 0)),
+            pl.BlockSpec((b, 128), lambda i, *_: (i, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((2, f, b, s), jnp.int32),
+            pltpu.VMEM((2, f, b, s), jnp.int8),
+            pltpu.SemaphoreType.DMA((2, f, 2)),
+        ],
+    )
+    slab2, age2, susp2, self_pad = pl.pallas_call(
+_kernel_factory(f, nb, s, spread, susp_ticks, age_stale),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, s), jnp.int32),
+            jax.ShapeDtypeStruct((n, s), jnp.int8),
+            jax.ShapeDtypeStruct((n, s), jnp.int16),
+            jax.ShapeDtypeStruct((n, 128), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        ginv,
+        rots,
+        pack_flags(edge_ok, alive),
+        pack_slots(fd_slot, sy_slot),
+        slab,
+        age,
+        jnp.broadcast_to(slot_subj[None, :], (8, s)),
+        slab,
+        age,
+        susp,
+    )
+    return slab2, age2, susp2, self_pad[:, 0]

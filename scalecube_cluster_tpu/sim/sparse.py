@@ -112,6 +112,10 @@ class SparseParams:
     #: host-boundary route keeps exactly ONE view_T buffer live (donated
     #: in-place scatter). Semantics = writeback_period == chunk length.
     in_scan_writeback: bool = True
+    #: Run the [N, S] tick core (delivery + merge + suspicion + aging) as
+    #: one fused Pallas kernel (ops/pallas_sparse.py). Bit-identical to the
+    #: XLA chain; needs n % 32 == 0 and S % 128 == 0, else ignored.
+    pallas_core: bool = False
 
     @classmethod
     def for_n(
@@ -121,6 +125,7 @@ class SparseParams:
         alloc_cap: int = 64,
         writeback_period: int = 1,
         in_scan_writeback: bool = True,
+        pallas_core: bool = False,
         **kw,
     ):
         return cls(
@@ -129,6 +134,7 @@ class SparseParams:
             alloc_cap=alloc_cap,
             writeback_period=writeback_period,
             in_scan_writeback=in_scan_writeback,
+            pallas_core=pallas_core,
         )
 
 
@@ -238,6 +244,44 @@ def restart_sparse(state: SparseState, idx: int) -> SparseState:
         slab=state.slab.at[idx, s].set(self_key),
         age=state.age.at[idx, s].set(0),
     )
+
+
+def _free_plan(params: SparseParams, state: SparseState, gate=True):
+    """THE slot free/write-back rule, shared by the in-scan path and the
+    host-boundary :func:`writeback_free` so the two modes cannot diverge.
+
+    A slot stays pinned while any LIVE viewer still has (a) a young copy,
+    (b) an armed suspicion, or (c) a DEAD tombstone not yet past the sweep
+    deadline — (c) keeps the dense engine's second-chance-after-sweep heal
+    path: the tombstone must demote to UNKNOWN on write-back, not persist
+    in view_T forever. Dead viewers never pin (their rows are inert until
+    restart); a subject's own row keeps its tombstone (a leaver).
+
+    Returns ``(freeing [S] bool, wb_subj [S] int32 (n = dropped),
+    make_writeback)`` where ``make_writeback()`` lazily builds the
+    demotion-applied [N_view, S] slab to scatter.
+    """
+    p = params.base
+    n = p.n
+    col = jnp.arange(n, dtype=jnp.int32)
+    active = state.slot_subj >= 0
+    own_row = col[:, None] == state.slot_subj[None, :]  # viewer == subject
+    dead_rec = ((state.slab & DEAD_BIT) != 0) & (state.slab >= 0)
+    stale_done = state.age.astype(jnp.int32) > p.periods_to_sweep
+    holding = (
+        (state.age < p.periods_to_spread)
+        | (state.susp > 0)
+        | (dead_rec & ~stale_done & ~own_row)
+    )
+    pinned = jnp.any(holding & state.alive[:, None], axis=0)
+    freeing = active & ~pinned & gate
+    wb_subj = jnp.where(freeing, state.slot_subj, n)
+
+    def make_writeback():
+        demote = dead_rec & stale_done & ~own_row
+        return jnp.where(demote, UNKNOWN_KEY, state.slab)
+
+    return freeing, wb_subj, make_writeback
 
 
 @partial(jax.jit, static_argnums=0, static_argnames=("collect",))
@@ -388,35 +432,18 @@ def sparse_tick(
     # UNKNOWN on write-back, not persist in view_T forever. Dead viewers
     # never pin (their rows are inert until restart).
     if params.in_scan_writeback:
-        active = state.slot_subj >= 0
-        own_row = col[:, None] == state.slot_subj[None, :]  # viewer == subject
-        dead_rec = ((state.slab & DEAD_BIT) != 0) & (state.slab >= 0)
-        stale_done = state.age.astype(jnp.int32) > p.periods_to_sweep
-        holding = (
-            (state.age < p.periods_to_spread)
-            | (state.susp > 0)
-            | (dead_rec & ~stale_done & ~own_row)
-        )
-        pinned = jnp.any(holding & alive[:, None], axis=0)
         # Frees happen only on write-back ticks (writeback_period): the
-        # full-table scatter below is the one op that touches all of view_T,
-        # so it must not run every tick.
+        # full-table scatter is the one op that touches all of view_T, so
+        # it must not run every tick.
         do_wb = (t % params.writeback_period) == 0
-        freeing = active & ~pinned & do_wb
-        # Tombstone demotion on write-back: a DEAD record whose rumor fully
-        # aged out becomes UNKNOWN (the dense engine's tomb_expired,
-        # sim/tick.py) — except the subject's own row (a leaver keeps its
-        # own tombstone).
-        wb_subj = jnp.where(freeing, state.slot_subj, n)
+        freeing, wb_subj, make_writeback = _free_plan(params, state, gate=do_wb)
 
         def apply_writeback(view_T):
-            demote = dead_rec & stale_done & ~own_row
-            writeback = jnp.where(demote, UNKNOWN_KEY, state.slab)
             # Scatter freed slots' columns back into view_T rows
             # (subject-major: one contiguous row per freed slot).
             # Non-freeing slots route out of bounds and are dropped —
             # freed subjects are unique, so no clobbering.
-            return view_T.at[wb_subj, :].set(writeback.T, mode="drop")
+            return view_T.at[wb_subj, :].set(make_writeback().T, mode="drop")
 
         view_T = lax.cond(
             jnp.any(freeing), apply_writeback, lambda vt: vt, state.view_T
@@ -500,7 +527,15 @@ def sparse_tick(
     age = jnp.where(cell_sy | cell_fd, jnp.asarray(0, jnp.int8), age)
 
     # ------------------------------------------------- 5. gossip delivery
-    inv_perm, ginv, rots = fanout_permutations_structured(k_gsel, n, p.gossip_fanout)
+    # 32-row sender groups when n allows: the fused kernel's int8 age
+    # windows need sublane-32 alignment, and both paths must consume the
+    # SAME sampled edges so the pallas_core switch is bit-invisible.
+    from scalecube_cluster_tpu.ops.pallas_sparse import SPARSE_GROUP
+
+    group = SPARSE_GROUP if n % SPARSE_GROUP == 0 else GROUP
+    inv_perm, ginv, rots = fanout_permutations_structured(
+        k_gsel, n, p.gossip_fanout, group=group
+    )
     lks = jax.random.split(k_glink, p.gossip_fanout)
     edge_ok = jnp.stack(
         [
@@ -508,53 +543,85 @@ def sparse_tick(
             for c in range(p.gossip_fanout)
         ]
     )
-    young = age < p.periods_to_spread
-    rows = jnp.where(young & active[None, :], slab, UNKNOWN_KEY)
-    best_any = jnp.full((n, S), UNKNOWN_KEY, jnp.int32)
-    best_alive = best_any
-    for c in range(p.gossip_fanout):
-        contrib = jnp.where(edge_ok[c][:, None], rows[inv_perm[c]], UNKNOWN_KEY)
-        best_any = jnp.maximum(best_any, contrib)
-        best_alive = jnp.maximum(
-            best_alive, jnp.where(is_alive_key(contrib), contrib, UNKNOWN_KEY)
-        )
-    # Self-rumor channel (receiver == slot's subject), then exclusion.
-    own_col = col[:, None] == slot_subj[None, :]  # [N_view, S]
-    self_rumor = jnp.max(jnp.where(own_col, best_any, UNKNOWN_KEY), axis=1)
-    best_any = jnp.where(own_col, UNKNOWN_KEY, best_any)
-    best_alive = jnp.where(own_col, UNKNOWN_KEY, best_alive)
-    merged, _ = merge_views(slab, best_any, best_alive)
-    merged = jnp.where(active[None, :], merged, slab)
-    merged = jnp.where(alive[:, None], merged, slab)
+    susp_in = susp  # post-load countdowns: what dead viewers keep frozen
+    age_in = age  # post-point ages: this tick's young mask (metrics below)
 
-    # ------------------------- 6. suspicion sweep (cancel-on-update form)
-    armed = susp > 0
-    rearm = merged != slab0
-    left0 = jnp.maximum(susp.astype(jnp.int32) - 1, 0)
-    expired = (
-        alive[:, None]
-        & armed
-        & ~rearm
-        & (left0 == 0)
-        & ((merged & DEAD_BIT) == 0)
-        & ((merged & 1) != 0)
-        & (merged >= 0)
+    use_kernel = (
+        params.pallas_core
+        and group == SPARSE_GROUP
+        and S % 128 == 0
+        and S < 4096  # packed-slot field width (ops/pallas_sparse.py)
     )
-    dead_keys = (merged | DEAD_BIT) & ~jnp.int32(1)
-    slab2 = jnp.where(expired, dead_keys, merged)
-    changed = (slab2 != slab0) & alive[:, None] & active[None, :]
-    age = jnp.where(
-        changed,
-        jnp.asarray(0, jnp.int8),
-        jnp.minimum(age, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
-    )
-    is_susp = ((slab2 & 1) != 0) & ((slab2 & DEAD_BIT) == 0) & (slab2 >= 0)
-    susp = jnp.where(
-        is_susp & active[None, :],
-        jnp.where(rearm | ~armed, p.suspicion_ticks, left0),
-        0,
-    ).astype(jnp.int16)
-    susp = jnp.where(alive[:, None], susp, state.susp)
+    if use_kernel:
+        from scalecube_cluster_tpu.ops.pallas_sparse import sparse_core_pallas
+
+        slab2, age, susp, self_rumor = sparse_core_pallas(
+            slab,
+            age,
+            susp_in,
+            slot_subj,
+            ginv,
+            rots,
+            edge_ok,
+            alive,
+            fd_slot,
+            sy_slot,
+            spread=p.periods_to_spread,
+            susp_ticks=p.suspicion_ticks,
+            age_stale=AGE_STALE,
+        )
+    else:
+        young = age < p.periods_to_spread
+        rows = jnp.where(young & active[None, :], slab, UNKNOWN_KEY)
+        best_any = jnp.full((n, S), UNKNOWN_KEY, jnp.int32)
+        best_alive = best_any
+        for c in range(p.gossip_fanout):
+            contrib = jnp.where(
+                edge_ok[c][:, None], rows[inv_perm[c]], UNKNOWN_KEY
+            )
+            best_any = jnp.maximum(best_any, contrib)
+            best_alive = jnp.maximum(
+                best_alive, jnp.where(is_alive_key(contrib), contrib, UNKNOWN_KEY)
+            )
+        # Self-rumor channel (receiver == slot's subject), then exclusion.
+        own_col = col[:, None] == slot_subj[None, :]  # [N_view, S]
+        self_rumor = jnp.max(jnp.where(own_col, best_any, UNKNOWN_KEY), axis=1)
+        best_any = jnp.where(own_col, UNKNOWN_KEY, best_any)
+        best_alive = jnp.where(own_col, UNKNOWN_KEY, best_alive)
+        merged, _ = merge_views(slab, best_any, best_alive)
+        merged = jnp.where(active[None, :], merged, slab)
+        merged = jnp.where(alive[:, None], merged, slab)
+
+        # --------------------- 6. suspicion sweep (cancel-on-update form)
+        armed = susp > 0
+        rearm = merged != slab0
+        left0 = jnp.maximum(susp.astype(jnp.int32) - 1, 0)
+        expired = (
+            alive[:, None]
+            & armed
+            & ~rearm
+            & (left0 == 0)
+            & ((merged & DEAD_BIT) == 0)
+            & ((merged & 1) != 0)
+            & (merged >= 0)
+        )
+        dead_keys = (merged | DEAD_BIT) & ~jnp.int32(1)
+        slab2 = jnp.where(expired, dead_keys, merged)
+        changed = (slab2 != slab0) & alive[:, None] & active[None, :]
+        age = jnp.where(
+            changed,
+            jnp.asarray(0, jnp.int8),
+            jnp.minimum(age, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
+        )
+        is_susp = ((slab2 & 1) != 0) & ((slab2 & DEAD_BIT) == 0) & (slab2 >= 0)
+        susp = jnp.where(
+            is_susp & active[None, :],
+            jnp.where(rearm | ~armed, p.suspicion_ticks, left0),
+            0,
+        ).astype(jnp.int16)
+        # Dead viewers freeze their (post-load) countdowns — identical to
+        # the kernel's restore of its susp input.
+        susp = jnp.where(alive[:, None], susp, susp_in)
 
     # --------------------------------------------------- 7. self-refutation
     r_status = decode_status(self_rumor)
@@ -594,16 +661,21 @@ def sparse_tick(
     )
     if not collect:
         return new_state, {"tick": t}
+    # Recomputed from the outputs so both core paths share the formulas.
+    is_susp2 = ((slab2 & 1) != 0) & ((slab2 & DEAD_BIT) == 0) & (slab2 >= 0)
+    sender_active = jnp.any(
+        (age_in < p.periods_to_spread) & active[None, :] & (slab >= 0), axis=1
+    )
     metrics = {
         "tick": t,
         "n_active_slots": jnp.sum(slot_subj >= 0),
         "slot_overflow": slot_overflow,
-        "n_suspected": jnp.sum(is_susp & alive[:, None] & active[None, :]),
+        "n_suspected": jnp.sum(is_susp2 & alive[:, None] & active[None, :]),
         "msgs_fd": msgs_fd,
         "msgs_sync": msgs_sync,
         "msgs_gossip": sum(
             jnp.sum(
-                jnp.any(rows[inv_perm[c]] >= 0, axis=1)
+                sender_active[inv_perm[c]]
                 & alive[inv_perm[c]]
                 & (inv_perm[c] != col)
             )
@@ -624,6 +696,12 @@ def run_sparse_ticks(
     collect: bool = True,
 ):
     """``lax.scan`` driver, the sparse twin of sim/run.py::run_ticks.
+
+    With ``params.in_scan_writeback=False`` this runner NEVER frees slots —
+    the caller owns the free cadence (call :func:`writeback_free` between
+    runs, or use :func:`run_sparse_chunked` which does); driving long runs
+    without frees saturates the slot table and drops new rumors (visible as
+    a climbing ``slot_overflow`` metric).
 
     The input state is DONATED (its buffers are reused for the output) — at
     100k members the view_T alone is ~40 GB, so holding input + output
@@ -646,26 +724,9 @@ def writeback_free(params: SparseParams, state: SparseState) -> SparseState:
     exactly one [N, N] buffer stays live, which is what lets 32k+ members
     run on a single chip (see SparseParams.in_scan_writeback).
     """
-    p = params.base
-    n = p.n
-    col = jnp.arange(n, dtype=jnp.int32)
-    alive = state.alive
-    active = state.slot_subj >= 0
-    own_row = col[:, None] == state.slot_subj[None, :]
-    dead_rec = ((state.slab & DEAD_BIT) != 0) & (state.slab >= 0)
-    stale_done = state.age.astype(jnp.int32) > p.periods_to_sweep
-    holding = (
-        (state.age < p.periods_to_spread)
-        | (state.susp > 0)
-        | (dead_rec & ~stale_done & ~own_row)
-    )
-    pinned = jnp.any(holding & alive[:, None], axis=0)
-    freeing = active & ~pinned
-    wb_subj = jnp.where(freeing, state.slot_subj, n)
-    demote = dead_rec & stale_done & ~own_row
-    writeback = jnp.where(demote, UNKNOWN_KEY, state.slab)
+    freeing, wb_subj, make_writeback = _free_plan(params, state)
     return state.replace(
-        view_T=state.view_T.at[wb_subj, :].set(writeback.T, mode="drop"),
+        view_T=state.view_T.at[wb_subj, :].set(make_writeback().T, mode="drop"),
         slot_subj=jnp.where(freeing, -1, state.slot_subj),
         subj_slot=state.subj_slot.at[wb_subj].set(-1, mode="drop"),
     )

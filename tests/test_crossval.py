@@ -9,7 +9,21 @@ same protocol constants, which is the BASELINE.json north-star check
 
 Tolerances: both backends are stochastic (independent RNGs, real sockets on
 the host side), so trials are averaged and completion periods are compared
-within a small window rather than bit-exactly.
+within a small window rather than bit-exactly. The period-indexed mesh
+comparison asserts aligned coverage gap <= 5% and message counts within 10%.
+
+What blocks the BASELINE ±2% aspiration (tracked statement, VERDICT round-1
+item 5): (a) sampling error — at the trial counts a CPU CI run affords
+(~10 trials of n<=48 sockets), the per-period coverage std-error alone is
+2-4%; (b) the host backend's period boundaries are wall-clock
+(gossipInterval timers racing asyncio scheduling under CI load), so curves
+jitter by a fraction of a period whereas the sim's ticks are exact — a
+sub-period phase offset shows up as a few % in mid-curve coverage; (c) loss
+draws are independent between backends by design (no shared RNG). (a) and
+(b) average out with O(100) trials on quiet hardware; (c) is irreducible
+but contributes <1% at the asserted scales. The 5% gate is therefore the
+tight-but-stable envelope for CI, with the measured gap reported in the
+assertion message every run.
 """
 
 import numpy as np

@@ -83,6 +83,31 @@ def test_kill_suspect_then_dead():
     slot_invariants(st)
 
 
+def test_pallas_core_matches_xla():
+    """The fused sparse tick core (ops/pallas_sparse.py, interpreted on the
+    CPU backend) is bit-identical to the XLA chain over whole trajectories
+    with kills, loss and slot churn."""
+    n, S = 128, 128
+    base = sparse_params(n)
+    p_xla = dataclasses.replace(base, slot_budget=S)
+    p_ker = dataclasses.replace(base, slot_budget=S, pallas_core=True)
+    plan = FaultPlan.uniform(loss_percent=10.0)
+
+    outs = []
+    for p in (p_xla, p_ker):
+        st = init_sparse_full_view(n, S)
+        st = kill_sparse(st, 5)
+        st, _ = run_sparse_ticks(p, st, plan, 40)
+        outs.append(st)
+    a, b = outs
+    assert bool(jnp.all(a.slab == b.slab))
+    assert bool(jnp.all(a.age == b.age))
+    assert bool(jnp.all(a.susp == b.susp))
+    assert bool(jnp.all(a.view_T == b.view_T))
+    assert bool(jnp.all(a.slot_subj == b.slot_subj))
+    assert bool(jnp.all(a.inc_self == b.inc_self))
+
+
 def test_host_boundary_writeback_matches_protocol():
     """The big-n mode (in_scan_writeback=False + chunked host frees) follows
     the same kill→SUSPECT→DEAD protocol path, and its slots actually drain

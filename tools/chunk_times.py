@@ -28,6 +28,7 @@ seeds = seeds_mask(n, [0, 1])
 t0 = time.perf_counter()
 for rep in range(6):
     state, _ = run_ticks(params, state, plan, seeds, chunk, collect=False)
+    int(state.view[0, 0])  # large-buffer sync (see verify SKILL.md)
     tick = int(state.tick)
     t1 = time.perf_counter()
     print(

@@ -24,7 +24,9 @@ chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 48
 print("devices:", jax.devices(), file=sys.stderr)
 params = SparseParams.for_n(n, slot_budget=S, in_scan_writeback=False)
 state = jax.eval_shape(lambda: init_sparse_full_view(n, slot_budget=S))
-plan = jax.eval_shape(lambda: FaultPlan.clean(n))
+# Uniform plan: what bench/_measure_sparse and the scenarios actually run —
+# a dense plan would add 3 O(N^2) matrices and falsify the HBM verdict.
+plan = jax.eval_shape(lambda: FaultPlan.uniform())
 
 lowered = run_sparse_ticks.lower(params, state, plan, chunk, collect=False)
 try:

@@ -32,6 +32,7 @@ plan = FaultPlan.uniform(loss_percent=5.0)
 t0 = time.perf_counter()
 for rep in range(6):
     state, _ = run_sparse_chunked(params, state, plan, chunk, chunk, collect=False)
+    int(state.view_T[0, 0])  # large-buffer sync (see verify SKILL.md)
     tick = int(state.tick)
     t1 = time.perf_counter()
     ms = (t1 - t0) / chunk * 1e3
