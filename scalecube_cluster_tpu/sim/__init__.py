@@ -15,6 +15,7 @@ from scalecube_cluster_tpu.sim.checkpoint import load_checkpoint, save_checkpoin
 from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.monitor import (
     cluster_summary,
+    sparse_summary,
     node_view,
     user_gossip_slot_free,
     user_gossip_swept,
@@ -38,6 +39,7 @@ __all__ = [
     "SimParams",
     "SimState",
     "cluster_summary",
+    "sparse_summary",
     "init_full_view",
     "init_seeded",
     "inject_gossip",

@@ -77,6 +77,35 @@ def cluster_summary(state: SimState) -> dict:
     }
 
 
+def sparse_summary(state) -> dict:
+    """Whole-cluster aggregates for the compact-rumor engine
+    (sim/sparse.py::SparseState) — the working-set twin of
+    :func:`cluster_summary`, plus slot-table health (the metric the
+    reference's gossip-map size would expose via JMX)."""
+    from scalecube_cluster_tpu.ops.merge import DEAD_BIT
+
+    alive = np.asarray(jax.device_get(state.alive))
+    slot_subj = np.asarray(jax.device_get(state.slot_subj))
+    slab = np.asarray(jax.device_get(state.slab))
+    active = slot_subj >= 0
+    live_active = slab[alive][:, active]
+    suspect = ((live_active & 1) != 0) & ((live_active & DEAD_BIT) == 0) & (
+        live_active >= 0
+    )
+    dead = ((live_active & DEAD_BIT) != 0) & (live_active >= 0)
+    return {
+        "tick": int(state.tick),
+        "n": int(alive.size),
+        "n_alive_processes": int(alive.sum()),
+        "active_slots": int(active.sum()),
+        "slot_budget": int(slot_subj.size),
+        "viewed_suspect_total": int(suspect.sum()),
+        "viewed_dead_total": int(dead.sum()),
+        "max_incarnation": int(np.asarray(jax.device_get(state.inc_self)).max()),
+        "max_epoch": int(np.asarray(jax.device_get(state.epoch)).max()),
+    }
+
+
 def user_gossip_swept(state: SimState, node: int, slot: int) -> bool:
     """Host-side ``spread()`` completion signal: has ``node`` swept user-gossip
     ``slot``?

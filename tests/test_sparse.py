@@ -82,6 +82,12 @@ def test_kill_suspect_then_dead():
     )
     slot_invariants(st)
 
+    from scalecube_cluster_tpu.sim import sparse_summary
+
+    summary = sparse_summary(st)
+    assert summary["n_alive_processes"] == n - 1
+    assert summary["active_slots"] <= summary["slot_budget"]
+
 
 def test_pallas_core_matches_xla():
     """The fused sparse tick core (ops/pallas_sparse.py, interpreted on the

@@ -22,9 +22,12 @@ from scalecube_cluster_tpu.sim.sparse import (
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 49152
 S = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
 chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 48
+pallas = bool(int(sys.argv[4])) if len(sys.argv) > 4 else False
 
 print("devices:", jax.devices(), file=sys.stderr)
-params = SparseParams.for_n(n, slot_budget=S, in_scan_writeback=False)
+params = SparseParams.for_n(
+    n, slot_budget=S, in_scan_writeback=False, pallas_core=pallas
+)
 state = init_sparse_full_view(n, slot_budget=S)
 state = kill_sparse(state, 7)  # one real failure so FD/suspicion does work
 plan = FaultPlan.uniform(loss_percent=5.0)
