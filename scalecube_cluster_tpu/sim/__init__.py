@@ -11,7 +11,12 @@ ratios (FailureDetectorConfig.java:8-20, GossipConfig.java:8,
 MembershipConfig.java:13-24).
 """
 
-from scalecube_cluster_tpu.sim.checkpoint import load_checkpoint, save_checkpoint
+from scalecube_cluster_tpu.sim.checkpoint import (
+    load_checkpoint,
+    load_sparse_checkpoint,
+    save_checkpoint,
+    save_sparse_checkpoint,
+)
 from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.monitor import (
     cluster_summary,
@@ -46,6 +51,7 @@ __all__ = [
     "kill",
     "leave",
     "load_checkpoint",
+    "load_sparse_checkpoint",
     "node_view",
     "user_gossip_slot_free",
     "user_gossip_swept",
@@ -54,6 +60,7 @@ __all__ = [
     "run_ticks",
     "run_until",
     "save_checkpoint",
+    "save_sparse_checkpoint",
     "sim_tick",
     "update_metadata",
 ]

@@ -25,6 +25,7 @@ from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import SimState
 
 _FIELDS = [f.name for f in dataclasses.fields(SimState)]
+_SPARSE_MAGIC = "__sparse_params__"
 
 
 def _normalize(path: str | Path) -> Path:
@@ -52,6 +53,10 @@ def load_checkpoint(path: str | Path) -> tuple[SimState, SimParams]:
     the saved params — they are pure functions of the persistent state.
     """
     with np.load(_normalize(path)) as data:
+        if _SPARSE_MAGIC in data:
+            raise ValueError(
+                f"{path} is a sparse-engine checkpoint; use load_sparse_checkpoint"
+            )
         params = SimParams(**json.loads(bytes(data["__params__"]).decode()))
         arrays = {
             name: jax.numpy.asarray(data[name])
@@ -73,4 +78,42 @@ def load_checkpoint(path: str | Path) -> tuple[SimState, SimParams]:
                 axis=1,
             )
         state = SimState(**arrays)
+    return state, params
+
+
+def save_sparse_checkpoint(path: str | Path, state, params) -> None:
+    """Sparse-engine snapshot (sim/sparse.py::SparseState + SparseParams).
+
+    Same .npz container as :func:`save_checkpoint`; the params JSON nests
+    the base SimParams plus the working-set bounds.
+    """
+    from scalecube_cluster_tpu.sim.sparse import SparseState
+
+    path = _normalize(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    arrays = {
+        f.name: np.asarray(jax.device_get(getattr(state, f.name)))
+        for f in dataclasses.fields(SparseState)
+    }
+    arrays[_SPARSE_MAGIC] = np.frombuffer(
+        json.dumps(dataclasses.asdict(params)).encode(), dtype=np.uint8
+    )
+    np.savez(path, **arrays)
+
+
+def load_sparse_checkpoint(path: str | Path):
+    """Load a sparse-engine snapshot → ``(SparseState, SparseParams)``."""
+    from scalecube_cluster_tpu.sim.sparse import SparseParams, SparseState
+
+    with np.load(_normalize(path)) as data:
+        if _SPARSE_MAGIC not in data:
+            raise ValueError(f"{path} is not a sparse-engine checkpoint")
+        raw = json.loads(bytes(data[_SPARSE_MAGIC]).decode())
+        params = SparseParams(base=SimParams(**raw.pop("base")), **raw)
+        state = SparseState(
+            **{
+                f.name: jax.numpy.asarray(data[f.name])
+                for f in dataclasses.fields(SparseState)
+            }
+        )
     return state, params

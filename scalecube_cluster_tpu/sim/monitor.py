@@ -81,29 +81,31 @@ def sparse_summary(state) -> dict:
     """Whole-cluster aggregates for the compact-rumor engine
     (sim/sparse.py::SparseState) — the working-set twin of
     :func:`cluster_summary`, plus slot-table health (the metric the
-    reference's gossip-map size would expose via JMX)."""
-    from scalecube_cluster_tpu.ops.merge import DEAD_BIT
+    reference's gossip-map size would expose via JMX).
 
-    alive = np.asarray(jax.device_get(state.alive))
-    slot_subj = np.asarray(jax.device_get(state.slot_subj))
-    slab = np.asarray(jax.device_get(state.slab))
-    active = slot_subj >= 0
-    live_active = slab[alive][:, active]
-    suspect = ((live_active & 1) != 0) & ((live_active & DEAD_BIT) == 0) & (
-        live_active >= 0
-    )
-    dead = ((live_active & DEAD_BIT) != 0) & (live_active >= 0)
-    return {
-        "tick": int(state.tick),
-        "n": int(alive.size),
-        "n_alive_processes": int(alive.sum()),
-        "active_slots": int(active.sum()),
-        "slot_budget": int(slot_subj.size),
-        "viewed_suspect_total": int(suspect.sum()),
-        "viewed_dead_total": int(dead.sum()),
-        "max_incarnation": int(np.asarray(jax.device_get(state.inc_self)).max()),
-        "max_epoch": int(np.asarray(jax.device_get(state.epoch)).max()),
+    Reduces ON DEVICE and transfers only scalars — at the engine's target
+    scale the slab is ~1 GB, so a host copy per monitoring call would
+    dwarf the ticks being monitored.
+    """
+    import jax.numpy as jnp
+
+    status = decode_status(state.slab)
+    counting = state.alive[:, None] & (state.slot_subj >= 0)[None, :]
+    summary = {
+        "tick": state.tick,
+        "n_alive_processes": state.alive.sum(),
+        "active_slots": (state.slot_subj >= 0).sum(),
+        "viewed_suspect_total": jnp.sum(
+            counting & (status == int(MemberStatus.SUSPECT))
+        ),
+        "viewed_dead_total": jnp.sum(counting & (status == int(MemberStatus.DEAD))),
+        "max_incarnation": state.inc_self.max(),
+        "max_epoch": state.epoch.max(),
     }
+    out = {k: int(jax.device_get(v)) for k, v in summary.items()}
+    out["n"] = int(state.alive.size)
+    out["slot_budget"] = int(state.slot_subj.size)
+    return out
 
 
 def user_gossip_swept(state: SimState, node: int, slot: int) -> bool:

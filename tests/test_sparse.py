@@ -89,6 +89,33 @@ def test_kill_suspect_then_dead():
     assert summary["active_slots"] <= summary["slot_budget"]
 
 
+def test_sparse_checkpoint_roundtrip_is_exact(tmp_path):
+    """Sparse snapshots resume bit-for-bit, like the dense engine's
+    (tests/test_sim_aux.py); the slot tables ride along."""
+    from scalecube_cluster_tpu.sim.checkpoint import (
+        load_sparse_checkpoint,
+        save_sparse_checkpoint,
+    )
+
+    n = 24
+    p = sparse_params(n)
+    st = kill_sparse(init_sparse_full_view(n, p.slot_budget), 5)
+    plan = FaultPlan.uniform(loss_percent=10.0)
+    st, _ = run_sparse_ticks(p, st, plan, 20)
+
+    save_sparse_checkpoint(tmp_path / "snap", st, p)
+    loaded, p2 = load_sparse_checkpoint(tmp_path / "snap")
+    assert p2 == p
+
+    # run_sparse_ticks donates: save the continuation of the original by
+    # running the loaded copy first, then the original.
+    cont_b, _ = run_sparse_ticks(p2, loaded, plan, 15)
+    cont_a, _ = run_sparse_ticks(p, st, plan, 15)
+    assert bool(jnp.all(cont_a.slab == cont_b.slab))
+    assert bool(jnp.all(cont_a.view_T == cont_b.view_T))
+    assert bool(jnp.all(cont_a.slot_subj == cont_b.slot_subj))
+
+
 def test_pallas_core_matches_xla():
     """The fused sparse tick core (ops/pallas_sparse.py, interpreted on the
     CPU backend) is bit-identical to the XLA chain over whole trajectories

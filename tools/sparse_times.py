@@ -1,6 +1,6 @@
 """Per-chunk wall times of the sparse engine on the TPU.
 
-Usage: python tools/sparse_times.py [n] [S] [chunk]
+Usage: python tools/sparse_times.py [n] [S] [chunk] [pallas 0|1]
 """
 
 import os
