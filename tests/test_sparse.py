@@ -89,6 +89,86 @@ def test_kill_suspect_then_dead():
     assert summary["active_slots"] <= summary["slot_budget"]
 
 
+def test_dense_and_sparse_failure_timelines_match():
+    """Cross-ENGINE validation: the dense [N,N] engine and the compact-rumor
+    engine detect and remove a killed member on matching timelines (within
+    the documented deviations' tolerance — uniform vs Gumbel FD sampling
+    shifts detection by at most a few FD periods; suspicion timeout is a
+    shared constant). The cross-BACKEND twin (sim vs asyncio host) lives in
+    tests/test_crossval.py."""
+    from scalecube_cluster_tpu.sim import FaultPlan as FP
+    from scalecube_cluster_tpu.sim import init_full_view, kill, run_ticks
+    from scalecube_cluster_tpu.sim.state import seeds_mask
+    from scalecube_cluster_tpu.ops.merge import decode_status as ds
+
+    n = 24
+    p_sparse = sparse_params(n)
+    p_dense = p_sparse.base
+    plan = FaultPlan.uniform()
+    sm = seeds_mask(n, [0])
+
+    def first_tick(run_chunk, detect, max_ticks, chunk=4):
+        ticks = 0
+        while ticks < max_ticks:
+            ticks += chunk
+            if detect(run_chunk(chunk)):
+                return ticks
+        return None
+
+    # Dense engine timeline.
+    d_st = kill(init_full_view(n, user_gossip_slots=2), 5)
+    d_holder = {"st": d_st}
+
+    def d_run(chunk):
+        d_holder["st"], _ = run_ticks(p_dense, d_holder["st"], plan, sm, chunk)
+        return d_holder["st"]
+
+    def all_suspect(st):
+        col = ds(st.view)[:, 5]
+        return bool(jnp.all(jnp.where(st.alive, col != ALIVE, True)))
+
+    def all_removed(st):
+        col = ds(st.view)[:, 5]
+        return bool(
+            jnp.all(jnp.where(st.alive, (col == DEAD) | (col == UNKNOWN), True))
+        )
+
+    d_suspect = first_tick(d_run, all_suspect, 120)
+    d_removed = first_tick(d_run, all_removed, 240)
+
+    # Sparse engine timeline (same detectors via the effective view).
+    s_st = kill_sparse(init_sparse_full_view(n, p_sparse.slot_budget), 5)
+    s_holder = {"st": s_st}
+
+    def s_run(chunk):
+        s_holder["st"], _ = run_sparse_ticks(p_sparse, s_holder["st"], plan, chunk)
+        return s_holder["st"]
+
+    def s_all_suspect(st):
+        col = statuses(st)[:, 5]
+        return bool(jnp.all(jnp.where(st.alive, col != ALIVE, True)))
+
+    def s_all_removed(st):
+        col = statuses(st)[:, 5]
+        return bool(
+            jnp.all(jnp.where(st.alive, (col == DEAD) | (col == UNKNOWN), True))
+        )
+
+    s_suspect = first_tick(s_run, s_all_suspect, 120)
+    s_removed = first_tick(s_run, s_all_removed, 240)
+
+    assert d_suspect is not None and s_suspect is not None
+    assert d_removed is not None and s_removed is not None
+    # Detection: within a few FD periods + one spread window of each other.
+    tol = 2 * p_dense.fd_period_ticks + p_dense.periods_to_spread
+    assert abs(d_suspect - s_suspect) <= tol, (d_suspect, s_suspect)
+    # Removal: dominated by the shared suspicion timeout.
+    assert abs(d_removed - s_removed) <= tol + p_dense.fd_period_ticks, (
+        d_removed,
+        s_removed,
+    )
+
+
 def test_sparse_checkpoint_roundtrip_is_exact(tmp_path):
     """Sparse snapshots resume bit-for-bit, like the dense engine's
     (tests/test_sim_aux.py); the slot tables ride along."""
