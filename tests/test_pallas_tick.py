@@ -86,6 +86,39 @@ def test_sim_tick_equal_with_fused_kernel():
     assert bool(jnp.all(tr_ref["convergence"] == tr_ker["convergence"]))
 
 
+def test_sim_tick_equal_with_fused_kernel_under_churn():
+    """Parity holds through the host-op mutators (leave/restart/metadata) —
+    the operations that must keep the derived rows/known_cnt invariants the
+    fused kernel consumes (sim/state.py)."""
+    from scalecube_cluster_tpu.sim.state import leave, restart, update_metadata
+
+    n = 128
+    p = small_params(n)
+    p_pallas = dataclasses.replace(p, pallas_delivery=True)
+    plan, sm = FaultPlan.uniform(loss_percent=10.0), seeds_mask(n, [0])
+
+    def scenario(params):
+        st = init_full_view(n, user_gossip_slots=2, seed=9)
+        st, _ = run_ticks(params, st, plan, sm, 6)
+        st = kill(st, 3)
+        st = leave(st, 4)
+        st = update_metadata(st, 11)
+        st, _ = run_ticks(params, st, plan, sm, 10)
+        st = kill(st, 4)
+        st = restart(st, 3)
+        st, tr = run_ticks(params, st, plan, sm, 14)
+        return st, tr
+
+    ref, tr_ref = scenario(p)
+    out, tr_ker = scenario(p_pallas)
+    assert bool(jnp.all(ref.view == out.view))
+    assert bool(jnp.all(ref.rumor_age == out.rumor_age))
+    assert bool(jnp.all(ref.suspect_left == out.suspect_left))
+    assert bool(jnp.all(ref.rows == out.rows))
+    assert bool(jnp.all(ref.known_cnt == out.known_cnt))
+    assert bool(jnp.all(tr_ref["convergence"] == tr_ker["convergence"]))
+
+
 def test_structured_fanout_is_bijection():
     n, f = 96, 3
     inv, ginv, rots = fanout_permutations_structured(jax.random.PRNGKey(3), n, f)

@@ -1,0 +1,27 @@
+#!/bin/bash
+# One-shot measurement sequence for when the axon tunnel recovers.
+# Each step is an isolated process with a hard deadline; failures skip on.
+set -u
+cd /root/repo
+LOG=${1:-/tmp/tpu_recovery.log}
+: > "$LOG"
+
+probe() {
+  timeout 120 python -c "import jax, jax.numpy as jnp, numpy as np; x=jnp.arange(64,dtype=jnp.int32); print(int(np.asarray(x.sum())))" >>"$LOG" 2>&1
+}
+
+echo "=== waiting for tunnel ===" >>"$LOG"
+until probe; do echo "probe failed $(date)" >>"$LOG"; sleep 420; done
+echo "=== tunnel up $(date) ===" >>"$LOG"
+sleep 15
+
+echo "=== sparse pallas_core 16384 ===" >>"$LOG"
+timeout 600 python tools/sparse_times.py 16384 2048 48 1 >>"$LOG" 2>&1
+sleep 15
+echo "=== sparse xla 16384 (control) ===" >>"$LOG"
+timeout 600 python tools/sparse_times.py 16384 2048 48 0 >>"$LOG" 2>&1
+sleep 15
+echo "=== sparse pallas_core 32768 ===" >>"$LOG"
+timeout 700 python tools/sparse_times.py 32768 2048 48 1 >>"$LOG" 2>&1
+sleep 15
+echo "=== done $(date) ===" >>"$LOG"
