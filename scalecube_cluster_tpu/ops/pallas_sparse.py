@@ -12,6 +12,13 @@ the suspicion intermediates separately (~2.5× the traffic, plus gather
 latency); bit-parity with that chain is asserted over whole trajectories by
 tests/test_sparse.py::test_pallas_core_matches_xla.
 
+Protocol anchors (via sim/sparse.py, whose formulas this kernel fuses):
+young-payload selection = selectGossipsToSend
+(GossipProtocolImpl.java:242-251); merge lattice = updateMembership /
+isOverrides (MembershipProtocolImpl.java:481-546,
+MembershipRecord.java:66-84); suspicion countdown = the suspicion timeout
+task (MembershipProtocolImpl.java:620-647).
+
 Window structure: the sparse fan-out uses 32-row sender groups
 (fanout_permutations_structured(group=32)) so the int8 age windows are
 tile-aligned (int8 sublane = 32); receiver blocks are the same 32 rows.
