@@ -126,6 +126,8 @@ def sparse_state_shardings(mesh: Mesh):
         inc_self=vec,
         epoch=vec,
         alive=vec,
+        useen=slabrow,  # [N, G]: viewer rows shard, G tiny
+        uage=slabrow,
         tick=rep,
         rng=rep,
     )

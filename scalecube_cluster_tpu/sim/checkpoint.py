@@ -110,10 +110,15 @@ def load_sparse_checkpoint(path: str | Path):
             raise ValueError(f"{path} is not a sparse-engine checkpoint")
         raw = json.loads(bytes(data[_SPARSE_MAGIC]).decode())
         params = SparseParams(base=SimParams(**raw.pop("base")), **raw)
-        state = SparseState(
-            **{
-                f.name: jax.numpy.asarray(data[f.name])
-                for f in dataclasses.fields(SparseState)
-            }
-        )
+        arrays = {
+            f.name: jax.numpy.asarray(data[f.name])
+            for f in dataclasses.fields(SparseState)
+            if f.name in data
+        }
+        # Snapshots from before the user-gossip fields existed: empty slots.
+        n = arrays["view_T"].shape[0]
+        g = params.base.user_gossip_slots
+        arrays.setdefault("useen", jax.numpy.zeros((n, g), bool))
+        arrays.setdefault("uage", jax.numpy.zeros((n, g), jax.numpy.int32))
+        state = SparseState(**arrays)
     return state, params

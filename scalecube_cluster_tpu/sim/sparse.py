@@ -48,8 +48,10 @@ those in sim/tick.py — the scenario tests are the fidelity oracle:
   tick and at most ``slot_budget`` are active at once; overflow requests are
   dropped and counted in the ``slot_overflow`` metric (the reference's
   unbounded gossip map has the same practical bound — memory).
-- User-gossip slots are not modeled here (the dense engine covers them;
-  nothing about them is N²-bound).
+- User gossip (spreadGossip) runs with the dense engine's exactly-once +
+  sweep lifecycle on the shared fan-out ([N, G] arrays — not N²-bound);
+  per-rumor infected-set SUPPRESSION stays a dense-engine validation-scale
+  feature (its state is [N, N, G]).
 """
 
 from __future__ import annotations
@@ -68,6 +70,7 @@ from scalecube_cluster_tpu.ops.delivery import (
     GROUP,
     fanout_permutations_structured,
 )
+from scalecube_cluster_tpu.sim.usergossip import user_gossip_step
 from scalecube_cluster_tpu.ops.merge import (
     DEAD_BIT,
     UNKNOWN_KEY,
@@ -152,6 +155,8 @@ class SparseState:
     inc_self: jax.Array  # [N] int32
     epoch: jax.Array  # [N] int32
     alive: jax.Array  # [N] bool
+    useen: jax.Array  # [N, G] bool — user-gossip dissemination (spreadGossip)
+    uage: jax.Array  # [N, G] int32
     tick: jax.Array  # [] int32
     rng: jax.Array
 
@@ -159,7 +164,9 @@ class SparseState:
         return dataclasses.replace(self, **changes)
 
 
-def init_sparse_full_view(n: int, slot_budget: int = 2048, seed: int = 0) -> SparseState:
+def init_sparse_full_view(
+    n: int, slot_budget: int = 2048, seed: int = 0, user_gossip_slots: int = 4
+) -> SparseState:
     """Post-join steady state, nothing active: the common 100k starting point."""
     return SparseState(
         view_T=jnp.full((n, n), encode_key(0, 0), jnp.int32),
@@ -171,8 +178,20 @@ def init_sparse_full_view(n: int, slot_budget: int = 2048, seed: int = 0) -> Spa
         inc_self=jnp.zeros((n,), jnp.int32),
         epoch=jnp.zeros((n,), jnp.int32),
         alive=jnp.ones((n,), bool),
+        useen=jnp.zeros((n, user_gossip_slots), bool),
+        uage=jnp.zeros((n, user_gossip_slots), jnp.int32),
         tick=jnp.zeros((), jnp.int32),
         rng=jax.random.PRNGKey(seed),
+    )
+
+
+def inject_gossip_sparse(state: SparseState, node_idx: int, slot: int) -> SparseState:
+    """``cluster.spreadGossip`` at scale: enqueue user payload ``slot`` at
+    ``node_idx`` (GossipProtocolImpl.spread, :124-128, 163-169 — the sparse
+    twin of sim/state.py::inject_gossip)."""
+    return state.replace(
+        useen=state.useen.at[node_idx, slot].set(True),
+        uage=state.uage.at[node_idx, slot].set(0),
     )
 
 
@@ -237,6 +256,8 @@ def restart_sparse(state: SparseState, idx: int) -> SparseState:
         slab=state.slab.at[idx, :].set(state.slab[seed_viewer, :]),
         age=state.age.at[idx, :].set(AGE_STALE),
         susp=state.susp.at[idx, :].set(0),
+        # A restarted process is a fresh identity: no user-gossip dedup state.
+        useen=state.useen.at[idx, :].set(False),
     )
     state, s = _activate_on_host(state, idx)
     # Announce the new identity (ALIVE at the new epoch, young).
@@ -648,6 +669,21 @@ def sparse_tick(
         jnp.where(threat, 0, age[col, own_safe])
     )
 
+    # ------------------------------------------------- 8. user gossip
+    # spreadGossip dissemination at working-set scale: the [N, G] arrays
+    # are not N²-bound, so the engine-shared lifecycle (sim/usergossip.py)
+    # rides the same fan-out. Per-rumor infected-set suppression stays a
+    # dense-engine (validation-scale) feature.
+    new_seen, uage, msgs_user = user_gossip_step(
+        state.useen,
+        state.uage,
+        inv_perm,
+        edge_ok,
+        alive,
+        p.periods_to_spread,
+        p.periods_to_sweep,
+    )
+
     new_state = state.replace(
         view_T=view_T,
         slot_subj=slot_subj,
@@ -656,6 +692,8 @@ def sparse_tick(
         age=age,
         susp=susp,
         inc_self=inc_self,
+        useen=new_seen,
+        uage=uage,
         tick=t,
         rng=rng_next,
     )
@@ -681,6 +719,9 @@ def sparse_tick(
             )
             for c in range(p.gossip_fanout)
         ),
+        "msgs_user": msgs_user,
+        "gossip_coverage": jnp.sum(new_seen & alive[:, None], axis=0)
+        / jnp.maximum(jnp.sum(alive), 1),
     }
     return new_state, metrics
 

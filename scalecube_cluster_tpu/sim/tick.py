@@ -111,11 +111,11 @@ from scalecube_cluster_tpu.ops.select import masked_random_choice, masked_random
 from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass, round_trip_in_time
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import AGE_STALE, SimState
+from scalecube_cluster_tpu.sim.usergossip import AGE_CAP as _AGE_CAP, user_gossip_step
 
 _ALIVE = int(MemberStatus.ALIVE)
 _SUSPECT = int(MemberStatus.SUSPECT)
 _DEAD = int(MemberStatus.DEAD)
-_AGE_CAP = 1 << 20
 
 
 def _fd_vectors(params, state, plan, keys, cand, view0):
@@ -471,9 +471,9 @@ def sim_tick(
     )
 
     # ----------------------------------------------------- 6. user gossip
-    urows = state.useen & (state.uage < params.periods_to_spread)
     nonself = inv_perm != col[None, :]  # [f, N]: sender != receiver
     if params.track_user_infected:
+        urows = state.useen & (state.uage < params.periods_to_spread)
         # Per-rumor suppression (GossipState.infected, GossipState.java:17-38;
         # selectGossipsToSend, GossipProtocolImpl.java:242-251): sender s
         # skips slot g for peer j once j previously pushed g to s.
@@ -499,33 +499,31 @@ def sim_tick(
             # that arrived (onGossipReq, GossipProtocolImpl.java:171-183).
             uinf_new = uinf_new | (onehots[c][:, :, None] & arrived[:, None, :])
         msgs_user = sum(jnp.sum(s, axis=0) for s in sent_cols)  # [G] sends
-    else:
-        got = permuted_delivery(urows.astype(jnp.int32), inv_perm, edge_ok) > 0
-        uinf_new = state.uinf
-        # Without suppression tracking, a send happens on every live non-self
-        # edge whose sender holds a young copy of the slot.
-        msgs_user = sum(
-            jnp.sum(
-                urows[inv_perm[c]] & (alive[inv_perm[c]] & nonself[c])[:, None],
-                axis=0,
-            )
-            for c in range(params.gossip_fanout)
-        )
-    new_seen = state.useen | (got & alive[:, None])
-    first_seen = new_seen & ~state.useen
-    uage = jnp.where(first_seen, 0, jnp.minimum(state.uage + 1, _AGE_CAP))
-    # Sweep/recycle (sweepGossips, GossipProtocolImpl.java:281-304): a slot
-    # older than periods_to_sweep leaves the local gossip map, freeing it for
-    # reuse by a later spread. Safe against re-infection for the same reason
-    # the reference's dedup-map removal is: by the earliest sweep, every
-    # copy's age exceeds sweep - spread > spread, so nobody spreads it
-    # anymore. A host-side spread() future resolves via
-    # sim/monitor.py::user_gossip_swept.
-    swept = new_seen & (uage > params.periods_to_sweep)
-    new_seen = new_seen & ~swept
-    if params.track_user_infected:
+        new_seen = state.useen | (got & alive[:, None])
+        first_seen = new_seen & ~state.useen
+        uage = jnp.where(first_seen, 0, jnp.minimum(state.uage + 1, _AGE_CAP))
+        # Sweep/recycle (sweepGossips, GossipProtocolImpl.java:281-304): a
+        # slot older than periods_to_sweep leaves the local gossip map,
+        # freeing it for reuse by a later spread (safety argument in
+        # sim/usergossip.py). A host-side spread() future resolves via
+        # sim/monitor.py::user_gossip_swept.
+        swept = new_seen & (uage > params.periods_to_sweep)
+        new_seen = new_seen & ~swept
         # Sweeping drops the whole GossipState, infected set included.
         uinf_new = uinf_new & ~swept[:, None, :]
+    else:
+        # Untracked lifecycle: the engine-shared helper (also used by the
+        # compact-rumor engine, sim/sparse.py step 8).
+        new_seen, uage, msgs_user = user_gossip_step(
+            state.useen,
+            state.uage,
+            inv_perm,
+            edge_ok,
+            alive,
+            params.periods_to_spread,
+            params.periods_to_sweep,
+        )
+        uinf_new = state.uinf
 
     # ------------------------------------------------------------- metrics
     new_state = state.replace(

@@ -1,0 +1,48 @@
+"""User-gossip (spreadGossip) lifecycle shared by both sim engines.
+
+One gossip period of the untracked dissemination path: young copies fan out
+along the tick's permutation edges, receivers dedup (exactly-once first-seen
+accounting, onGossipReq GossipProtocolImpl.java:171-183), and slots sweep /
+recycle after ``periods_to_sweep`` (sweepGossips, :281-304). The dense
+engine's per-rumor infected-set SUPPRESSION variant ([N, N, G] state,
+GossipState.java:17-38) stays in sim/tick.py — it is validation-scale only.
+
+Sweep is safe against re-infection for the same reason the reference's
+dedup-map removal is: by the earliest sweep, every copy's age exceeds
+``sweep - spread > spread``, so nobody spreads it anymore.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu.ops.delivery import permuted_delivery
+
+#: Saturation for the [N, G] user-gossip ages (int32; far past any sweep).
+AGE_CAP = 1 << 20
+
+
+def user_gossip_step(useen, uage, inv_perm, edge_ok, alive, spread, sweep):
+    """Advance the [N, G] user-gossip state one period.
+
+    Returns ``(new_seen, new_age, msgs_user [G])`` — message counting is
+    sender-side (selectGossipsToSend non-empty ⇒ one message per edge;
+    loss doesn't unsend), comparable to ClusterMath.maxMessagesPerGossip.
+    """
+    n = useen.shape[0]
+    col = jnp.arange(n, dtype=jnp.int32)
+    nonself = inv_perm != col[None, :]  # [f, N]: sender != receiver
+    urows = useen & (uage < spread)
+    got = permuted_delivery(urows.astype(jnp.int32), inv_perm, edge_ok) > 0
+    msgs_user = sum(
+        jnp.sum(
+            urows[inv_perm[c]] & (alive[inv_perm[c]] & nonself[c])[:, None],
+            axis=0,
+        )
+        for c in range(inv_perm.shape[0])
+    )
+    new_seen = useen | (got & alive[:, None])
+    first_seen = new_seen & ~useen
+    new_age = jnp.where(first_seen, 0, jnp.minimum(uage + 1, AGE_CAP))
+    swept = new_seen & (new_age > sweep)
+    return new_seen & ~swept, new_age, msgs_user

@@ -16,6 +16,7 @@ from scalecube_cluster_tpu.sim.sparse import (
     SparseParams,
     effective_view,
     init_sparse_full_view,
+    inject_gossip_sparse,
     kill_sparse,
     leave_sparse,
     restart_sparse,
@@ -87,6 +88,23 @@ def test_kill_suspect_then_dead():
     summary = sparse_summary(st)
     assert summary["n_alive_processes"] == n - 1
     assert summary["active_slots"] <= summary["slot_budget"]
+
+
+def test_sparse_user_gossip_disseminates_and_sweeps():
+    """spreadGossip on the sparse engine: full coverage within the spread
+    window, then the slot sweeps everywhere (the dense engine's lifecycle,
+    sim/tick.py step 6, on the scale path)."""
+    n = 32
+    p = sparse_params(n)
+    st = inject_gossip_sparse(init_sparse_full_view(n, p.slot_budget), 2, 0)
+    plan = FaultPlan.uniform()
+
+    st, tr = run_sparse_ticks(p, st, plan, p.base.periods_to_spread + 4)
+    cov = float(tr["gossip_coverage"][-1][0])
+    assert cov == 1.0, cov
+
+    st, tr = run_sparse_ticks(p, st, plan, p.base.periods_to_sweep + 4)
+    assert not bool(jnp.any(st.useen[:, 0])), "slot should sweep everywhere"
 
 
 def test_dense_and_sparse_failure_timelines_match():
