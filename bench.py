@@ -59,7 +59,9 @@ LADDER = (
     ("dense-xla", 1024),
 )
 PROBE_DEADLINE_S = 120
-PROBE_RETRIES = 3
+#: 5 × 120 s of probing before giving up: the tunnel has been observed to
+#: recover minutes after a long wedge, and the total still fits the budget.
+PROBE_RETRIES = 5
 CHILD_DEADLINE_S = 420
 #: Hard budget on total wall time before the JSON line must be out — stops
 #: starting new children once exceeded, so a wedged backend can't push the

@@ -238,6 +238,28 @@ def leave_sparse(state: SparseState, idx: int) -> SparseState:
     )
 
 
+def update_metadata_sparse(state: SparseState, idx: int) -> SparseState:
+    """Announce a metadata change at node ``idx`` — incarnation bump + fresh
+    young own-record, exactly the dense twin (sim/state.py::update_metadata;
+    updateIncarnation, ClusterImpl.java:365-369). A voluntary leaver keeps
+    its tombstone."""
+    state, s = _activate_on_host(state, idx)
+    left = (state.slab[idx, s] & DEAD_BIT) != 0
+    inc = jnp.where(left, state.inc_self[idx], state.inc_self[idx] + 1)
+    key = jnp.where(
+        left,
+        state.slab[idx, s],
+        encode_key(jnp.zeros_like(inc), inc, state.epoch[idx]),
+    )
+    return state.replace(
+        inc_self=state.inc_self.at[idx].set(inc),
+        slab=state.slab.at[idx, s].set(key),
+        age=state.age.at[idx, s].set(
+            jnp.where(left, state.age[idx, s], 0)
+        ),
+    )
+
+
 def restart_sparse(state: SparseState, idx: int) -> SparseState:
     """Restart slot ``idx`` as a new identity (epoch bump), rejoining with a
     seed-loaded table (the initial-sync outcome as a host op — dense twin:

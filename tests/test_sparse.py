@@ -90,6 +90,22 @@ def test_kill_suspect_then_dead():
     assert summary["active_slots"] <= summary["slot_budget"]
 
 
+def test_sparse_metadata_version_propagates():
+    """update_metadata_sparse bumps the incarnation and the new version
+    reaches every live viewer (the metadata-version propagation contract,
+    SURVEY.md §7 hard part 5 — dense twin in tests/test_sim_aux.py)."""
+    from scalecube_cluster_tpu.ops.merge import decode_incarnation
+    from scalecube_cluster_tpu.sim.sparse import update_metadata_sparse
+
+    n = 32
+    p = sparse_params(n)
+    st = update_metadata_sparse(init_sparse_full_view(n, p.slot_budget), 6)
+    assert int(st.inc_self[6]) == 1
+    st, _ = run_sparse_ticks(p, st, FaultPlan.uniform(), p.base.periods_to_spread + 6)
+    col6 = decode_incarnation(effective_view(st))[:, 6]
+    assert bool(jnp.all(col6 == 1)), col6
+
+
 def test_sparse_user_gossip_disseminates_and_sweeps():
     """spreadGossip on the sparse engine: full coverage within the spread
     window, then the slot sweeps everywhere (the dense engine's lifecycle,
