@@ -8,9 +8,17 @@ results can be committed verbatim (VERDICT round-1 item 10).
 import json
 import sys
 
+args = [a for a in sys.argv[1:]]
+if "--cpu" in args:
+    # Must run before any other jax op; env vars alone don't stick on boxes
+    # with an installed TPU plugin (tests/conftest.py).
+    args.remove("--cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 from scalecube_cluster_tpu.experiments.scenarios import run_all
 
-args = [a for a in sys.argv[1:]]
 out = None
 if "--out" in args:
     i = args.index("--out")
