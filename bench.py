@@ -19,8 +19,10 @@ Two engines climb the ladder largest-first:
 Hardened per VERDICT.md round-1 item 1: this script ALWAYS prints exactly
 one JSON line on stdout, no matter what the TPU tunnel does.
 
-- A tiny probe op with a hard deadline runs first, retried with backoff; if
-  the backend never comes up, the JSON line carries an ``"error"`` field.
+- A tiny probe op with a hard deadline runs first, retried until the total
+  budget is spent; if the backend never comes up, the JSON line carries an
+  ``"error"`` field plus the last committed self-measured number and commit
+  hash (``PERF_SELF.json``), so an outage round still reports evidence.
 - Each measured config runs in a subprocess with its own deadline, so a
   mid-dispatch hang (the round-1 failure mode: BENCH_r01.json rc=1, later
   re-runs hanging >4 min) is converted into a fallback down the ladder.
@@ -59,9 +61,6 @@ LADDER = (
     ("dense-xla", 1024),
 )
 PROBE_DEADLINE_S = 120
-#: 5 × 120 s of probing before giving up: the tunnel has been observed to
-#: recover minutes after a long wedge, and the total still fits the budget.
-PROBE_RETRIES = 5
 CHILD_DEADLINE_S = 420
 #: Hard budget on total wall time before the JSON line must be out — stops
 #: starting new children once exceeded, so a wedged backend can't push the
@@ -143,8 +142,8 @@ def _measure(engine: str, n_members: int) -> dict:
     }
 
 
-def _probe() -> str | None:
-    """Fail-fast backend check: tiny op in a subprocess under a deadline.
+def _probe_once() -> str | None:
+    """One backend check: tiny op in a subprocess under a deadline.
 
     Returns None when the backend is usable, else the failure description.
     """
@@ -153,23 +152,48 @@ def _probe() -> str | None:
         "x = jnp.arange(64, dtype=jnp.int32);"
         "print(int(np.asarray(x.sum())))"
     )
-    err = "probe never ran"
-    for attempt in range(PROBE_RETRIES):
-        try:
-            res = subprocess.run(
-                [sys.executable, "-c", code],
-                capture_output=True,
-                text=True,
-                timeout=PROBE_DEADLINE_S,
-            )
-            if res.returncode == 0 and res.stdout.strip().endswith("2016"):
-                return None
-            err = f"probe rc={res.returncode}: {res.stderr.strip()[-300:]}"
-        except subprocess.TimeoutExpired:
-            err = f"probe timed out after {PROBE_DEADLINE_S}s"
-        if attempt + 1 < PROBE_RETRIES:
-            time.sleep(2**attempt)
-    return err
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True,
+            text=True,
+            timeout=PROBE_DEADLINE_S,
+        )
+        if res.returncode == 0 and res.stdout.strip().endswith("2016"):
+            return None
+        return f"probe rc={res.returncode}: {res.stderr.strip()[-300:]}"
+    except subprocess.TimeoutExpired:
+        return f"probe timed out after {PROBE_DEADLINE_S}s"
+
+
+def _self_evidence() -> dict:
+    """Last self-measured result + provenance, for outage-round error JSON.
+
+    Round-2 verdict: an outage round reported value 0.0 with no way to tell
+    "measured then tunnel died" from "never measured". PERF_SELF.json is the
+    committed raw artifact of the most recent self-run; surface it (plus the
+    commit hash) whenever the driver's own run can't measure.
+    """
+    out = {}
+    try:
+        res = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True,
+            text=True,
+            timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        if res.returncode == 0:
+            out["commit"] = res.stdout.strip()
+    except Exception:
+        pass
+    try:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "PERF_SELF.json")
+        with open(path) as fh:
+            out["last_self_measured"] = json.load(fh)
+    except Exception:
+        pass
+    return out
 
 
 def _run_child(engine: str, n: int) -> tuple[dict | None, str]:
@@ -202,29 +226,56 @@ def _run_child(engine: str, n: int) -> tuple[dict | None, str]:
 
 
 def main() -> None:
+    """Probe-then-measure, persisting until TOTAL_BUDGET_S is spent.
+
+    Round-2 verdict weak#1: the old probe gave up after ~615 s with ~585 s
+    of budget unspent, and the tunnel has been observed to recover minutes
+    after a long wedge. Now probing and ladder descent interleave until the
+    budget line: every probe success starts a ladder pass; every failure
+    backs off briefly and re-probes, as long as enough budget remains for a
+    probe (plus, ideally, a child).
+    """
     t_start = time.monotonic()
+
+    def budget_left() -> float:
+        return TOTAL_BUDGET_S - (time.monotonic() - t_start)
+
     result = None
-    err = _probe()
+    err = "never probed"
     last_fail = ""
-    if err is None:
+    probes = 0
+    while result is None and budget_left() > PROBE_DEADLINE_S + 5:
+        err = _probe_once()
+        probes += 1
+        if err is not None:
+            time.sleep(min(15, max(1, budget_left() - PROBE_DEADLINE_S)))
+            continue
+        children = 0
         for engine, n in LADDER:
-            if time.monotonic() - t_start > TOTAL_BUDGET_S:
-                last_fail = f"budget {TOTAL_BUDGET_S}s exhausted; " + last_fail
+            if budget_left() < 30:
                 break
+            children += 1
             result, fail = _run_child(engine, n)
             if result is not None:
                 break
             last_fail = fail
         if result is None:
-            err = f"all benchmark configs failed ({last_fail})"
+            if children == 0:
+                err = "probe ok but budget exhausted before any config ran"
+            else:
+                err = f"all {children} attempted configs failed ({last_fail})"
+            break
     if result is None:
         result = {
             "metric": "member_gossip_rounds_per_sec",
             "value": 0.0,
             "unit": "member·rounds/s",
             "vs_baseline": 0.0,
-            "error": err,
+            "error": f"{err} (probe attempts: {probes})",
+            **_self_evidence(),
         }
+    else:
+        result.update(_self_evidence())
     print(json.dumps(result), flush=True)
 
 

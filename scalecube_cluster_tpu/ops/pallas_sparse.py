@@ -49,6 +49,11 @@ SLOT_MASK = (1 << SLOT_BITS) - 1
 def pack_flags(edge_ok, alive):
     """``[f, N]`` bool edge-ok + ``[N]`` bool alive → packed ``[N]`` int32."""
     f = edge_ok.shape[0]
+    if f > ALIVE_BIT:
+        # Edge channel c rides bit c (c < f); a fanout above ALIVE_BIT would
+        # silently alias an edge-ok bit onto the alive bit and corrupt
+        # freeze semantics (round-2 advisor finding).
+        raise ValueError(f"gossip fanout {f} > ALIVE_BIT ({ALIVE_BIT})")
     word = alive.astype(jnp.int32) << ALIVE_BIT
     for c in range(f):
         word = word | (edge_ok[c].astype(jnp.int32) << c)

@@ -41,7 +41,8 @@ young-payload and candidate-count maintenance) runs as ONE of two
   * **fast path** (common case: no SYNC due, nobody joining): the whole
     [N, N] core is a single fused Pallas kernel
     (ops/pallas_tick.py::tick_core_pallas) when ``params.pallas_delivery``
-    and n % 32 == 0, else the equivalent XLA chain. HBM traffic ~30 B/cell.
+    and n % 128 == 0 (32-row blocks AND a 128-multiple lane split — the
+    ``use_fused`` gate below), else the equivalent XLA chain. HBM traffic ~30 B/cell.
   * **slow path** (SYNC tick or a joining node): the unfused XLA chain with
     the full-table SYNC exchange folded between merge and suspicion sweep.
 

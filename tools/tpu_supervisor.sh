@@ -1,0 +1,81 @@
+#!/bin/bash
+# Round-3 TPU recovery supervisor (VERDICT.md round-2 item 1).
+#
+# Runs for the whole round: probes the tunneled TPU backend forever; the
+# first time it answers, runs the full on-chip measurement sequence and
+# writes raw artifacts into /root/repo (they are committed by the session).
+# Steps are isolated processes with hard deadlines so a mid-sequence wedge
+# cannot kill the supervisor; after a completed sequence it keeps probing
+# and re-runs every 2h in case later rungs can improve.
+set -u
+cd /root/repo
+LOG=${1:-/root/repo/tools/tpu_supervisor.log}
+echo "=== supervisor start $(date -u +%FT%TZ) ===" >>"$LOG"
+
+probe() {
+  timeout 120 python -c "import jax, jax.numpy as jnp, numpy as np; x=jnp.arange(64,dtype=jnp.int32); print('PROBE_OK', int(np.asarray(x.sum())))" >>"$LOG" 2>&1
+}
+
+run_sequence() {
+  local stamp
+  stamp=$(date -u +%FT%TZ)
+  echo "=== tunnel up $stamp — sequence begins ===" >>"$LOG"
+  sleep 10
+
+  echo "--- [1/5] pallas_sparse on-chip parity ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  timeout 600 python tools/tpu_kernel_check.py >>"$LOG" 2>&1
+  sleep 10
+
+  echo "--- [2/5] sparse ladder timings ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  timeout 600 python tools/sparse_times.py 16384 2048 48 1 >>"$LOG" 2>&1
+  sleep 10
+  timeout 700 python tools/sparse_times.py 32768 2048 48 1 >>"$LOG" 2>&1
+  sleep 10
+
+  echo "--- [3/5] big-n compile probe ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  timeout 900 python tools/sparse_times.py 49152 3072 48 1 >>"$LOG" 2>&1
+  sleep 10
+
+  echo "--- [4/5] bench.py (driver-identical invocation) ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  # bench.py worst case: probes until ~budget_left>125s, then one child up
+  # to 420 s -> ~1590 s; 1700 keeps the guaranteed JSON line alive.
+  timeout 1700 python bench.py >/root/repo/BENCH_SELF_r3.json 2>>"$LOG"
+  echo "BENCH_SELF_r3.json: $(cat /root/repo/BENCH_SELF_r3.json 2>/dev/null)" >>"$LOG"
+  python - <<'PYEOF' >>"$LOG" 2>&1
+import json, datetime
+try:
+    r = json.load(open("/root/repo/BENCH_SELF_r3.json"))
+    if r.get("value", 0) > 0:
+        r["provenance"] = (
+            "self-measured round 3 by tools/tpu_supervisor.sh (driver-identical "
+            "bench.py invocation) at " + datetime.datetime.utcnow().isoformat() + "Z"
+        )
+        r["measured_round"] = 3
+        json.dump(r, open("/root/repo/PERF_SELF.json", "w"), indent=2)
+        print("PERF_SELF.json refreshed from round-3 run")
+except Exception as e:
+    print("PERF_SELF refresh skipped:", e)
+PYEOF
+  sleep 10
+
+  echo "--- [5/5] dense control ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  timeout 600 python tools/chunk_times.py 2>&1 | tail -30 >>"$LOG"
+  echo "=== sequence done $(date -u +%FT%TZ) ===" >>"$LOG"
+  cp "$LOG" /root/repo/TPU_RUN_r3.log 2>/dev/null
+  touch /root/repo/tools/.sequence_done
+}
+
+LAST_SEQ=0
+while true; do
+  if probe; then
+    NOW=$(date +%s)
+    if [ $((NOW - LAST_SEQ)) -gt 7200 ]; then
+      run_sequence
+      LAST_SEQ=$(date +%s)
+    fi
+    sleep 600
+  else
+    echo "probe failed $(date -u +%FT%TZ)" >>"$LOG"
+    sleep 240
+  fi
+done
