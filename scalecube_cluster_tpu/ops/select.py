@@ -15,10 +15,14 @@ set". The TPU form: every node draws i.i.d. Gumbel noise over all N slots,
 masks invalid candidates to -inf, and takes top-k — an exact uniform sample
 of k distinct valid candidates, batched over all nodes in one ``top_k``.
 
-Deviation noted for the judge: the reference's shuffled *round-robin* probe
-order guarantees each member is pinged once per n periods; i.i.d. sampling
-gives the same expected probe rate with geometric gaps. Convergence bounds in
-ClusterMath assume the random model, so validation curves are unaffected.
+The PING target is the exception (round-3): the reference's shuffled
+*round-robin* probe list guarantees each member is pinged within n periods
+(selectPingMember, FailureDetectorImpl.java:340-349) — a real SWIM
+time-bounded-completeness property that i.i.d. sampling loses to
+coupon-collector gaps. ``probe_cursor_targets`` restores it statelessly:
+an affine permutation of [0, n) per node, re-parameterized every wrap.
+Relay/gossip/sync selection stays i.i.d. (the reference randomizes those
+too; no completeness bound is attached to them).
 """
 
 from __future__ import annotations
@@ -54,3 +58,53 @@ def masked_random_choice(rng, mask):
     """
     idx, valid = masked_random_topk(rng, mask, 1)
     return idx[..., 0], valid[..., 0]
+
+
+#: Root key for the probe cursor's per-wrap permutation parameters. Fixed
+#: (not threaded from the sim rng) so the schedule is a pure function of
+#: (n, fd_round): checkpoint/resume and sharded re-slicing need no state.
+_PROBE_CURSOR_KEY = jax.random.PRNGKey(0x5CA1EC)
+#: Stride bound keeping ``a * c`` < 2^31 for n < 2^20 (uint32 arithmetic).
+_MAX_STRIDE = 2048
+
+
+def probe_cursor_targets(fd_round, n):
+    """Shuffled round-robin PING target of every node for this FD round.
+
+    The TPU-native form of the reference's shuffled probe list
+    (selectPingMember, FailureDetectorImpl.java:340-349 — shuffled
+    round-robin with a reshuffle each wrap): node i's target in round r is
+
+        ``tgt_i(r) = (a_i(w) * (r mod n) + b_i(w)) mod n``,  ``w = r // n``
+
+    an affine permutation of [0, n) — within each wrap of n rounds every
+    node enumerates ALL n indices exactly once, so every live member is
+    probed within n FD periods (the SWIM time-bounded-completeness bound).
+    ``a_i`` (odd-coprime stride < 2048) and ``b_i`` (offset) are re-drawn
+    from a per-wrap fold of a fixed key: the reshuffle.
+
+    Rows whose target is self / unknown / DEAD fall back to an i.i.d. draw
+    at the call site (the reference's list simply omits those members; one
+    skipped slot per wrap does not break the n-period bound).
+
+    Args:
+      fd_round: traced int32 scalar — index of this FD round (t // period).
+      n: static member count (< 2^20 so the uint32 product cannot wrap).
+
+    Returns:
+      ``[n]`` int32 targets in [0, n).
+    """
+    if n >= 1 << 20:
+        raise ValueError(f"probe cursor supports n < 2^20, got {n}")
+    w = fd_round // n
+    c = jnp.mod(fd_round, n).astype(jnp.uint32)
+    kw = jax.random.fold_in(_PROBE_CURSOR_KEY, w)
+    ka, kb = jax.random.split(kw)
+    hi = min(_MAX_STRIDE, n) if n > 1 else 2
+    cands = jax.random.randint(ka, (8, n), 1, hi, jnp.int32)
+    ok = jnp.gcd(cands, n) == 1
+    first = jnp.argmax(ok, axis=0)
+    a = jnp.take_along_axis(cands, first[None, :], axis=0)[0]
+    a = jnp.where(jnp.any(ok, axis=0), a, 1).astype(jnp.uint32)
+    b = jax.random.randint(kb, (n,), 0, n, jnp.int32).astype(jnp.uint32)
+    return ((a * c + b) % jnp.uint32(n)).astype(jnp.int32)

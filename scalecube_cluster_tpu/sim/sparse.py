@@ -34,10 +34,13 @@ generate activation requests.
 Documented deviations from the dense engine (and the reference), beyond
 those in sim/tick.py — the scenario tests are the fidelity oracle:
 
-- FD probe targets/relays are uniform random members, validity-checked
-  against the viewer's table, instead of Gumbel-top-k over the full
-  candidate matrix (O(N) vs O(N²) selection; same expected probe rate —
-  an invalid pick skips that node's round, rare in steady state).
+- FD probe targets follow the shuffled round-robin cursor
+  (ops/select.py::probe_cursor_targets — the reference's selectPingMember
+  completeness bound holds: every member probed within n FD periods), with
+  a uniform-random fallback when the cursor slot is not probeable; relays
+  are uniform random members, validity-checked against the viewer's table,
+  instead of Gumbel-top-k over the full candidate matrix (O(N) vs O(N²)
+  selection; same expected relay rate).
 - SYNC exchanges only the partners' OWN records (O(1) payload), not full
   tables (O(N) — the reference ships the entire table per SYNC,
   SyncData.java:11-41, which is itself impractical at 100k members). Healing
@@ -82,6 +85,7 @@ from scalecube_cluster_tpu.ops.merge import (
     merge_views,
     overrides_same_epoch,
 )
+from scalecube_cluster_tpu.ops.select import probe_cursor_targets
 from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass, round_trip_in_time
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import AGE_STALE
@@ -357,10 +361,16 @@ def sparse_tick(
         return jnp.where(s >= 0, from_slab, state.view_T[subject, viewer])
 
     # ------------------------------------------------------------------ 1. FD
-    # Uniform target sampling ([N] work) instead of Gumbel-top-k over [N, N]
-    # (module docstring deviation 1).
+    # Shuffled round-robin cursor (ops/select.py::probe_cursor_targets —
+    # selectPingMember, FailureDetectorImpl.java:340-349) with an i.i.d.
+    # fallback for rows whose cursor slot is not probeable this round; all
+    # [N]-sized work (module docstring FD deviation).
     def fd_fire_phase(_):
-        tgt = jax.random.randint(k_tgt, (n,), 0, n, jnp.int32)
+        rr_tgt = probe_cursor_targets(t // p.fd_period_ticks, n)
+        rr_key = my_record_of(col, rr_tgt)
+        rr_valid = (rr_tgt != col) & (rr_key >= 0) & ((rr_key & DEAD_BIT) == 0)
+        rand_tgt = jax.random.randint(k_tgt, (n,), 0, n, jnp.int32)
+        tgt = jnp.where(rr_valid, rr_tgt, rand_tgt)
         vkey = my_record_of(col, tgt)
         valid = (tgt != col) & (vkey >= 0) & ((vkey & DEAD_BIT) == 0)
         probing = alive & valid

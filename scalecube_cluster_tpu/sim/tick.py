@@ -108,7 +108,11 @@ from scalecube_cluster_tpu.ops.merge import (
     merge_views,
     overrides_same_epoch,
 )
-from scalecube_cluster_tpu.ops.select import masked_random_choice, masked_random_topk
+from scalecube_cluster_tpu.ops.select import (
+    masked_random_choice,
+    masked_random_topk,
+    probe_cursor_targets,
+)
 from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass, round_trip_in_time
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import AGE_STALE, SimState
@@ -119,7 +123,7 @@ _SUSPECT = int(MemberStatus.SUSPECT)
 _DEAD = int(MemberStatus.DEAD)
 
 
-def _fd_vectors(params, state, plan, keys, cand, view0):
+def _fd_vectors(params, state, plan, keys, cand, view0, fd_round):
     """One FD round as per-row vectors: ``(tgt, fd_key, fire, msgs)``.
 
     The whole doPing/doPingReq flow (FailureDetectorImpl.java:126-209) runs
@@ -127,6 +131,12 @@ def _fd_vectors(params, state, plan, keys, cand, view0):
     and whether a SUSPECT/DEAD record fires. The [N, N] application of the
     verdict is left to the caller (one fused `where` — or the Pallas tick
     kernel).
+
+    Target selection is the shuffled round-robin cursor
+    (ops/select.py::probe_cursor_targets — selectPingMember,
+    FailureDetectorImpl.java:340-349); rows whose cursor slot is not a
+    probe candidate this round (self / unknown / DEAD) fall back to an
+    i.i.d. draw so probe work never idles.
     """
     n = params.n
     k_tgt, k_ping, k_relay = keys
@@ -134,7 +144,11 @@ def _fd_vectors(params, state, plan, keys, cand, view0):
     i_idx = col
     alive = state.alive
 
-    tgt, tgt_valid = masked_random_choice(k_tgt, cand)
+    rr_tgt = probe_cursor_targets(fd_round, n)
+    rr_valid = jnp.take_along_axis(cand, rr_tgt[:, None], axis=1)[:, 0]
+    rand_tgt, rand_valid = masked_random_choice(k_tgt, cand)
+    tgt = jnp.where(rr_valid, rr_tgt, rand_tgt)
+    tgt_valid = rr_valid | rand_valid
     vkey = jnp.take_along_axis(view0, tgt[:, None], axis=1)[:, 0]
     v_inc = decode_incarnation(vkey)
     v_epoch = decode_epoch(vkey)
@@ -244,7 +258,13 @@ def sim_tick(
         status0 = decode_status(view0)
         cand = (view0 >= 0) & (status0 != _DEAD) & ~diag
         return _fd_vectors(
-            params, state, plan, (k_tgt, k_ping, k_relay), cand, view0
+            params,
+            state,
+            plan,
+            (k_tgt, k_ping, k_relay),
+            cand,
+            view0,
+            t // params.fd_period_ticks,
         )
 
     def fd_skip_phase(_):
