@@ -11,7 +11,9 @@ from scalecube_cluster_tpu.parallel.mesh import (
     make_mesh,
     make_mesh2d,
     shard_plan,
+    shard_sparse_state,
     shard_state,
+    sparse_state_shardings,
     state_shardings,
 )
 
@@ -19,6 +21,8 @@ __all__ = [
     "make_mesh",
     "make_mesh2d",
     "shard_plan",
+    "shard_sparse_state",
     "shard_state",
+    "sparse_state_shardings",
     "state_shardings",
 ]

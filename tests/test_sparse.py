@@ -381,3 +381,37 @@ def test_tombstone_demotes_to_unknown_like_dense():
     live = st.alive
     assert bool(jnp.all(jnp.where(live, col5 == UNKNOWN, True))), col5
     assert int(jnp.sum(st.slot_subj >= 0)) == 0
+
+
+def test_sparse_sharded_equals_single():
+    """Sharding the sparse engine's viewer axis over 8 virtual devices must
+    not change the computation — same seed, same trajectory, bit-for-bit
+    (the dense engine's test_sharded_equals_single, for the engine that
+    carries the 100k story — VERDICT round-2 item 2)."""
+    import jax
+
+    from scalecube_cluster_tpu.parallel import (
+        make_mesh,
+        shard_plan,
+        shard_sparse_state,
+    )
+
+    assert len(jax.devices()) >= 8
+    n = 64
+    p = sparse_params(n)
+    plan = FaultPlan.clean(n).with_loss(15.0)
+
+    st0 = kill_sparse(init_sparse_full_view(n, p.slot_budget, seed=7), 4)
+    ref, _ = run_sparse_ticks(p, st0, plan, 80)
+
+    mesh = make_mesh(jax.devices()[:8])
+    st_sh = shard_sparse_state(
+        kill_sparse(init_sparse_full_view(n, p.slot_budget, seed=7), 4), mesh
+    )
+    out, _ = run_sparse_ticks(p, st_sh, shard_plan(plan, mesh), 80)
+
+    for field in ("view_T", "slab", "age", "susp", "slot_subj", "subj_slot",
+                  "inc_self", "epoch", "useen", "uage"):
+        a = jax.device_get(getattr(ref, field))
+        b = jax.device_get(getattr(out, field))
+        assert (a == b).all(), field

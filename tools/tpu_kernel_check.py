@@ -44,9 +44,9 @@ n_dense_arg = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
 # The engines silently fall back to the XLA chain when the kernel size
 # gates fail — which would turn this tool into XLA-vs-XLA false evidence.
 # Refuse sizes that cannot engage the fused paths.
-if n_sparse % 32 != 0 or S % 128 != 0:
+if n_sparse % 32 != 0 or S % 128 != 0 or S >= 4096:
     sys.exit(f"sparse sizes n={n_sparse} S={S} won't engage pallas_core "
-             "(need n % 32 == 0 and S % 128 == 0)")
+             "(need n % 32 == 0, S % 128 == 0, S < 4096 packed-slot bound)")
 if n_dense_arg % 128 != 0:
     sys.exit(f"dense n={n_dense_arg} won't engage the fused tick kernel "
              "(need n % 128 == 0)")
