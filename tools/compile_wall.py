@@ -1,0 +1,87 @@
+"""Diagnose the >32k sparse-scan compile wall on the real TPU backend.
+
+Round-2 finding (PERF.md "Ceiling"): XLA's compile of the sparse scan
+degenerates (>8 min) at n >= 40960 even though the arrays fit HBM. Round-3
+measurement: the SAME program AOT-compiles in ~8 s on XLA:CPU at 40960, so
+the pathology is in the TPU backend (or the tunnel's remote_compile), not
+the traced program. This tool AOT-compiles one (n, variant) pair and
+prints the phase timings; the supervisor runs it in a matrix with hard
+deadlines, LAST in the sequence — an abandoned server-side compile can
+wedge the tunnel for every later process (tools/SKILL verify notes).
+
+Variants:
+  base      — the bench configuration (chunk=48 scan, donated carry)
+  tick1     — single tick, no scan (isolates scan vs body)
+  remat     — jax.checkpoint around the tick body
+  pallas    — fused sparse kernel core
+  cache     — base + persistent compilation cache under .jax_cache/
+
+Usage: python tools/compile_wall.py <n> <variant> [S]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+VARIANTS = ("base", "tick1", "remat", "pallas", "cache")
+n = int(sys.argv[1])
+variant = sys.argv[2] if len(sys.argv) > 2 else "base"
+S = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+if variant not in VARIANTS:
+    sys.exit(f"unknown variant {variant!r}; choose from {VARIANTS}")
+
+if variant == "cache":
+    from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+    enable_repo_jax_cache()
+
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    kill_sparse,
+    run_sparse_ticks,
+    sparse_tick,
+)
+
+print(f"backend={jax.default_backend()} n={n} S={S} variant={variant}", flush=True)
+params = SparseParams.for_n(
+    n, slot_budget=S, in_scan_writeback=False, pallas_core=(variant == "pallas")
+)
+plan = FaultPlan.uniform(loss_percent=5.0)
+state = kill_sparse(init_sparse_full_view(n, S), 7)
+
+if variant == "tick1":
+    fn = jax.jit(lambda st: sparse_tick(params, st, plan, collect=False)[0])
+elif variant == "remat":
+    tick = jax.checkpoint(lambda st: sparse_tick(params, st, plan, collect=False)[0])
+
+    def chain(st):
+        import jax.lax as lax
+
+        return lax.scan(lambda c, _: (tick(c), None), st, None, length=48)[0]
+
+    fn = jax.jit(chain)
+else:
+    fn = jax.jit(lambda st: run_sparse_ticks(params, st, plan, 48, collect=False)[0])
+
+t0 = time.perf_counter()
+lowered = fn.lower(state)
+t1 = time.perf_counter()
+print(f"lower: {t1 - t0:.1f}s", flush=True)
+compiled = lowered.compile()
+t2 = time.perf_counter()
+print(f"compile: {t2 - t1:.1f}s", flush=True)
+mem = compiled.memory_analysis()
+if mem is not None:
+    print(
+        f"argument {getattr(mem, 'argument_size_in_bytes', 0)/2**30:.2f} GiB, "
+        f"temp {getattr(mem, 'temp_size_in_bytes', 0)/2**30:.2f} GiB, "
+        f"output {getattr(mem, 'output_size_in_bytes', 0)/2**30:.2f} GiB",
+        flush=True,
+    )
+print("COMPILE_OK", flush=True)

@@ -22,21 +22,11 @@ run_sequence() {
   echo "=== tunnel up $stamp — sequence begins ===" >>"$LOG"
   sleep 10
 
-  echo "--- [1/5] pallas_sparse on-chip parity ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  echo "--- [1/6] pallas on-chip parity, small sizes ($(date -u +%FT%TZ)) ---" >>"$LOG"
   timeout 600 python tools/tpu_kernel_check.py >>"$LOG" 2>&1
   sleep 10
 
-  echo "--- [2/5] sparse ladder timings ($(date -u +%FT%TZ)) ---" >>"$LOG"
-  timeout 600 python tools/sparse_times.py 16384 2048 48 1 >>"$LOG" 2>&1
-  sleep 10
-  timeout 700 python tools/sparse_times.py 32768 2048 48 1 >>"$LOG" 2>&1
-  sleep 10
-
-  echo "--- [3/5] big-n compile probe ($(date -u +%FT%TZ)) ---" >>"$LOG"
-  timeout 900 python tools/sparse_times.py 49152 3072 48 1 >>"$LOG" 2>&1
-  sleep 10
-
-  echo "--- [4/5] bench.py (driver-identical invocation) ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  echo "--- [2/6] bench.py (driver-identical invocation) ($(date -u +%FT%TZ)) ---" >>"$LOG"
   # bench.py worst case: probes until ~budget_left>125s, then one child up
   # to 420 s -> ~1590 s; 1700 keeps the guaranteed JSON line alive.
   timeout 1700 python bench.py >/root/repo/BENCH_SELF_r3.json 2>>"$LOG"
@@ -58,8 +48,39 @@ except Exception as e:
 PYEOF
   sleep 10
 
-  echo "--- [5/5] dense control ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  echo "--- [3/6] sparse ladder timings ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  timeout 600 python tools/sparse_times.py 16384 2048 48 1 >>"$LOG" 2>&1
+  sleep 10
+  timeout 700 python tools/sparse_times.py 32768 2048 48 1 >>"$LOG" 2>&1
+  sleep 10
+
+  echo "--- [4/6] dense control ($(date -u +%FT%TZ)) ---" >>"$LOG"
   timeout 600 python tools/chunk_times.py 2>&1 | tail -30 >>"$LOG"
+  cp "$LOG" /root/repo/TPU_RUN_r3.log 2>/dev/null
+
+  # Compile-wall matrix LAST: an abandoned server-side XLA compile can
+  # wedge the tunnel for every later process, so nothing measurement-
+  # critical may run after these. tick1 first (smallest program), then the
+  # scan variants; snapshot the log after each step in case of a wedge.
+  echo "--- [5/6] compile-wall matrix at 40960 ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  SCAN_OK=0
+  for v in tick1 cache remat pallas; do
+    echo "... compile_wall 40960 $v $(date -u +%FT%TZ)" >>"$LOG"
+    STEP=$(mktemp)
+    timeout 700 python tools/compile_wall.py 40960 "$v" >"$STEP" 2>&1
+    cat "$STEP" >>"$LOG"
+    # Only a FULL-SCAN variant compiling proves the wall is passable;
+    # tick1 (single tick, no scan) is the control the wall never blocked.
+    if [ "$v" != "tick1" ] && grep -q "COMPILE_OK" "$STEP"; then SCAN_OK=1; fi
+    rm -f "$STEP"
+    cp "$LOG" /root/repo/TPU_RUN_r3.log 2>/dev/null
+    sleep 20
+  done
+
+  echo "--- [6/6] 49152 attempt (scan_ok=$SCAN_OK) ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  if [ "$SCAN_OK" = 1 ]; then
+    timeout 900 python tools/sparse_times.py 49152 3072 48 0 >>"$LOG" 2>&1
+  fi
   echo "=== sequence done $(date -u +%FT%TZ) ===" >>"$LOG"
   cp "$LOG" /root/repo/TPU_RUN_r3.log 2>/dev/null
   touch /root/repo/tools/.sequence_done
