@@ -256,6 +256,73 @@ def sparse_scale_scenario(
     }
 
 
+def sparse_churn_scenario(
+    n: int = 32768,
+    churn_per_chunk: int = 256,
+    ticks: int = 480,
+    chunk: int = 48,
+    seed: int = 0,
+) -> dict:
+    """Sustained churn on the compact-rumor engine, measuring the working
+    set's behavior under pressure: ``slot_overflow`` (activation requests
+    dropped because the slot table was full — the engine's documented
+    bounded-memory deviation) and final slot occupancy. Kills/restarts land
+    at chunk boundaries (host fault control), like the dense churn bench.
+    VERDICT round-2 weak#5: slot_overflow under sustained churn at scale
+    was never measured.
+    """
+    import time
+
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+        kill_sparse,
+        restart_sparse,
+        run_sparse_chunked,
+    )
+
+    params = SparseParams.for_n(n, in_scan_writeback=False)
+    state = init_sparse_full_view(n, params.slot_budget)
+    plan = FaultPlan.uniform(loss_percent=1.0)
+    rng = np.random.default_rng(seed)
+    down: set[int] = set()
+    max_overflow = 0.0
+    sum_overflow = 0.0
+    chunks = 0
+    t0 = time.perf_counter()
+    for _ in range(max(1, ticks // chunk)):
+        kills = rng.choice(
+            [i for i in range(2, n) if i not in down],
+            size=churn_per_chunk,
+            replace=False,
+        )
+        state = kill_sparse(state, jnp.asarray(kills))
+        down.update(int(i) for i in kills)
+        revive = list(down)[: churn_per_chunk // 2]
+        for i in revive:
+            state = restart_sparse(state, i)
+            down.discard(i)
+        state, traces = run_sparse_chunked(params, state, plan, chunk, chunk=chunk)
+        overflow = np.asarray(jax.device_get(traces["slot_overflow"]))
+        max_overflow = max(max_overflow, float(overflow.max()))
+        sum_overflow += float(overflow.sum())
+        chunks += 1
+    int(state.view_T[0, 0])
+    dt = time.perf_counter() - t0
+    return {
+        "scenario": "sparse_churn",
+        "n": n,
+        "churn_per_chunk": churn_per_chunk,
+        "ticks": chunks * chunk,
+        "churned_down": len(down),
+        "slot_overflow_max_per_tick": max_overflow,
+        "slot_overflow_total": sum_overflow,
+        "active_slots": int(jnp.sum(state.slot_subj >= 0)),
+        "slot_budget": params.slot_budget,
+        "member_rounds_per_sec": round(n * chunks * chunk / dt, 1),
+    }
+
+
 def run_all(scale: str = "small") -> list[dict]:
     """Run the grid. ``scale``: small (CI/CPU), large (one TPU chip)."""
     if scale not in ("small", "large"):
@@ -267,6 +334,7 @@ def run_all(scale: str = "small") -> list[dict]:
             lambda: partition_recovery_scenario(n=256),
             lambda: churn_benchmark(n=256, churn_per_chunk=2, ticks=200),
             lambda: sparse_scale_scenario(n=256),
+            lambda: sparse_churn_scenario(n=256, churn_per_chunk=8, ticks=96),
         ]
     else:
         grid = [
@@ -275,6 +343,7 @@ def run_all(scale: str = "small") -> list[dict]:
             lambda: partition_recovery_scenario(n=10_000),
             lambda: churn_benchmark(n=8192, churn_per_chunk=16),
             lambda: sparse_scale_scenario(n=32768),
+            lambda: sparse_churn_scenario(n=32768, churn_per_chunk=256),
         ]
     results = []
     for fn in grid:

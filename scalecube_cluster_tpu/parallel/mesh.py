@@ -128,6 +128,8 @@ def sparse_state_shardings(mesh: Mesh):
         alive=vec,
         useen=slabrow,  # [N, G]: viewer rows shard, G tiny
         uage=slabrow,
+        uinf_ids=NamedSharding(mesh, P(AXIS, None, None)),  # [N, G, k]
+        uptr=slabrow,
         tick=rep,
         rng=rep,
     )

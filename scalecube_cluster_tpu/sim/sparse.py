@@ -41,20 +41,26 @@ those in sim/tick.py — the scenario tests are the fidelity oracle:
   are uniform random members, validity-checked against the viewer's table,
   instead of Gumbel-top-k over the full candidate matrix (O(N) vs O(N²)
   selection; same expected relay rate).
-- SYNC exchanges only the partners' OWN records (O(1) payload), not full
+- SYNC exchanges the partners' OWN records plus a globally-rotating
+  BOUNDED WINDOW of ``sync_window`` table records (O(W) payload), not full
   tables (O(N) — the reference ships the entire table per SYNC,
-  SyncData.java:11-41, which is itself impractical at 100k members). Healing
-  still works: learning one re-introduced member is a table change, which
-  gossips cluster-wide and re-seeds anti-entropy; the joining path loads the
-  seed's table directly (host op), like initial sync.
+  SyncData.java:11-41, which is itself impractical at 100k members). Full
+  anti-entropy coverage takes ceil(n/W) sync periods instead of one;
+  healing is faster in practice because every learned change gossips
+  cluster-wide and re-seeds anti-entropy, and the partner's own record
+  (the reintroduction channel) is still exchanged every period. Window
+  learnings apply post-core, so they disseminate from the next tick
+  (the dense slow path folds SYNC inside the core — one-tick shift).
 - The working set is bounded: at most ``alloc_cap`` subjects activate per
   tick and at most ``slot_budget`` are active at once; overflow requests are
   dropped and counted in the ``slot_overflow`` metric (the reference's
   unbounded gossip map has the same practical bound — memory).
 - User gossip (spreadGossip) runs with the dense engine's exactly-once +
   sweep lifecycle on the shared fan-out ([N, G] arrays — not N²-bound);
-  per-rumor infected-set SUPPRESSION stays a dense-engine validation-scale
-  feature (its state is [N, N, G]).
+  per-rumor infected-set SUPPRESSION (GossipState.java:17-38) is the
+  last-k-senders ring approximation ([N, G, k] — the dense engine's exact
+  form is [N, N, G]): suppression can only under-fire, never mis-suppress
+  (sim/usergossip.py::user_gossip_step_tracked).
 """
 
 from __future__ import annotations
@@ -73,7 +79,10 @@ from scalecube_cluster_tpu.ops.delivery import (
     GROUP,
     fanout_permutations_structured,
 )
-from scalecube_cluster_tpu.sim.usergossip import user_gossip_step
+from scalecube_cluster_tpu.sim.usergossip import (
+    user_gossip_step,
+    user_gossip_step_tracked,
+)
 from scalecube_cluster_tpu.ops.merge import (
     DEAD_BIT,
     UNKNOWN_KEY,
@@ -89,6 +98,25 @@ from scalecube_cluster_tpu.ops.select import probe_cursor_targets
 from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass, round_trip_in_time
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import AGE_STALE
+
+def sync_accept(learned, mine):
+    """Merge-lattice accept test for SYNC-learned records (broadcast-poly).
+
+    Mirrors ops/merge.py::merge_views: same-epoch records fight by key
+    (overrides_same_epoch); unknown/newer-epoch identities may only be
+    introduced by an ALIVE record. Shared by the own-record SYNC, the
+    bounded-window exchange, and the post-core window re-verify so the
+    lattice rule cannot desynchronize between them.
+    """
+    known = learned >= 0
+    same = (mine >= 0) & known & (decode_epoch(mine) == decode_epoch(learned))
+    intro = (
+        known
+        & is_alive_key(learned)
+        & ((mine < 0) | (decode_epoch(learned) > decode_epoch(mine)))
+    )
+    return (same & overrides_same_epoch(learned, mine)) | (~same & intro)
+
 
 _ALIVE = int(MemberStatus.ALIVE)
 _SUSPECT = int(MemberStatus.SUSPECT)
@@ -123,6 +151,13 @@ class SparseParams:
     #: one fused Pallas kernel (ops/pallas_sparse.py). Bit-identical to the
     #: XLA chain; needs n % 32 == 0 and S % 128 == 0, else ignored.
     pallas_core: bool = False
+    #: Bounded-window table SYNC: each sync period, partners additionally
+    #: exchange their records for a globally-rotating window of this many
+    #: subjects — the scalable form of the reference's FULL-table exchange
+    #: (SyncData.java:11-41; onSync, MembershipProtocolImpl.java:352-373).
+    #: Full table coverage every ceil(n / sync_window) sync periods; 0
+    #: disables (round-2 own-record-only behavior).
+    sync_window: int = 64
 
     @classmethod
     def for_n(
@@ -133,6 +168,7 @@ class SparseParams:
         writeback_period: int = 1,
         in_scan_writeback: bool = True,
         pallas_core: bool = False,
+        sync_window: int = 64,
         **kw,
     ):
         return cls(
@@ -142,6 +178,7 @@ class SparseParams:
             writeback_period=writeback_period,
             in_scan_writeback=in_scan_writeback,
             pallas_core=pallas_core,
+            sync_window=sync_window,
         )
 
 
@@ -161,6 +198,8 @@ class SparseState:
     alive: jax.Array  # [N] bool
     useen: jax.Array  # [N, G] bool — user-gossip dissemination (spreadGossip)
     uage: jax.Array  # [N, G] int32
+    uinf_ids: jax.Array  # [N, G, k] int32 — last-k-senders infected ring (-1 empty)
+    uptr: jax.Array  # [N, G] int32 — ring write cursor
     tick: jax.Array  # [] int32
     rng: jax.Array
 
@@ -169,9 +208,18 @@ class SparseState:
 
 
 def init_sparse_full_view(
-    n: int, slot_budget: int = 2048, seed: int = 0, user_gossip_slots: int = 4
+    n: int,
+    slot_budget: int = 2048,
+    seed: int = 0,
+    user_gossip_slots: int = 4,
+    infected_k: int = 16,
 ) -> SparseState:
-    """Post-join steady state, nothing active: the common 100k starting point."""
+    """Post-join steady state, nothing active: the common 100k starting point.
+
+    ``infected_k`` sizes the user-gossip last-k-senders suppression ring
+    (sim/usergossip.py::user_gossip_step_tracked); 0 selects the untracked
+    lifecycle (the tick gates on this static shape).
+    """
     return SparseState(
         view_T=jnp.full((n, n), encode_key(0, 0), jnp.int32),
         slot_subj=jnp.full((slot_budget,), -1, jnp.int32),
@@ -184,6 +232,8 @@ def init_sparse_full_view(
         alive=jnp.ones((n,), bool),
         useen=jnp.zeros((n, user_gossip_slots), bool),
         uage=jnp.zeros((n, user_gossip_slots), jnp.int32),
+        uinf_ids=jnp.full((n, user_gossip_slots, infected_k), -1, jnp.int32),
+        uptr=jnp.zeros((n, user_gossip_slots), jnp.int32),
         tick=jnp.zeros((), jnp.int32),
         rng=jax.random.PRNGKey(seed),
     )
@@ -284,6 +334,14 @@ def restart_sparse(state: SparseState, idx: int) -> SparseState:
         susp=state.susp.at[idx, :].set(0),
         # A restarted process is a fresh identity: no user-gossip dedup state.
         useen=state.useen.at[idx, :].set(False),
+        # Neither its own ring nor PEERS' knowledge of it: a restarted
+        # member is a fresh identity absent from all infected sets (dense
+        # twin sim/state.py::restart clears both directions) — a stale
+        # entry would mis-suppress sends to a node that holds nothing.
+        uinf_ids=jnp.where(
+            state.uinf_ids == idx, -1, state.uinf_ids
+        ).at[idx].set(-1),
+        uptr=state.uptr.at[idx].set(0),
     )
     state, s = _activate_on_host(state, idx)
     # Announce the new identity (ALIVE at the new epoch, young).
@@ -446,34 +504,60 @@ def sparse_tick(
         # leavers cluster-wide.
         learned_key = my_record_of(prt, prt)
         mine = my_record_of(col, prt)
-        # Accept test mirrors the merge lattice (ops/merge.py::merge_views):
-        # same-epoch records fight by key; unknown/newer-epoch identities may
-        # only be introduced by an ALIVE record.
-        known_l = learned_key >= 0
-        same = (
-            (mine >= 0)
-            & known_l
-            & (decode_epoch(mine) == decode_epoch(learned_key))
-        )
-        intro = (
-            known_l
-            & is_alive_key(learned_key)
-            & ((mine < 0) | (decode_epoch(learned_key) > decode_epoch(mine)))
-        )
-        accept = ok & (
-            (same & overrides_same_epoch(learned_key, mine)) | (~same & intro)
-        )
-        return prt, learned_key, accept, jnp.sum(ok) * 2
+        accept = ok & sync_accept(learned_key, mine)
+
+        # Bounded-window table exchange (params.sync_window): the partner's
+        # records for the rotating window ride the same SYNC message pair —
+        # the scalable form of the reference's full-table SyncData
+        # (SyncData.java:11-41; onSync, MembershipProtocolImpl.java:352-373).
+        # Self-cells are excluded from the merge and routed to the
+        # refutation channel instead (onSelfMemberDetected,
+        # MembershipProtocolImpl.java:549-569).
+        if W > 0:
+            learned_w = my_record_of(prt[:, None], wsubj[None, :])
+            mine_w = my_record_of(col[:, None], wsubj[None, :])
+            self_cell = wsubj[None, :] == col[:, None]
+            accept_w = ok[:, None] & ~self_cell & sync_accept(learned_w, mine_w)
+            self_win = jnp.max(
+                jnp.where(
+                    self_cell & ok[:, None] & (learned_w >= 0),
+                    learned_w,
+                    UNKNOWN_KEY,
+                ),
+                axis=1,
+            )
+        else:
+            learned_w, accept_w, self_win = _window_zeros()
+        return prt, learned_key, accept, jnp.sum(ok) * 2, learned_w, accept_w, self_win
 
     def sync_skip_phase(_):
+        learned_w, accept_w, self_win = _window_zeros()
         return (
             jnp.zeros((n,), jnp.int32),
             jnp.zeros((n,), jnp.int32),
             jnp.zeros((n,), bool),
             jnp.asarray(0, jnp.int32),
+            learned_w,
+            accept_w,
+            self_win,
         )
 
-    sy_subj, sy_key, sy_accept, msgs_sync = lax.cond(
+    # Rotating global window: full table coverage every ceil(n/W) sync
+    # periods; W <= n keeps in-window subjects distinct (wrap at the last
+    # block only re-covers early subjects).
+    W = min(params.sync_window, n)
+    nblocks = (n + W - 1) // W if W else 1
+    sync_round = t // p.sync_period_ticks
+    wsubj = (jnp.mod(sync_round, nblocks) * W + jnp.arange(W, dtype=jnp.int32)) % n
+
+    def _window_zeros():
+        return (
+            jnp.full((n, W), UNKNOWN_KEY, jnp.int32),
+            jnp.zeros((n, W), bool),
+            jnp.full((n,), UNKNOWN_KEY, jnp.int32),
+        )
+
+    (sy_subj, sy_key, sy_accept, msgs_sync, win_key, win_accept, self_win) = lax.cond(
         do_sync, sync_fire_phase, sync_skip_phase, None
     )
 
@@ -514,6 +598,21 @@ def sparse_tick(
     req = jnp.zeros((n,), bool)
     req = req.at[fd_tgt].max(fd_fire)
     req = req.at[sy_subj].max(sy_accept)
+    if W > 0:
+        # Window-learned subjects any viewer accepted need a slot; the
+        # window is global, so at most W activations cluster-wide. A
+        # window-learned THREAT about myself also needs my own slot — the
+        # refutation (step 7) writes the incarnation bump into my row.
+        req = req.at[wsubj].max(jnp.any(win_accept, axis=0))
+        st_w = decode_status(self_win)
+        self_threat_pre = (
+            alive
+            & (self_win >= 0)
+            & (decode_epoch(self_win) == state.epoch)
+            & (decode_incarnation(self_win) >= state.inc_self)
+            & ((st_w == _SUSPECT) | (st_w == _DEAD))
+        )
+        req = req | self_threat_pre
     req = req & (subj_slot < 0)
     # Rank requests; grant the first alloc_cap into the first free slots.
     cap = params.alloc_cap
@@ -676,7 +775,54 @@ def sparse_tick(
         # the kernel's restore of its susp input.
         susp = jnp.where(alive[:, None], susp, susp_in)
 
+    # ------------------------- 6.5 window SYNC application (cond-gated)
+    # Applied AFTER the core so the fused kernel and the XLA chain share
+    # this code path (bit-parity preserved without kernel surgery). The
+    # accept decision was taken against arrival state (step 2, like the
+    # reference's onSync merge); the core only raises records, so a
+    # monotone re-verify against the post-core cell keeps the lattice
+    # order. Applied cells age-reset to 0 (young: the learning gossips
+    # from the NEXT tick's delivery — one tick later than the dense slow
+    # path, which folds SYNC inside the core; documented deviation) and
+    # re-arm/clear their suspicion countdown like any strict change.
+    if W > 0:
+
+        def _apply_window(args):
+            slab_a, age_a, susp_a = args
+            wslot = subj_slot[wsubj]
+            safe = jnp.where(wslot >= 0, wslot, 0)
+            cur = slab_a[:, safe]
+            app = (
+                win_accept
+                & (wslot >= 0)[None, :]
+                & alive[:, None]
+                & sync_accept(win_key, cur)
+            )
+            new = jnp.where(app, win_key, cur)
+            route = jnp.where(wslot >= 0, wslot, S)
+            slab_a = slab_a.at[:, route].set(new, mode="drop")
+            age_a = age_a.at[:, route].set(
+                jnp.where(app, jnp.asarray(0, jnp.int8), age_a[:, safe]),
+                mode="drop",
+            )
+            is_s = ((new & 1) != 0) & ((new & DEAD_BIT) == 0) & (new >= 0)
+            new_susp = jnp.where(
+                app,
+                jnp.where(is_s, p.suspicion_ticks, 0),
+                susp_a[:, safe].astype(jnp.int32),
+            ).astype(jnp.int16)
+            susp_a = susp_a.at[:, route].set(new_susp, mode="drop")
+            return slab_a, age_a, susp_a
+
+        slab2, age, susp = lax.cond(
+            do_sync, _apply_window, lambda a: a, (slab2, age, susp)
+        )
+
     # --------------------------------------------------- 7. self-refutation
+    # ``self_win`` folds window-SYNC-learned records about self into the
+    # same refutation channel as gossip rumors (a SYNC-reason update about
+    # self also triggers onSelfMemberDetected in the reference).
+    self_rumor = jnp.maximum(self_rumor, self_win)
     r_status = decode_status(self_rumor)
     own_slot = subj_slot[col]
     has_own = own_slot >= 0
@@ -706,15 +852,29 @@ def sparse_tick(
     # are not N²-bound, so the engine-shared lifecycle (sim/usergossip.py)
     # rides the same fan-out. Per-rumor infected-set suppression stays a
     # dense-engine (validation-scale) feature.
-    new_seen, uage, msgs_user = user_gossip_step(
-        state.useen,
-        state.uage,
-        inv_perm,
-        edge_ok,
-        alive,
-        p.periods_to_spread,
-        p.periods_to_sweep,
-    )
+    if state.uinf_ids.shape[2] > 0:
+        new_seen, uage, uinf_ids, uptr, msgs_user = user_gossip_step_tracked(
+            state.useen,
+            state.uage,
+            state.uinf_ids,
+            state.uptr,
+            inv_perm,
+            edge_ok,
+            alive,
+            p.periods_to_spread,
+            p.periods_to_sweep,
+        )
+    else:
+        new_seen, uage, msgs_user = user_gossip_step(
+            state.useen,
+            state.uage,
+            inv_perm,
+            edge_ok,
+            alive,
+            p.periods_to_spread,
+            p.periods_to_sweep,
+        )
+        uinf_ids, uptr = state.uinf_ids, state.uptr
 
     new_state = state.replace(
         view_T=view_T,
@@ -726,6 +886,8 @@ def sparse_tick(
         inc_self=inc_self,
         useen=new_seen,
         uage=uage,
+        uinf_ids=uinf_ids,
+        uptr=uptr,
         tick=t,
         rng=rng_next,
     )

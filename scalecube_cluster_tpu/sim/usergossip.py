@@ -46,3 +46,58 @@ def user_gossip_step(useen, uage, inv_perm, edge_ok, alive, spread, sweep):
     new_age = jnp.where(first_seen, 0, jnp.minimum(uage + 1, AGE_CAP))
     swept = new_seen & (new_age > sweep)
     return new_seen & ~swept, new_age, msgs_user
+
+
+def user_gossip_step_tracked(
+    useen, uage, uinf_ids, uptr, inv_perm, edge_ok, alive, spread, sweep
+):
+    """Tracked variant: last-k-senders infected-set suppression.
+
+    The reference's per-gossip ``infected`` set (GossipState.java:17-38)
+    lets a sender skip peers it knows already hold the rumor
+    (selectGossipsToSend, GossipProtocolImpl.java:242-251); the dense
+    engine's exact form needs [N, N, G] state. At working-set scale the
+    set is bounded to the LAST k SENDERS per (holder, slot): ``uinf_ids``
+    ``[N, G, k]`` int32 member ids (-1 empty) with write cursor ``uptr``
+    ``[N, G]``. Receivers record the pushing sender on arrival
+    (onGossipReq, :171-183); sweep drops the whole per-slot state. The
+    approximation only weakens SUPPRESSION (an id evicted from the ring
+    may be re-sent to) — delivery dedup/exactly-once is carried by
+    ``useen`` exactly as in the untracked path.
+
+    Returns ``(new_seen, new_age, uinf_ids, uptr, msgs_user [G])``.
+    """
+    n, g_slots = useen.shape
+    k = uinf_ids.shape[2]
+    f = inv_perm.shape[0]
+    col = jnp.arange(n, dtype=jnp.int32)
+    kr = jnp.arange(k, dtype=jnp.int32)
+    nonself = inv_perm != col[None, :]
+    urows = useen & (uage < spread)
+
+    sent = []
+    for c in range(f):
+        s = inv_perm[c]  # sender feeding receiver `col` along edge c
+        # Does sender s know receiver col already holds slot g?
+        known = jnp.any(uinf_ids[s] == col[:, None, None], axis=2)  # [N, G]
+        sent.append(urows[s] & ~known & (alive[s] & nonself[c])[:, None])
+    msgs_user = sum(jnp.sum(c_sent, axis=0) for c_sent in sent)
+
+    got = jnp.zeros_like(urows)
+    for c in range(f):
+        arrived = sent[c] & edge_ok[c][:, None] & alive[:, None]  # [N, G]
+        got = got | arrived
+        sid = inv_perm[c]
+        pos = jnp.mod(uptr, k)  # [N, G]
+        cell = (kr[None, None, :] == pos[:, :, None]) & arrived[:, :, None]
+        uinf_ids = jnp.where(cell, sid[:, None, None], uinf_ids)
+        uptr = uptr + arrived.astype(jnp.int32)
+
+    new_seen = useen | got
+    first_seen = new_seen & ~useen
+    new_age = jnp.where(first_seen, 0, jnp.minimum(uage + 1, AGE_CAP))
+    swept = new_seen & (new_age > sweep)
+    # Sweeping drops the whole GossipState, infected ring included.
+    uinf_ids = jnp.where(swept[:, :, None], -1, uinf_ids)
+    uptr = jnp.where(swept, 0, uptr)
+    return new_seen & ~swept, new_age, uinf_ids, uptr, msgs_user

@@ -12,3 +12,9 @@ def test_small_grid_passes():
     assert results["partition_recovery"]["partition_detected"]
     assert results["partition_recovery"]["healed_convergence"] == 1.0
     assert results["churn"]["final_convergence"] > 0.9
+    churn = results["sparse_churn"]
+    assert churn["churned_down"] > 0
+    # At CI scale (n=256, budget 2048) churn activity must fit the slot
+    # table with real headroom and never drop an activation request.
+    assert churn["active_slots"] < churn["slot_budget"] // 2, churn
+    assert churn["slot_overflow_total"] == 0.0, churn
