@@ -45,6 +45,9 @@ import time
 
 BASELINE_MEMBER_ROUNDS_PER_SEC = 1_000_000.0
 #: Largest-first ladder of (engine, n_members); first one that lands wins.
+#: ``sparse-pallas`` (the fused [N, S] kernel core) leads: if it lowers on
+#: the chip it should beat the XLA chain; if it fails the child dies and
+#: the ladder falls through to the proven plain-sparse rung.
 #: 32768 is the single-chip ceiling: above it XLA's compile of the sparse
 #: scan degenerates (>>8 min at 40960/49152, measured) even though the
 #: arrays would fit HBM — a child would burn its whole deadline, so bigger
@@ -52,6 +55,7 @@ BASELINE_MEMBER_ROUNDS_PER_SEC = 1_000_000.0
 #: landing even if the fused Pallas kernel ever fails to lower on the
 #: target chip.
 LADDER = (
+    ("sparse-pallas", 32768),
     ("sparse", 32768),
     ("sparse", 16384),
     ("dense", 10240),
@@ -98,7 +102,9 @@ def _measure_dense(
     return n_members * (reps * chunk / dt)
 
 
-def _measure_sparse(n_members: int, chunk: int = 48, reps: int = 4) -> float:
+def _measure_sparse(
+    n_members: int, chunk: int = 48, reps: int = 4, pallas: bool = False
+) -> float:
     from scalecube_cluster_tpu.sim.faults import FaultPlan
     from scalecube_cluster_tpu.sim.sparse import (
         SparseParams,
@@ -107,7 +113,9 @@ def _measure_sparse(n_members: int, chunk: int = 48, reps: int = 4) -> float:
         run_sparse_chunked,
     )
 
-    params = SparseParams.for_n(n_members, in_scan_writeback=False)
+    params = SparseParams.for_n(
+        n_members, in_scan_writeback=False, pallas_core=pallas
+    )
     state = kill_sparse(
         init_sparse_full_view(n_members, params.slot_budget), 7
     )
@@ -128,8 +136,8 @@ def _measure_sparse(n_members: int, chunk: int = 48, reps: int = 4) -> float:
 
 def _measure(engine: str, n_members: int) -> dict:
     """Run one benchmark config in-process and return the result dict."""
-    if engine == "sparse":
-        value = _measure_sparse(n_members)
+    if engine in ("sparse", "sparse-pallas"):
+        value = _measure_sparse(n_members, pallas=(engine == "sparse-pallas"))
     else:
         value = _measure_dense(n_members, pallas=(engine == "dense"))
     return {

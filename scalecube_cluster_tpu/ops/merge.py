@@ -115,6 +115,13 @@ def is_alive_key(key):
     return (key >= 0) & ((key & DEAD_BIT) == 0) & ((key & _RANK_BIT) == 0)
 
 
+def is_suspect_key(key):
+    """Mask of keys encoding a (known) SUSPECT record — rank bit set, dead
+    bit clear (suspicion countdowns arm exactly on these)."""
+    key = jnp.asarray(key)
+    return (key >= 0) & ((key & DEAD_BIT) == 0) & ((key & _RANK_BIT) != 0)
+
+
 def overrides_same_epoch(key1, key0):
     """Vectorized ``isOverrides`` for records of the *same known* epoch.
 

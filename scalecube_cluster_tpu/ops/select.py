@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def masked_random_topk(rng, mask, k):
@@ -60,12 +61,23 @@ def masked_random_choice(rng, mask):
     return idx[..., 0], valid[..., 0]
 
 
-#: Root key for the probe cursor's per-wrap permutation parameters. Fixed
-#: (not threaded from the sim rng) so the schedule is a pure function of
-#: (n, fd_round): checkpoint/resume and sharded re-slicing need no state.
-_PROBE_CURSOR_KEY = jax.random.PRNGKey(0x5CA1EC)
 #: Stride bound keeping ``a * c`` < 2^31 for n < 2^20 (uint32 arithmetic).
 _MAX_STRIDE = 2048
+
+
+#: Root key for the probe cursor's per-wrap permutation parameters, as raw
+#: threefry key data (``PRNGKey(seed)`` == ``[seed >> 32, seed & 0xffffffff]``).
+#: Fixed (not threaded from the sim rng) so the schedule is a pure function
+#: of (n, fd_round): checkpoint/resume and sharded re-slicing need no state.
+#: Kept as NUMPY on purpose: a module-level jax array would initialize the
+#: default backend at IMPORT time, before callers (tests, ``--cpu``
+#: runners) can pin a platform; and caching a lazily-built jax key leaks
+#: tracers when first touched inside a jit trace.
+_PROBE_CURSOR_KEY_DATA = np.array([0, 0x5CA1EC], dtype=np.uint32)
+
+
+def _probe_cursor_key():
+    return jnp.asarray(_PROBE_CURSOR_KEY_DATA)
 
 
 def probe_cursor_targets(fd_round, n):
@@ -98,7 +110,7 @@ def probe_cursor_targets(fd_round, n):
         raise ValueError(f"probe cursor supports n < 2^20, got {n}")
     w = fd_round // n
     c = jnp.mod(fd_round, n).astype(jnp.uint32)
-    kw = jax.random.fold_in(_PROBE_CURSOR_KEY, w)
+    kw = jax.random.fold_in(_probe_cursor_key(), w)
     ka, kb = jax.random.split(kw)
     hi = min(_MAX_STRIDE, n) if n > 1 else 2
     cands = jax.random.randint(ka, (8, n), 1, hi, jnp.int32)

@@ -71,6 +71,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.tree_util import register_dataclass
 
@@ -91,6 +92,7 @@ from scalecube_cluster_tpu.ops.merge import (
     decode_status,
     encode_key,
     is_alive_key,
+    is_suspect_key,
     merge_views,
     overrides_same_epoch,
 )
@@ -317,37 +319,96 @@ def update_metadata_sparse(state: SparseState, idx: int) -> SparseState:
 def restart_sparse(state: SparseState, idx: int) -> SparseState:
     """Restart slot ``idx`` as a new identity (epoch bump), rejoining with a
     seed-loaded table (the initial-sync outcome as a host op — dense twin:
-    sim/state.py::restart + the join SYNC)."""
-    n = state.view_T.shape[0]
-    new_epoch = state.epoch[idx] + 1
-    self_key = encode_key(jnp.asarray(_ALIVE), jnp.asarray(0), new_epoch)
-    # The restarted process forgets its table (fresh join: copy a live seed's
-    # view — here subject-major column idx across all subjects).
+    sim/state.py::restart + the join SYNC). Single-member form of
+    :func:`restart_many_sparse` (one implementation, one semantics)."""
+    return restart_many_sparse(state, [idx])
+
+
+def restart_many_sparse(state: SparseState, idxs) -> SparseState:
+    """Batched :func:`restart_sparse`: every member of ``idxs`` rejoins as a
+    fresh identity in ONE pass over the big arrays.
+
+    A host loop of single restarts copies the [N, N] table once per member
+    (each eager ``.at[:, idx].set`` materializes the whole array) —
+    prohibitive at 32k+; churn scenarios restart dozens per chunk. Slot
+    bookkeeping (tiny [S]/[N] vectors) stays host-side; all [N, *] updates
+    are batched. Semantics per member are identical to restart_sparse.
+    """
+    from scalecube_cluster_tpu.ops import merge as _merge_ops
+
+    idx_list = [int(i) for i in np.asarray(idxs).ravel()]
+    if not idx_list:
+        return state
+    if len(set(idx_list)) != len(idx_list):
+        raise ValueError("duplicate indices in restart_many_sparse")
+    epochs = jax.device_get(state.epoch[jnp.asarray(idx_list)])
+    if int(epochs.max()) >= _merge_ops.EPOCH_MAX:
+        raise ValueError(
+            f"a slot in {idx_list} exhausted its {_merge_ops.EPOCH_MAX} "
+            "restart epochs"
+        )
+    ii = jnp.asarray(idx_list, jnp.int32)
     seed_viewer = int(jnp.argmax(state.alive))
-    state = state.replace(
-        alive=state.alive.at[idx].set(True),
-        epoch=state.epoch.at[idx].set(new_epoch),
-        inc_self=state.inc_self.at[idx].set(0),
-        view_T=state.view_T.at[:, idx].set(state.view_T[:, seed_viewer]),
-        slab=state.slab.at[idx, :].set(state.slab[seed_viewer, :]),
-        age=state.age.at[idx, :].set(AGE_STALE),
-        susp=state.susp.at[idx, :].set(0),
-        # A restarted process is a fresh identity: no user-gossip dedup state.
-        useen=state.useen.at[idx, :].set(False),
-        # Neither its own ring nor PEERS' knowledge of it: a restarted
-        # member is a fresh identity absent from all infected sets (dense
-        # twin sim/state.py::restart clears both directions) — a stale
-        # entry would mis-suppress sends to a node that holds nothing.
-        uinf_ids=jnp.where(
-            state.uinf_ids == idx, -1, state.uinf_ids
-        ).at[idx].set(-1),
-        uptr=state.uptr.at[idx].set(0),
+    new_epochs = state.epoch[ii] + 1
+    self_keys = encode_key(
+        jnp.full((len(idx_list),), _ALIVE, jnp.int32),
+        jnp.zeros((len(idx_list),), jnp.int32),
+        new_epochs,
     )
-    state, s = _activate_on_host(state, idx)
-    # Announce the new identity (ALIVE at the new epoch, young).
+
+    # 1. Bulk identity resets (each a single pass over its array).
+    state = state.replace(
+        alive=state.alive.at[ii].set(True),
+        epoch=state.epoch.at[ii].set(new_epochs),
+        inc_self=state.inc_self.at[ii].set(0),
+        view_T=state.view_T.at[:, ii].set(
+            state.view_T[:, seed_viewer][:, None]
+        ),
+        slab=state.slab.at[ii, :].set(state.slab[seed_viewer, :][None, :]),
+        age=state.age.at[ii, :].set(AGE_STALE),
+        susp=state.susp.at[ii, :].set(0),
+        useen=state.useen.at[ii, :].set(False),
+        uinf_ids=jnp.where(
+            jnp.isin(state.uinf_ids, ii), -1, state.uinf_ids
+        ).at[ii].set(-1),
+        uptr=state.uptr.at[ii].set(0),
+    )
+
+    # 2. Slot allocation (host bookkeeping on the tiny tables), split into
+    # already-active subjects vs fresh activations.
+    subj_slot = np.asarray(jax.device_get(state.subj_slot)).copy()
+    slot_subj = np.asarray(jax.device_get(state.slot_subj)).copy()
+    slots = np.empty(len(idx_list), np.int32)
+    need_load = []
+    free_iter = iter(np.flatnonzero(slot_subj < 0).tolist())
+    for k, j in enumerate(idx_list):
+        if subj_slot[j] >= 0:
+            slots[k] = subj_slot[j]
+        else:
+            try:
+                s = next(free_iter)
+            except StopIteration:
+                raise RuntimeError("slot budget exhausted for host op")
+            slots[k] = s
+            subj_slot[j] = s
+            slot_subj[s] = j
+            need_load.append(k)
+    sl = jnp.asarray(slots)
+    state = state.replace(
+        slot_subj=jnp.asarray(slot_subj), subj_slot=jnp.asarray(subj_slot)
+    )
+    if need_load:
+        nl = jnp.asarray(need_load, jnp.int32)
+        state = state.replace(
+            slab=state.slab.at[:, sl[nl]].set(state.view_T[ii[nl], :].T),
+            age=state.age.at[:, sl[nl]].set(jnp.asarray(AGE_STALE, jnp.int8)),
+            susp=state.susp.at[:, sl[nl]].set(jnp.asarray(0, jnp.int16)),
+        )
+
+    # 3. Announce the new identities (ALIVE at the new epoch, young).
     return state.replace(
-        slab=state.slab.at[idx, s].set(self_key),
-        age=state.age.at[idx, s].set(0),
+        slab=state.slab.at[ii, sl].set(self_keys),
+        age=state.age.at[ii, sl].set(0),
     )
 
 
@@ -765,7 +826,7 @@ def sparse_tick(
             jnp.asarray(0, jnp.int8),
             jnp.minimum(age, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
         )
-        is_susp = ((slab2 & 1) != 0) & ((slab2 & DEAD_BIT) == 0) & (slab2 >= 0)
+        is_susp = is_suspect_key(slab2)
         susp = jnp.where(
             is_susp & active[None, :],
             jnp.where(rearm | ~armed, p.suspicion_ticks, left0),
@@ -805,7 +866,7 @@ def sparse_tick(
                 jnp.where(app, jnp.asarray(0, jnp.int8), age_a[:, safe]),
                 mode="drop",
             )
-            is_s = ((new & 1) != 0) & ((new & DEAD_BIT) == 0) & (new >= 0)
+            is_s = is_suspect_key(new)
             new_susp = jnp.where(
                 app,
                 jnp.where(is_s, p.suspicion_ticks, 0),
@@ -894,7 +955,7 @@ def sparse_tick(
     if not collect:
         return new_state, {"tick": t}
     # Recomputed from the outputs so both core paths share the formulas.
-    is_susp2 = ((slab2 & 1) != 0) & ((slab2 & DEAD_BIT) == 0) & (slab2 >= 0)
+    is_susp2 = is_suspect_key(slab2)
     sender_active = jnp.any(
         (age_in < p.periods_to_spread) & active[None, :] & (slab >= 0), axis=1
     )

@@ -569,3 +569,35 @@ def test_restart_clears_peer_infected_rings():
     st = restart_sparse(st, 5)
     assert not bool(jnp.any(st.uinf_ids == 5))
     assert bool(jnp.all(st.uinf_ids[5] == -1))
+
+
+def test_restart_many_matches_sequential():
+    """restart_many_sparse is the batched control-plane op for churn at
+    scale; it must equal a sequence of single restarts field-for-field
+    (same epoch bumps, seed-table copies, slot loads, young announces)."""
+    from scalecube_cluster_tpu.sim.sparse import restart_many_sparse
+
+    n = 24
+    p = sparse_params(n)
+    base = kill_sparse(
+        kill_sparse(kill_sparse(init_sparse_full_view(n, p.slot_budget), 4), 7), 9
+    )
+    base, _ = run_sparse_ticks(p, base, FaultPlan.clean(n), 12)
+
+    import dataclasses as dc
+
+    def compare(seq, bat):
+        for f in dc.fields(type(seq)):
+            a, b = getattr(seq, f.name), getattr(bat, f.name)
+            assert bool(jnp.all(a == b)), f.name
+
+    # Subjects already active (FD/suspicion allocated their slots).
+    seq = base
+    for j in (4, 7, 9):
+        seq = restart_sparse(seq, j)
+    compare(seq, restart_many_sparse(base, [4, 7, 9]))
+
+    # Fresh-allocation path: nothing active yet.
+    cold = kill_sparse(init_sparse_full_view(n, p.slot_budget), 11)
+    seq2 = restart_sparse(restart_sparse(cold, 11), 3)
+    compare(seq2, restart_many_sparse(cold, [11, 3]))
