@@ -127,8 +127,13 @@ def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale):
                 copy.wait()
             rot = rot_ref[c, i]
             w = pltpu.roll(wslab[slot, c], shift=b - rot, axis=0)
-            wa = pltpu.roll(wage[slot, c], shift=b - rot, axis=0)
-            young_w = wa.astype(jnp.int32) < spread
+            # Mosaic's dynamic rotate only lowers for 32-bit lanes ("Rotate
+            # with non-32-bit data" — hit on the real chip, round 3), so the
+            # int8 age window widens BEFORE the roll, not after.
+            wa = pltpu.roll(
+                wage[slot, c].astype(jnp.int32), shift=b - rot, axis=0
+            )
+            young_w = wa < spread
             payload = jnp.where(young_w & active_lane, w, -1)
             ok = ((flags >> c) & 1) != 0
             contrib = jnp.where(ok, payload, -1)

@@ -1,0 +1,67 @@
+"""Attribute per-feature cost in the sparse tick on the real chip.
+
+Round-3 regression hunt: bench landed 0.97M member rounds/s @ 32768 vs the
+round-2 1.17M — the delta appeared together with three protocol upgrades
+(round-robin FD cursor, bounded-window SYNC, last-k-senders suppression
+ring). This tool times the bench configuration with each feature toggled
+off so the regression can be attributed by measurement instead of blame.
+
+Usage: python tools/variant_times.py [n] [variants...]
+Variants: full, nowin (sync_window=0), noring (infected_k=0),
+          neither, pallas (full + fused kernel core).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+enable_repo_jax_cache()
+
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    kill_sparse,
+    run_sparse_chunked,
+)
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+variants = sys.argv[2:] or ["full", "nowin", "noring", "neither"]
+S, chunk = 2048, 48
+
+print("devices:", jax.devices(), file=sys.stderr)
+plan = FaultPlan.uniform(loss_percent=5.0)
+
+for v in variants:
+    sync_window = 0 if v in ("nowin", "neither") else 64
+    infected_k = 0 if v in ("noring", "neither") else 16
+    params = SparseParams.for_n(
+        n,
+        slot_budget=S,
+        in_scan_writeback=False,
+        pallas_core=(v == "pallas"),
+        sync_window=sync_window,
+    )
+    state = kill_sparse(init_sparse_full_view(n, S, infected_k=infected_k), 7)
+    # Warmup chunk = compile + steady state; then steady-state chunks only.
+    state, _ = run_sparse_chunked(params, state, plan, chunk, chunk, collect=False)
+    int(state.view_T[0, 0])
+    times = []
+    for _ in range(4):
+        t0 = time.perf_counter()
+        state, _ = run_sparse_chunked(params, state, plan, chunk, chunk, collect=False)
+        int(state.view_T[0, 0])
+        times.append(time.perf_counter() - t0)
+    ms = min(times) / chunk * 1e3
+    print(
+        f"{v:8s} sync_window={sync_window:3d} infected_k={infected_k:2d}: "
+        f"{ms:7.2f} ms/tick -> {n / ms * 1e3:,.0f} member·rounds/s "
+        f"(chunks: {' '.join(f'{t:.2f}' for t in times)})",
+        flush=True,
+    )
