@@ -44,16 +44,21 @@ import sys
 import time
 
 BASELINE_MEMBER_ROUNDS_PER_SEC = 1_000_000.0
-#: Largest-first ladder of (engine, n_members); first one that lands wins.
-#: ``sparse-pallas`` (the fused [N, S] kernel core) leads: if it lowers on
-#: the chip it should beat the XLA chain; if it fails the child dies and
-#: the ladder falls through to the proven plain-sparse rung.
-#: 32768 is the single-chip ceiling: above it XLA's compile of the sparse
-#: scan degenerates (>>8 min at 40960/49152, measured) even though the
-#: arrays would fit HBM — a child would burn its whole deadline, so bigger
-#: configs are not attempted. ``dense-xla`` rungs keep a measurement
-#: landing even if the fused Pallas kernel ever fails to lower on the
-#: target chip.
+#: Best-value-first ladder of (engine, n_members); first one that lands
+#: wins. ``sparse-pallas`` (the fused [N, S] kernel core) leads: if it
+#: lowers on the chip it beats the XLA chain; if it fails the child dies
+#: and the ladder falls through to the proven plain-sparse rung.
+#: 32768 is the VALUE-optimal rung, not a ceiling any more: the round-2
+#: >8-min compile degeneration at 40960/49152 was in the XLA tick chain
+#: — with the fused kernel replacing it, both compile in ~15 s and RUN on
+#: one chip (tools/compile_wall.py + tools/sparse_times.py, round 3), but
+#: per-tick cost grows super-linearly (23.4 ms @32768 → 35.3 ms @40960),
+#: so member·rounds/s peaks at 32768. ``dense-xla`` rungs keep a
+#: measurement landing even if the fused Pallas kernel ever fails to
+#: lower on the target chip.
+#: 40960/49152 are deliberately NOT rungs: a rung below the 32768 pair is
+#: only reached after sparse-pallas already failed at 32768 — it would
+#: fail identically at larger n and just burn child budget.
 LADDER = (
     ("sparse-pallas", 32768),
     ("sparse", 32768),

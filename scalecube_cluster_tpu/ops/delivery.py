@@ -125,6 +125,31 @@ def inv_from_structured(ginv, rots, n, group=GROUP):
     return inv.astype(jnp.int32)
 
 
+def perm_from_structured(ginv, rots, n, group=GROUP):
+    """Forward permutation ``perm[c, i]`` = sender i's c-th receiver.
+
+    The inverse of :func:`inv_from_structured` in closed form: only the
+    group permutation needs inverting (an argsort over ``n/group``
+    entries — [k, n/32] at the sparse engine's group, ~1000× smaller than
+    argsorting the full [k, N] ``inv``), the within-group rotation flips
+    sign. Satisfies ``perm[c, inv[c, j]] == j`` and vice versa.
+
+    Consumers (sim/usergossip.py::user_gossip_step_tracked) use it to
+    evaluate sender-side predicates like "does sender i's infected ring
+    name its own target?" as pure elementwise compares — the receiver-side
+    formulation needs a row-gather of the [N, G, k] ring per fan-out
+    channel, measured 5.2 ms/tick at n=32768 on a v5e chip
+    (tools/ring_profile.py) vs ~0 for this form.
+    """
+    ng = n // group
+    gfwd = jnp.argsort(ginv, axis=1).astype(jnp.int32)  # [k, ng]
+    i = jnp.arange(n, dtype=jnp.int32)
+    b = i // group
+    g = gfwd[:, b]  # [k, N] receiver group of sender i
+    rot = jnp.take_along_axis(rots, g, axis=1)
+    return (group * g + (i[None, :] - rot) % group).astype(jnp.int32)
+
+
 def permuted_delivery(rows, inv_perm, edge_ok):
     """Push delivery along permutation fan-out edges, receiver-side gathered.
 

@@ -79,6 +79,7 @@ from scalecube_cluster_tpu.cluster_api.member import MemberStatus
 from scalecube_cluster_tpu.ops.delivery import (
     GROUP,
     fanout_permutations_structured,
+    perm_from_structured,
 )
 from scalecube_cluster_tpu.sim.usergossip import (
     user_gossip_step,
@@ -924,6 +925,9 @@ def sparse_tick(
             alive,
             p.periods_to_spread,
             p.periods_to_sweep,
+            # Forward perm in closed form from the structured draw — the
+            # argsort fallback inside the step costs a full [f, N] sort.
+            perm=perm_from_structured(ginv, rots, n, group=group),
         )
     else:
         new_seen, uage, msgs_user = user_gossip_step(

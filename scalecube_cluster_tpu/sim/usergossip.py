@@ -49,7 +49,8 @@ def user_gossip_step(useen, uage, inv_perm, edge_ok, alive, spread, sweep):
 
 
 def user_gossip_step_tracked(
-    useen, uage, uinf_ids, uptr, inv_perm, edge_ok, alive, spread, sweep
+    useen, uage, uinf_ids, uptr, inv_perm, edge_ok, alive, spread, sweep,
+    perm=None,
 ):
     """Tracked variant: last-k-senders infected-set suppression.
 
@@ -65,6 +66,15 @@ def user_gossip_step_tracked(
     may be re-sent to) — delivery dedup/exactly-once is carried by
     ``useen`` exactly as in the untracked path.
 
+    ``perm`` is the FORWARD fan-out permutation (sender i's c-th receiver;
+    ops/delivery.py::perm_from_structured). With it the suppression check
+    "does sender i's ring name its own target" is a pure elementwise
+    compare against the [N, G, k] ring; without it (None) the same
+    predicate is evaluated via ``jnp.argsort(inv_perm)`` — the f per-tick
+    row-gathers of the ring that the receiver-side formulation needs were
+    measured at 5.2 of the ring's 6.9 ms/tick at n=32768 on a v5e chip
+    (tools/ring_profile.py).
+
     Returns ``(new_seen, new_age, uinf_ids, uptr, msgs_user [G])``.
     """
     n, g_slots = useen.shape
@@ -72,20 +82,28 @@ def user_gossip_step_tracked(
     f = inv_perm.shape[0]
     col = jnp.arange(n, dtype=jnp.int32)
     kr = jnp.arange(k, dtype=jnp.int32)
-    nonself = inv_perm != col[None, :]
+    if perm is None:
+        perm = jnp.argsort(inv_perm, axis=1).astype(jnp.int32)
     urows = useen & (uage < spread)
 
-    sent = []
+    # Sender-side send predicate (bit-identical to the receiver-side form
+    # composed with inv_perm; tests/test_sparse.py suppression crossvals
+    # are the oracle): sender i sends slot g to target perm[c, i] unless
+    # its ring already names that target.
+    sent_s = []
     for c in range(f):
-        s = inv_perm[c]  # sender feeding receiver `col` along edge c
-        # Does sender s know receiver col already holds slot g?
-        known = jnp.any(uinf_ids[s] == col[:, None, None], axis=2)  # [N, G]
-        sent.append(urows[s] & ~known & (alive[s] & nonself[c])[:, None])
-    msgs_user = sum(jnp.sum(c_sent, axis=0) for c_sent in sent)
+        tgt = perm[c]  # [N] sender i's receiver this channel
+        known = jnp.any(uinf_ids == tgt[:, None, None], axis=2)  # [N, G]
+        sent_s.append(urows & ~known & (alive & (tgt != col))[:, None])
+    msgs_user = sum(jnp.sum(c_sent, axis=0) for c_sent in sent_s)
 
     got = jnp.zeros_like(urows)
     for c in range(f):
-        arrived = sent[c] & edge_ok[c][:, None] & alive[:, None]  # [N, G]
+        # Receiver-side view of the channel: one cheap [N, G] row-gather
+        # (same granularity as the untracked path's delivery gathers).
+        arrived = (
+            sent_s[c][inv_perm[c]] & edge_ok[c][:, None] & alive[:, None]
+        )
         got = got | arrived
         sid = inv_perm[c]
         pos = jnp.mod(uptr, k)  # [N, G]

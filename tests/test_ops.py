@@ -221,3 +221,55 @@ def test_topk_jit_compatible(k):
     f = jax.jit(lambda r, m: masked_random_topk(r, m, k))
     idx, valid = f(jax.random.PRNGKey(0), mask)
     assert idx.shape == (4, k)
+
+
+def test_perm_from_structured_inverts_inv():
+    """perm_from_structured is the closed-form inverse of the structured
+    fan-out draw (ops/delivery.py): perm[c, inv[c, j]] == j for every
+    channel, receiver, and group size — the property the gather-free
+    suppression check in user_gossip_step_tracked rests on."""
+    import jax
+
+    from scalecube_cluster_tpu.ops.delivery import (
+        fanout_permutations_structured,
+        perm_from_structured,
+    )
+
+    for group, n in ((8, 64), (32, 256)):
+        inv, ginv, rots = fanout_permutations_structured(
+            jax.random.PRNGKey(3), n, 3, group=group
+        )
+        perm = perm_from_structured(ginv, rots, n, group=group)
+        j = jnp.arange(n)
+        for c in range(3):
+            assert jnp.array_equal(perm[c][inv[c]], j)
+            assert jnp.array_equal(inv[c][perm[c]], j)
+
+
+def test_tracked_user_gossip_perm_arg_is_bit_invisible():
+    """user_gossip_step_tracked(perm=...) must equal the perm=None
+    (argsort fallback) path bit-for-bit — same sends, same ring writes."""
+    import jax
+
+    from scalecube_cluster_tpu.ops.delivery import (
+        fanout_permutations_structured,
+        perm_from_structured,
+    )
+    from scalecube_cluster_tpu.sim.usergossip import user_gossip_step_tracked
+
+    n, G, K, f = 64, 3, 4, 3
+    ks = jax.random.split(jax.random.PRNGKey(7), 6)
+    useen = jax.random.bernoulli(ks[0], 0.4, (n, G))
+    uage = jax.random.randint(ks[1], (n, G), 0, 20)
+    uinf = jax.random.randint(ks[2], (n, G, K), -1, n)
+    uptr = jax.random.randint(ks[3], (n, G), 0, K)
+    inv, ginv, rots = fanout_permutations_structured(ks[4], n, f, group=8)
+    edge_ok = jax.random.bernoulli(ks[5], 0.9, (f, n))
+    alive = jnp.ones((n,), bool).at[5].set(False)
+    args = (useen, uage, uinf, uptr, inv, edge_ok, alive, 8, 18)
+    ref = user_gossip_step_tracked(*args)
+    out = user_gossip_step_tracked(
+        *args, perm=perm_from_structured(ginv, rots, n, group=8)
+    )
+    for a, b in zip(ref, out):
+        assert jnp.array_equal(a, b)

@@ -1,0 +1,112 @@
+"""Attribute the last-k suppression ring's on-chip cost op-by-op.
+
+Round-3 variant matrix (tools/variant_times.py): infected_k=16 costs
+~4.3 ms/tick at n=32768 — absurd for [N, 4, 16] int32 state (8 MiB).
+This times the tracked user-gossip step's pieces in isolation, each as a
+jitted scan over a chunk with the same feedback-sync methodology as the
+bench (PERF.md), so the pathological op can be named before redesign.
+
+Usage: python tools/ring_profile.py [n] [variant...]
+Variants: tracked (the engine path: sender-side check, closed-form perm),
+tracked_argsort (same step via the perm=None argsort fallback), untracked,
+gather (the f receiver-side row-gathers of [N,G,k] alone — the round-3
+pathology this tool caught), writes (the f ring writes alone, no gathers).
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+enable_repo_jax_cache()
+
+from scalecube_cluster_tpu.ops.delivery import (
+    fanout_permutations_structured,
+    perm_from_structured,
+)
+from scalecube_cluster_tpu.sim.usergossip import (
+    user_gossip_step,
+    user_gossip_step_tracked,
+)
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+variants = sys.argv[2:] or [
+    "tracked", "tracked_argsort", "untracked", "gather", "writes",
+]
+G, K, F, CHUNK = 4, 16, 3, 48
+
+print("devices:", jax.devices(), file=sys.stderr)
+
+
+def make_state(key):
+    ks = jax.random.split(key, 4)
+    useen = jax.random.bernoulli(ks[0], 0.3, (n, G))
+    uage = jax.random.randint(ks[1], (n, G), 0, 30)
+    uinf = jax.random.randint(ks[2], (n, G, K), -1, n // 2)
+    uptr = jax.random.randint(ks[3], (n, G), 0, K)
+    return useen, uage, uinf, uptr
+
+
+def step_fn(variant, carry, key):
+    useen, uage, uinf, uptr = carry
+    inv_perm, ginv, rots = fanout_permutations_structured(key, n, F, group=32)
+    edge_ok = jnp.ones((F, n), bool)
+    alive = jnp.ones((n,), bool)
+    if variant == "tracked":
+        # The engine path: closed-form forward perm, no ring gathers.
+        useen, uage, uinf, uptr, _ = user_gossip_step_tracked(
+            useen, uage, uinf, uptr, inv_perm, edge_ok, alive, 12, 26,
+            perm=perm_from_structured(ginv, rots, n, group=32),
+        )
+    elif variant == "tracked_argsort":
+        useen, uage, uinf, uptr, _ = user_gossip_step_tracked(
+            useen, uage, uinf, uptr, inv_perm, edge_ok, alive, 12, 26
+        )
+    elif variant == "untracked":
+        useen, uage, _ = user_gossip_step(
+            useen, uage, inv_perm, edge_ok, alive, 12, 26
+        )
+    elif variant == "gather":
+        col = jnp.arange(n, dtype=jnp.int32)
+        acc = jnp.zeros((n, G), bool)
+        for c in range(F):
+            s = inv_perm[c]
+            acc = acc | jnp.any(uinf[s] == col[:, None, None], axis=2)
+        useen = useen ^ acc
+    elif variant == "writes":
+        kr = jnp.arange(K, dtype=jnp.int32)
+        for c in range(F):
+            arrived = useen & (uage < 12)
+            pos = jnp.mod(uptr, K)
+            cell = (kr[None, None, :] == pos[:, :, None]) & arrived[:, :, None]
+            uinf = jnp.where(cell, inv_perm[c][:, None, None], uinf)
+            uptr = uptr + arrived.astype(jnp.int32)
+    return (useen, uage, uinf, uptr), None
+
+
+for variant in variants:
+    @partial(jax.jit, donate_argnums=(0,))
+    def chunk(carry, key, _v=variant):
+        keys = jax.random.split(key, CHUNK)
+        return jax.lax.scan(partial(step_fn, _v), carry, keys)[0]
+
+    carry = make_state(jax.random.PRNGKey(0))
+    carry = chunk(carry, jax.random.PRNGKey(1))
+    int(carry[2][0, 0, 0])  # sync off the big buffer
+    t0 = time.perf_counter()
+    reps = 4
+    for r in range(reps):
+        carry = chunk(carry, jax.random.PRNGKey(2 + r))
+        int(carry[2][0, 0, 0])
+    dt = time.perf_counter() - t0
+    print(
+        f"{variant:10s} {dt / (reps * CHUNK) * 1e3:7.3f} ms/step",
+        flush=True,
+    )
