@@ -106,7 +106,8 @@ class MembershipProtocol:
         self._table: dict[str, MembershipRecord] = {}
         self._members: dict[str, Member] = {}
         self._suspicion_tasks: dict[str, asyncio.Task] = {}
-        self._fetch_tasks: dict[str, asyncio.Task] = {}
+        #: member id -> (incarnation being fetched, fetch task)
+        self._fetch_tasks: dict[str, tuple[int, asyncio.Task]] = {}
         self._removed_history: deque[Member] = deque(
             maxlen=self._membership_config.removed_members_history_size
         )
@@ -138,7 +139,7 @@ class MembershipProtocol:
         for task in (
             self._tasks
             + list(self._suspicion_tasks.values())
-            + list(self._fetch_tasks.values())
+            + [entry[1] for entry in self._fetch_tasks.values()]
         ):
             task.cancel()
         self._tasks.clear()
@@ -458,31 +459,54 @@ class MembershipProtocol:
     def _on_alive_member_detected(
         self, r1: MembershipRecord, reason: UpdateReason
     ) -> None:
-        """An alive record overrode: cancel suspicion, gate visibility on a
-        metadata fetch, then emit ADDED or UPDATED (:518-543, 589-610)."""
-        self._cancel_suspicion(r1.member.id)
-        self._table[r1.member.id] = r1
-        if reason not in _NO_REGOSSIP:
-            self._spread_membership_gossip(r1)
+        """An alive record overrode: fetch metadata FIRST and apply the
+        record only on success (the reference's doOnSuccess, :518-543).
+
+        A failed fetch must leave NO table trace: the record would otherwise
+        block every later same-incarnation SYNC from re-triggering the fetch
+        and the member could never become visible (the one-way-partition
+        heal of MembershipProtocolTest.java:702-752 exercises exactly this).
+        Unlike the reference — which lets duplicate fetches race and relies
+        on the memberExists check — we keep at most one fetch in flight per
+        member, keyed by incarnation."""
+        pending = self._fetch_tasks.get(r1.member.id)
+        if pending is not None and pending[0] >= r1.incarnation:
+            return  # an equal-or-newer fetch is already in flight
         self._cancel_fetch(r1.member.id)
-        self._fetch_tasks[r1.member.id] = asyncio.create_task(
-            self._fetch_then_emit(r1.member)
+        self._fetch_tasks[r1.member.id] = (
+            r1.incarnation,
+            asyncio.create_task(self._fetch_then_emit(r1, reason)),
         )
 
-    async def _fetch_then_emit(self, member: Member) -> None:
+    async def _fetch_then_emit(
+        self, r1: MembershipRecord, reason: UpdateReason
+    ) -> None:
+        member = r1.member
         try:
             metadata = await self._metadata.fetch_metadata(member)
         except (asyncio.TimeoutError, ConnectionError, OSError) as exc:
-            # Member stays in the table but invisible; a later incarnation
-            # bump or sync retries the fetch (:534-541).
+            # Nothing applied; the next sync/gossip record retries (:534-541).
             logger.debug("%s: metadata fetch from %s failed: %s", self._local, member, exc)
             return
         finally:
             # Only deregister ourselves — a newer fetch may have replaced us.
-            if self._fetch_tasks.get(member.id) is asyncio.current_task():
+            entry = self._fetch_tasks.get(member.id)
+            if entry is not None and entry[1] is asyncio.current_task():
                 del self._fetch_tasks[member.id]
-        if member.id not in self._table:
-            return  # declared dead while we fetched
+        # Metadata arrived: member is alive — apply the record now
+        # (onAliveMemberDetected, :589-610). The table may have moved while
+        # we awaited (e.g. a SUSPECT at the same incarnation, which ALIVE
+        # must not clobber), so re-consult the merge rule; the reference
+        # puts unconditionally here, a race its own lattice forbids.
+        # Suspicion is deliberately NOT cancelled before this point: an
+        # unreachable member's refutation must not clear suspicion, so the
+        # cancel is gated on the fetch proving reachability (:534-541).
+        if not is_overrides(r1, self._table.get(member.id)):
+            return
+        self._cancel_suspicion(member.id)
+        self._table[member.id] = r1
+        if reason not in _NO_REGOSSIP:
+            self._spread_membership_gossip(r1)
         if member.id not in self._members:
             self._members[member.id] = member
             self._metadata.put_metadata(member, metadata)
@@ -490,7 +514,8 @@ class MembershipProtocol:
         else:
             old = self._metadata.put_metadata(member, metadata)
             self._members[member.id] = member
-            self._emit(MembershipEvent.updated(member, old, metadata))
+            if old != metadata:
+                self._emit(MembershipEvent.updated(member, old, metadata))
 
     # -- helpers --------------------------------------------------------------
 
@@ -500,9 +525,9 @@ class MembershipProtocol:
             task.cancel()
 
     def _cancel_fetch(self, member_id: str) -> None:
-        task = self._fetch_tasks.pop(member_id, None)
-        if task is not None:
-            task.cancel()
+        entry = self._fetch_tasks.pop(member_id, None)
+        if entry is not None:
+            entry[1].cancel()
 
     def _emit(self, event: MembershipEvent) -> None:
         logger.debug("%s: %s", self._local, event)

@@ -141,6 +141,127 @@ async def test_metadata_visible_to_all_members():
         await shutdown_all(*clusters)
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+@pytest.mark.asyncio
+async def test_join_self_seed_ignored():
+    """A node whose seed list names its OWN address (by two spellings) starts
+    as a 1-member cluster — it must not 'join' itself or hang waiting for a
+    foreign SYNC_ACK (ClusterTest.java:55-70)."""
+    from scalecube_cluster_tpu.testlib import fast_test_config
+    from scalecube_cluster_tpu.utils.address import Address
+
+    port = _free_port()
+    cfg = fast_test_config().transport(lambda t: t.with_(port=port))
+    node = await start_node(
+        cfg,
+        seeds=(Address("localhost", port), Address("127.0.0.1", port)),
+    )
+    try:
+        await asyncio.sleep(0.8)  # a few sync periods
+        assert node.other_members() == []
+        assert len(node.members()) == 1
+    finally:
+        await shutdown_all(node)
+
+
+@pytest.mark.asyncio
+async def test_join_self_seed_ignored_with_override():
+    """Same with an external-address override: advertised address == the only
+    seed entry still yields a clean 1-member start (ClusterTest.java:72-86)."""
+    from scalecube_cluster_tpu.testlib import fast_test_config
+    from scalecube_cluster_tpu.utils.address import Address
+
+    port = _free_port()
+    cfg = fast_test_config(
+        external_host="localhost", external_port=port
+    ).transport(lambda t: t.with_(port=port))
+    node = await start_node(cfg, seeds=(Address("localhost", port),))
+    try:
+        await asyncio.sleep(0.8)
+        assert node.other_members() == []
+    finally:
+        await shutdown_all(node)
+
+
+@pytest.mark.asyncio
+async def test_metadata_property_update_and_remove():
+    """Changing one key and then dropping another in the metadata map is
+    observed by every other node after UPDATED (ClusterTest.java:193-356)."""
+    seed = await start_node()
+    meta_node = await start_node(
+        seeds=(seed.address,), metadata={"key1": "value1", "key2": "value2"}
+    )
+    a = await start_node(seeds=(seed.address,))
+    b = await start_node(seeds=(seed.address,))
+    watchers = [seed, a, b]
+    try:
+        await await_until(
+            lambda: all(len(c.members()) == 4 for c in watchers + [meta_node]),
+            timeout=10,
+        )
+        mid = meta_node.member().id
+
+        def seen_by_all(expect: dict) -> bool:
+            return all(
+                c.member_by_id(mid) is not None
+                and c.metadata(c.member_by_id(mid)) == expect
+                for c in watchers
+            )
+
+        await await_until(
+            lambda: seen_by_all({"key1": "value1", "key2": "value2"}), timeout=10
+        )
+        await meta_node.update_metadata({"key1": "value1", "key2": "value3"})
+        await await_until(
+            lambda: seen_by_all({"key1": "value1", "key2": "value3"}), timeout=10
+        )
+        await meta_node.update_metadata({"key2": "value3"})
+        await await_until(lambda: seen_by_all({"key2": "value3"}), timeout=10)
+    finally:
+        await shutdown_all(seed, meta_node, a, b)
+
+
+@pytest.mark.asyncio
+async def test_member_metadata_removed_on_shutdown():
+    """When a member leaves, observers get REMOVED carrying its last-known
+    metadata, and the metadata cache drops it (ClusterTest.java:401-470)."""
+    removed_events = []
+
+    class Recorder(ClusterMessageHandler):
+        def on_membership_event(self, event):
+            if event.is_removed:
+                removed_events.append(event)
+
+    seed = await start_node(metadata={"seed": "shmid"}, handler=Recorder())
+    node1 = await start_node(seeds=(seed.address,), metadata={"node": "shmod"})
+    try:
+        await await_until(
+            lambda: len(seed.members()) == 2 and len(node1.members()) == 2,
+            timeout=10,
+        )
+        node1_member = node1.member()
+        assert seed.metadata(seed.member_by_id(node1_member.id)) == {
+            "node": "shmod"
+        }
+        await node1.shutdown()
+        await await_until(lambda: len(removed_events) == 1, timeout=10)
+        event = removed_events[0]
+        assert event.member.id == node1_member.id
+        assert event.old_metadata == {"node": "shmod"}
+        assert seed.member_by_id(node1_member.id) is None
+    finally:
+        await shutdown_all(seed, node1)
+
+
 @pytest.mark.asyncio
 async def test_seedless_seed_startup():
     """A node seeded with its own address starts cleanly as a 1-member

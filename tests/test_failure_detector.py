@@ -135,6 +135,95 @@ async def test_ping_req_rescues_one_bad_link():
 
 
 @pytest.mark.asyncio
+async def test_suspected_member_with_bad_network_gets_partitioned():
+    """A blocks ALL its outbound: A suspects everyone (its pings and its acks
+    never leave), everyone suspects A; after unblock all verdicts return to
+    ALIVE (FailureDetectorTest.java:180-236)."""
+    a, b, c, d = nodes = await make_nodes(4)
+    try:
+        a.transport.network_emulator.block_all_outbound()
+        for node in nodes:
+            node.statuses.clear()
+        await await_until(
+            lambda: saw_all(a, nodes, MemberStatus.SUSPECT)
+            and all(
+                n.statuses.get(a.member.id) is MemberStatus.SUSPECT
+                for n in (b, c, d)
+            ),
+            timeout=8,
+        )
+        a.transport.network_emulator.unblock_all_outbound()
+        for node in nodes:
+            node.statuses.clear()
+        await await_until(
+            lambda: all(saw_all(n, nodes, MemberStatus.ALIVE) for n in nodes),
+            timeout=8,
+        )
+    finally:
+        await stop_nodes(nodes)
+
+
+@pytest.mark.asyncio
+async def test_suspected_member_with_normal_network_gets_partitioned():
+    """Everyone blocks outbound TO D (D's own network is fine): A/B/C suspect
+    D, and D suspects A/B/C — their acks to D's pings ride their blocked
+    outbound. Unblock returns every verdict to ALIVE
+    (FailureDetectorTest.java:239-300)."""
+    a, b, c, d = nodes = await make_nodes(4)
+    try:
+        for node in (a, b, c):
+            node.transport.network_emulator.block_outbound(d.transport.address)
+        for node in nodes:
+            node.statuses.clear()
+        await await_until(
+            lambda: all(
+                n.statuses.get(d.member.id) is MemberStatus.SUSPECT
+                for n in (a, b, c)
+            )
+            and saw_all(d, nodes, MemberStatus.SUSPECT),
+            timeout=8,
+        )
+        for node in (a, b, c):
+            node.transport.network_emulator.unblock_all_outbound()
+        for node in nodes:
+            node.statuses.clear()
+        await await_until(
+            lambda: all(saw_all(n, nodes, MemberStatus.ALIVE) for n in nodes),
+            timeout=8,
+        )
+    finally:
+        await stop_nodes(nodes)
+
+
+@pytest.mark.asyncio
+async def test_status_change_after_network_recovery():
+    """Mutual outbound block between two nodes → mutual SUSPECT; unblock →
+    both recover to ALIVE (FailureDetectorTest.java:302-341)."""
+    a, b = nodes = await make_nodes(2)
+    try:
+        a.transport.network_emulator.block_outbound(b.transport.address)
+        b.transport.network_emulator.block_outbound(a.transport.address)
+        a.statuses.clear()
+        b.statuses.clear()
+        await await_until(
+            lambda: a.statuses.get(b.member.id) is MemberStatus.SUSPECT
+            and b.statuses.get(a.member.id) is MemberStatus.SUSPECT,
+            timeout=6,
+        )
+        a.transport.network_emulator.unblock_all_outbound()
+        b.transport.network_emulator.unblock_all_outbound()
+        a.statuses.clear()
+        b.statuses.clear()
+        await await_until(
+            lambda: a.statuses.get(b.member.id) is MemberStatus.ALIVE
+            and b.statuses.get(a.member.id) is MemberStatus.ALIVE,
+            timeout=6,
+        )
+    finally:
+        await stop_nodes(nodes)
+
+
+@pytest.mark.asyncio
 async def test_restarted_process_detected_as_dead():
     """A process restarted at the same address answers with a new member id:
     the ack is DEST_GONE and the old identity goes DEAD

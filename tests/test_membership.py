@@ -117,6 +117,133 @@ async def test_double_partition_and_heal():
 
 
 @pytest.mark.asyncio
+async def test_all_nodes_lose_outbound_then_recover():
+    """EVERY node blocks all outbound: each keeps itself trusted, suspects
+    both peers, and nobody is removed before the links heal; unblocking
+    clears every suspicion (MembershipProtocolTest.java:266-319)."""
+    # Long suspicion timeout so the blackout phase cannot progress to
+    # removal on a slow machine — the scenario is suspect-then-recover.
+    cfg = lambda: fast_test_config().membership(
+        lambda m: m.with_(suspicion_mult=40)
+    )
+    a = await start_node(cfg())
+    b = await start_node(cfg(), seeds=(a.address,))
+    c = await start_node(cfg(), seeds=(a.address,))
+    nodes = [a, b, c]
+    try:
+        await await_until(lambda: views_converged(nodes, 3), timeout=10)
+        for u in nodes:
+            u.network_emulator.block_all_outbound()
+        await await_until(
+            lambda: all(len(u.monitor().suspected_members) == 2 for u in nodes),
+            timeout=10,
+        )
+        # Suspicion, not eviction: views still hold all three members.
+        assert views_converged(nodes, 3)
+        for u in nodes:
+            u.network_emulator.unblock_all_outbound()
+        await await_until(
+            lambda: views_converged(nodes, 3)
+            and all(not u.monitor().suspected_members for u in nodes),
+            timeout=15,
+        )
+    finally:
+        await shutdown_all(*nodes)
+
+
+@pytest.mark.asyncio
+async def test_no_inbound_partition_removed_then_inbound_recovers():
+    """C blocks ALL inbound: its outbound SYNCs still reach the others but
+    nothing gets back in, so both sides remove each other after the
+    suspicion timeout (repeated one-way SYNCs must NOT re-admit C, because
+    ADDED is metadata-fetch-gated and the fetch cannot reach C); restoring
+    inbound heals the full 3-view on every node
+    (MembershipProtocolTest.java:702-752)."""
+    a = await start_node()
+    b = await start_node(seeds=(a.address,))
+    c = await start_node(seeds=(a.address,))
+    nodes = [a, b, c]
+    try:
+        await await_until(lambda: views_converged(nodes, 3), timeout=10)
+        c.network_emulator.block_all_inbound()
+        settle = suspicion_settle_time(3)
+        await await_until(
+            lambda: len(a.members()) == 2
+            and len(b.members()) == 2
+            and len(c.members()) == 1,
+            timeout=settle + 10,
+        )
+        c_id = c.member().id
+        assert c_id in {m.id for m in a.monitor().removed_members}
+        assert {m.id for m in c.monitor().removed_members} == {
+            a.member().id,
+            b.member().id,
+        }
+        # One-way SYNCs from C keep arriving the whole time; give them a
+        # moment to prove they do not resurrect C without a metadata path.
+        await asyncio.sleep(1.0)
+        assert len(a.members()) == 2
+        c.network_emulator.unblock_all_inbound()
+        await await_until(lambda: views_converged(nodes, 3), timeout=20)
+    finally:
+        await shutdown_all(*nodes)
+
+
+@pytest.mark.parametrize("direction", ["inbound", "outbound", "both"])
+@pytest.mark.asyncio
+async def test_pairwise_link_partition_does_not_evict(direction):
+    """A broken B<->C link (inbound, outbound, or both at C) evicts nobody:
+    ping-req relays through A keep the failure detector quiet and gossip/
+    SYNC via A keeps all views complete
+    (MembershipProtocolTest.java:754-843)."""
+    a = await start_node()
+    b = await start_node(seeds=(a.address,))
+    c = await start_node(seeds=(a.address,))
+    nodes = [a, b, c]
+    try:
+        await await_until(lambda: views_converged(nodes, 3), timeout=10)
+        if direction in ("inbound", "both"):
+            c.network_emulator.block_inbound(b.address)
+        if direction in ("outbound", "both"):
+            c.network_emulator.block_outbound(b.address)
+        await asyncio.sleep(suspicion_settle_time(3))
+        assert views_converged(nodes, 3), (
+            f"pairwise {direction} block must not evict any member"
+        )
+    finally:
+        await shutdown_all(*nodes)
+
+
+@pytest.mark.asyncio
+async def test_restart_stopped_members_on_new_ports():
+    """Stop two members, restart them on fresh ports: the old identities are
+    removed and the new ones join, converging to a full view of new ids
+    (MembershipProtocolTest.java:374-452)."""
+    a = await start_node()
+    b = await start_node(seeds=(a.address,))
+    c = await start_node(seeds=(a.address,))
+    d = await start_node(seeds=(a.address,))
+    try:
+        await await_until(lambda: views_converged([a, b, c, d], 4), timeout=10)
+        old_ids = {c.member().id, d.member().id}
+        await shutdown_all(c, d)
+        await await_until(
+            lambda: len(a.members()) == 2 and len(b.members()) == 2, timeout=15
+        )
+        c2 = await start_node(seeds=(a.address,))
+        d2 = await start_node(seeds=(a.address,))
+        nodes = [a, b, c2, d2]
+        await await_until(lambda: views_converged(nodes, 4), timeout=15)
+        for u in nodes:
+            ids = {m.id for m in u.members()}
+            assert not (ids & old_ids), "old identities must stay removed"
+            assert {c2.member().id, d2.member().id} <= ids
+        await shutdown_all(c2, d2)
+    finally:
+        await shutdown_all(a, b)
+
+
+@pytest.mark.asyncio
 async def test_heterogeneous_fd_timings_stay_alive():
     """Nodes running different ping intervals/timeouts still converge with
     no false suspicion (FailureDetectorTest.java:149-177)."""

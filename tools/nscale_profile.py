@@ -1,0 +1,154 @@
+"""Why does the sparse-pallas tick scale super-linearly in n?
+
+PERF.md round 3: 23.4 ms @32768 -> 35.3 @40960 -> 42.7 @49152 with S fixed
+at 2048 — per-member cost rises 0.71 -> 0.86 -> 0.87 µs. The kernel's grid
+is linear in n, so the growth lives somewhere else. This times, per n, each
+candidate in isolation with the bench methodology (jitted chunk scans,
+feed-back dependency, large-buffer sync):
+
+  full    — the engine tick (run_sparse_chunked, pallas_core=True)
+  kernel  — sparse_core_pallas alone under a scan
+  select  — fanout_permutations_structured + perm_from_structured + link draws
+  ring    — user_gossip_step_tracked alone (sender-side form)
+
+Usage: python tools/nscale_profile.py [piece...] [-- n...]
+Default pieces: full kernel select ring; default n: 24576 32768 40960 49152
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+enable_repo_jax_cache()
+
+from scalecube_cluster_tpu.ops.delivery import (
+    fanout_permutations_structured,
+    perm_from_structured,
+)
+from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    kill_sparse,
+    run_sparse_chunked,
+)
+from scalecube_cluster_tpu.sim.state import AGE_STALE
+from scalecube_cluster_tpu.sim.usergossip import user_gossip_step_tracked
+
+args = sys.argv[1:]
+ns = [24576, 32768, 40960, 49152]
+if "--" in args:
+    i = args.index("--")
+    ns = [int(a) for a in args[i + 1 :]]
+    args = args[:i]
+pieces = args or ["full", "kernel", "select", "ring"]
+S, CHUNK, REPS, F, G, K = 2048, 48, 3, 3, 4, 16
+
+print("devices:", jax.devices(), file=sys.stderr)
+plan = FaultPlan.uniform(loss_percent=5.0)
+
+
+def timed_scan(step, carry0, label, n):
+    """jit a CHUNK-long scan of ``step``, feed carry back, steady-state min."""
+    fn = jax.jit(
+        lambda carry: jax.lax.scan(
+            step, carry, jax.random.split(jax.random.key(0), CHUNK)
+        )[0]
+    )
+    carry = fn(carry0)
+    jax.block_until_ready(carry)
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        carry = fn(carry)
+        jax.block_until_ready(carry)
+        times.append(time.perf_counter() - t0)
+    ms = min(times) / CHUNK * 1e3
+    print(f"n={n:6d} {label:7s}: {ms:7.3f} ms/tick  ({ms / n * 1e6:6.3f} ns/member)",
+          flush=True)
+
+
+for n in ns:
+    params = SparseParams.for_n(n, slot_budget=S, in_scan_writeback=False,
+                                pallas_core=True)
+    p = params.base
+
+    if "full" in pieces:
+        state = kill_sparse(init_sparse_full_view(n, S), 7)
+        state, _ = run_sparse_chunked(params, state, plan, CHUNK, CHUNK, collect=False)
+        int(state.view_T[0, 0])
+        times = []
+        for _ in range(REPS):
+            t0 = time.perf_counter()
+            state, _ = run_sparse_chunked(params, state, plan, CHUNK, CHUNK,
+                                          collect=False)
+            int(state.view_T[0, 0])
+            times.append(time.perf_counter() - t0)
+        ms = min(times) / CHUNK * 1e3
+        print(f"n={n:6d} full   : {ms:7.3f} ms/tick  ({ms / n * 1e6:6.3f} ns/member)",
+              flush=True)
+        del state
+
+    if "kernel" in pieces:
+        from scalecube_cluster_tpu.ops.pallas_sparse import sparse_core_pallas
+
+        ks = jax.random.split(jax.random.key(1), 4)
+        slab0 = jax.random.randint(ks[0], (n, S), 0, 1 << 20, jnp.int32)
+        age0 = jax.random.randint(ks[1], (n, S), 0, 30).astype(jnp.int8)
+        susp0 = jnp.zeros((n, S), jnp.int16)
+        slot_subj = jnp.arange(S, dtype=jnp.int32)
+        none_slot = jnp.full((n,), -1, jnp.int32)
+
+        def kstep(carry, key):
+            slab, age, susp = carry
+            _, ginv, rots = fanout_permutations_structured(key, n, F, group=32)
+            edge_ok = jax.random.bernoulli(key, 0.95, (F, n))
+            slab, age, susp, _ = sparse_core_pallas(
+                slab, age, susp, slot_subj, ginv, rots, edge_ok,
+                jnp.ones((n,), bool), none_slot, none_slot,
+                spread=p.periods_to_spread, susp_ticks=p.suspicion_ticks,
+                age_stale=AGE_STALE,
+            )
+            return (slab, age, susp), None
+
+        timed_scan(kstep, (slab0, age0, susp0), "kernel", n)
+
+    if "select" in pieces:
+        col = jnp.arange(n, dtype=jnp.int32)
+
+        def sstep(carry, key):
+            acc = carry
+            _, ginv, rots = fanout_permutations_structured(key, n, F, group=32)
+            perm = perm_from_structured(ginv, rots, n, group=32)
+            k1, k2 = jax.random.split(key)
+            ok = link_pass(k1, plan, col, perm[0])
+            acc = acc ^ ginv[0] ^ rots ^ perm[-1] ^ ok.astype(jnp.int32)
+            return acc, None
+
+        timed_scan(sstep, jnp.zeros((n,), jnp.int32), "select", n)
+
+    if "ring" in pieces:
+        ks = jax.random.split(jax.random.key(2), 4)
+        useen0 = jax.random.bernoulli(ks[0], 0.3, (n, G))
+        uage0 = jax.random.randint(ks[1], (n, G), 0, 30)
+        uinf0 = jax.random.randint(ks[2], (n, G, K), -1, n // 2)
+        uptr0 = jax.random.randint(ks[3], (n, G), 0, K)
+
+        def rstep(carry, key):
+            useen, uage, uinf, uptr = carry
+            inv_perm, ginv, rots = fanout_permutations_structured(key, n, F, group=32)
+            useen, uage, uinf, uptr, _ = user_gossip_step_tracked(
+                useen, uage, uinf, uptr, inv_perm,
+                jnp.ones((F, n), bool), jnp.ones((n,), bool), 12, 26,
+                perm=perm_from_structured(ginv, rots, n, group=32),
+            )
+            return (useen, uage, uinf, uptr), None
+
+        timed_scan(rstep, (useen0, uage0, uinf0, uptr0), "ring", n)
