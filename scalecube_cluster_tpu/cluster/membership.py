@@ -494,10 +494,15 @@ class MembershipProtocol:
             if entry is not None and entry[1] is asyncio.current_task():
                 del self._fetch_tasks[member.id]
         # Metadata arrived: member is alive — apply the record now
-        # (onAliveMemberDetected, :589-610). The table may have moved while
-        # we awaited (e.g. a SUSPECT at the same incarnation, which ALIVE
-        # must not clobber), so re-consult the merge rule; the reference
-        # puts unconditionally here, a race its own lattice forbids.
+        # (onAliveMemberDetected, :589-610). For a KNOWN member the table
+        # may have moved while we awaited (e.g. a SUSPECT at the same
+        # incarnation, which ALIVE must not clobber), so re-consult the
+        # merge rule; the reference puts unconditionally here, a race its
+        # own lattice forbids. For a FIRST-JOIN member there is no table
+        # entry, so a SUSPECT/DEAD rumor arriving mid-fetch was dropped by
+        # isOverrides(r1, None)==isAlive (MembershipRecord.java:67-69) and
+        # the ALIVE applies — identical to the reference, whose FD/
+        # suspicion cycle then re-detects a genuinely dead member.
         # Suspicion is deliberately NOT cancelled before this point: an
         # unreachable member's refutation must not clear suspicion, so the
         # cancel is gated on the fetch proving reachability (:534-541).

@@ -2,7 +2,9 @@
 
 Ports the core of MembershipProtocolTest.java:40-1086: 3-node joins,
 outbound-block partitions with suspicion-timeout removal and recovery,
-restart at the same port, seed-chain joins, sync-group isolation, and
+all-nodes-outbound blackout, one-way (inbound) partitions with removal and
+rejoin, pairwise-link partitions that must evict nobody, restart at the
+same port and on fresh ports, seed-chain joins, sync-group isolation, and
 self-refutation (incarnation bump) under false suspicion.
 """
 
@@ -223,24 +225,27 @@ async def test_restart_stopped_members_on_new_ports():
     b = await start_node(seeds=(a.address,))
     c = await start_node(seeds=(a.address,))
     d = await start_node(seeds=(a.address,))
+    live = [a, b, c, d]
     try:
         await await_until(lambda: views_converged([a, b, c, d], 4), timeout=10)
         old_ids = {c.member().id, d.member().id}
         await shutdown_all(c, d)
+        live = [a, b]
         await await_until(
             lambda: len(a.members()) == 2 and len(b.members()) == 2, timeout=15
         )
         c2 = await start_node(seeds=(a.address,))
+        live.append(c2)
         d2 = await start_node(seeds=(a.address,))
+        live.append(d2)
         nodes = [a, b, c2, d2]
         await await_until(lambda: views_converged(nodes, 4), timeout=15)
         for u in nodes:
             ids = {m.id for m in u.members()}
             assert not (ids & old_ids), "old identities must stay removed"
             assert {c2.member().id, d2.member().id} <= ids
-        await shutdown_all(c2, d2)
     finally:
-        await shutdown_all(a, b)
+        await shutdown_all(*live)
 
 
 @pytest.mark.asyncio

@@ -66,6 +66,19 @@ async def test_send_to_unreachable_fails():
 
 
 @pytest.mark.asyncio
+async def test_send_to_unresolved_host_fails():
+    """A hostname that cannot resolve surfaces as an error on the send path,
+    not a hang (TransportTest.java:43-55)."""
+    a = await bind()
+    try:
+        ghost = Address("wrong-host.invalid", 5000)  # RFC 2606 reserved TLD
+        with pytest.raises((ConnectionError, OSError, asyncio.TimeoutError)):
+            await a.send(ghost, Message.create(qualifier="x", sender=a.address))
+    finally:
+        await a.stop()
+
+
+@pytest.mark.asyncio
 async def test_request_response_timeout():
     a, b = await bind(), await bind()  # b never answers
     try:

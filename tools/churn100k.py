@@ -28,8 +28,9 @@ ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 96
 row = sparse_churn_scenario(n=n, churn_per_chunk=1024, ticks=ticks)
 row["backend"] = "cpu"
 row["note"] = (
-    "100k churn config; CPU host (dense cold view exceeds one chip's HBM; "
-    "TPU path at this n is the 8-device mesh, __graft_entry__.dryrun_sparse)"
+    f"churn config at n={n} (BASELINE names 100k), ticks={ticks}; CPU host "
+    "(the [N, N] cold view exceeds one chip's HBM at this n; the TPU path "
+    "is the 8-device mesh, __graft_entry__.dryrun_sparse)"
 )
 print(json.dumps(row), flush=True)
 with open(os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
