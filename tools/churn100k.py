@@ -1,11 +1,12 @@
 """BASELINE 100k-member churn row (VERDICT round-2 weak #5, round-3 grid).
 
 Runs sparse_churn_scenario at n=102400 — the BASELINE.json "100k-member
-churn" config — on whatever backend is available (the [N, N] cold view is
-42 GB, far beyond one v5e chip's HBM, so in practice this is the CPU host
-with the backend marked in the row; the TPU path at this n is the 8-device
-mesh, certified by __graft_entry__.dryrun_sparse). Appends the row to
-EXPERIMENTS_r3.jsonl.
+churn" config — pinned to the CPU host backend: the [N, N] cold view is
+42 GB, far beyond one v5e chip's HBM (the TPU path at this n is the
+8-device mesh, certified by __graft_entry__.dryrun_sparse). Appends the
+row to EXPERIMENTS_r3.jsonl. NOTE: the scan-wrapped tick chain's compile
+degenerates at this n — tools/churn100k_eager.py is the driver that
+actually completes; this one is kept for sub-40k rows.
 
 Usage: python tools/churn100k.py [n] [ticks]
 """
@@ -19,6 +20,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+enable_repo_jax_cache()
 
 from scalecube_cluster_tpu.experiments.scenarios import sparse_churn_scenario
 

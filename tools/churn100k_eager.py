@@ -1,0 +1,124 @@
+"""100k churn row, eager per-tick fallback (no lax.scan).
+
+The scan-wrapped XLA tick chain's compile degenerates somewhere past
+n=40960 (PERF.md "Ceiling"; round-3 showed the fused-kernel scan escapes
+it on TPU, but the kernel doesn't lower on CPU outside interpret mode).
+A SINGLE jitted tick ("tick1" in tools/compile_wall.py) never hit the
+wall, so this driver steps jit(sparse_tick) in a Python loop — identical
+protocol semantics, chunk-boundary slot frees via writeback_free, just
+host-side loop control — and appends the churn row with slot_overflow
+stats to EXPERIMENTS_r3.jsonl.
+
+Usage: python tools/churn100k_eager.py [n] [ticks] [chunk]
+"""
+
+import json
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+enable_repo_jax_cache()
+
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    kill_sparse,
+    restart_many_sparse,
+    sparse_tick,
+    writeback_free,
+)
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 102400
+ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 96
+chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 48
+churn_per_chunk = 1024
+
+params = SparseParams.for_n(n, in_scan_writeback=False)
+state = init_sparse_full_view(n, params.slot_budget)
+plan = FaultPlan.uniform(loss_percent=1.0)
+rng = np.random.default_rng(0)
+
+tick_fn = jax.jit(partial(sparse_tick, params, collect=True), donate_argnums=(0,))
+
+down: set[int] = set()
+max_overflow = 0.0
+sum_overflow = 0.0
+dt = 0.0
+done = 0
+t_all = time.perf_counter()
+while done < ticks:
+    kills = rng.choice(
+        [i for i in range(2, n) if i not in down],
+        size=churn_per_chunk,
+        replace=False,
+    )
+    state = kill_sparse(state, jnp.asarray(kills))
+    down.update(int(i) for i in kills)
+    revive = list(down)[: churn_per_chunk // 2]
+    state = restart_many_sparse(state, revive)
+    down.difference_update(revive)
+    int(state.view_T[0, 0])  # settle host ops before the timed chunk
+    t0 = time.perf_counter()
+    for i in range(chunk):
+        state, metrics = tick_fn(state, plan)
+        overflow = float(metrics["slot_overflow"])
+        max_overflow = max(max_overflow, overflow)
+        sum_overflow += overflow
+        if i % 8 == 0:
+            print(
+                f"  tick {int(metrics['tick'])} "
+                f"({(time.perf_counter() - t_all) / 60:.1f} min)",
+                flush=True,
+            )
+    state = writeback_free(params, state)
+    int(state.view_T[0, 0])
+    dt += time.perf_counter() - t0
+    done += chunk
+    print(
+        f"chunk done: tick={int(state.tick)} overflow_total={sum_overflow:.0f} "
+        f"active={int(jnp.sum(state.slot_subj >= 0))} "
+        f"({(time.perf_counter() - t_all) / 60:.1f} min elapsed)",
+        flush=True,
+    )
+
+row = {
+    "scenario": "sparse_churn",
+    "n": n,
+    "churn_per_chunk": churn_per_chunk,
+    "ticks": done,
+    "churned_down": len(down),
+    "slot_overflow_max_per_tick": max_overflow,
+    "slot_overflow_total": sum_overflow,
+    "active_slots": int(jnp.sum(state.slot_subj >= 0)),
+    "slot_budget": params.slot_budget,
+    "member_rounds_per_sec": round(n * done / dt, 1),
+    "backend": "cpu",
+    "note": (
+        f"churn at n={n} (BASELINE 100k config), eager per-tick driver "
+        "(tools/churn100k_eager.py): the scan-wrapped XLA chain's compile "
+        "degenerates at this n; single-tick jit does not. First tick "
+        "includes compile; throughput here is a CPU floor, not a TPU number."
+    ),
+}
+print(json.dumps(row), flush=True)
+with open(
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "EXPERIMENTS_r3.jsonl",
+    ),
+    "a",
+) as fh:
+    fh.write(json.dumps(row) + "\n")
