@@ -53,9 +53,12 @@ rng = np.random.default_rng(0)
 
 tick_fn = jax.jit(partial(sparse_tick, params, collect=True), donate_argnums=(0,))
 
+# NOTE: this loop mirrors experiments/scenarios.py::sparse_churn_scenario's
+# churn policy (kill selection, revive fraction, chunk cadence) with a
+# different tick driver; a change to the policy there must be mirrored here
+# or the two "sparse_churn" row flavors diverge.
 down: set[int] = set()
-max_overflow = 0.0
-sum_overflow = 0.0
+overflow_per_tick: list = []
 dt = 0.0
 done = 0
 t_all = time.perf_counter()
@@ -73,13 +76,14 @@ while done < ticks:
     int(state.view_T[0, 0])  # settle host ops before the timed chunk
     t0 = time.perf_counter()
     for i in range(chunk):
+        # Keep device arrays in a list and fetch AFTER the timed region —
+        # a per-tick float() would serialize async dispatch and bias the
+        # published throughput low vs the scan-driver rows.
         state, metrics = tick_fn(state, plan)
-        overflow = float(metrics["slot_overflow"])
-        max_overflow = max(max_overflow, overflow)
-        sum_overflow += overflow
+        overflow_per_tick.append(metrics["slot_overflow"])
         if i % 8 == 0:
             print(
-                f"  tick {int(metrics['tick'])} "
+                f"  tick {i} of chunk at done={done} "
                 f"({(time.perf_counter() - t_all) / 60:.1f} min)",
                 flush=True,
             )
@@ -88,11 +92,15 @@ while done < ticks:
     dt += time.perf_counter() - t0
     done += chunk
     print(
-        f"chunk done: tick={int(state.tick)} overflow_total={sum_overflow:.0f} "
+        f"chunk done: tick={int(state.tick)} "
         f"active={int(jnp.sum(state.slot_subj >= 0))} "
         f"({(time.perf_counter() - t_all) / 60:.1f} min elapsed)",
         flush=True,
     )
+
+overflow_arr = np.asarray([float(o) for o in overflow_per_tick])
+max_overflow = float(overflow_arr.max()) if overflow_arr.size else 0.0
+sum_overflow = float(overflow_arr.sum())
 
 row = {
     "scenario": "sparse_churn",
@@ -107,10 +115,12 @@ row = {
     "member_rounds_per_sec": round(n * done / dt, 1),
     "backend": "cpu",
     "note": (
-        f"churn at n={n} (BASELINE 100k config), eager per-tick driver "
-        "(tools/churn100k_eager.py): the scan-wrapped XLA chain's compile "
-        "degenerates at this n; single-tick jit does not. First tick "
-        "includes compile; throughput here is a CPU floor, not a TPU number."
+        f"churn at n={n}"
+        + (" (the BASELINE 100k config)" if n == 102400 else "")
+        + ", eager per-tick driver (tools/churn100k_eager.py): the "
+        "scan-wrapped XLA chain's compile degenerates at this n; "
+        "single-tick jit does not. First tick includes compile; throughput "
+        "here is a CPU floor, not a TPU number."
     ),
 }
 print(json.dumps(row), flush=True)
