@@ -249,6 +249,51 @@ async def test_restart_stopped_members_on_new_ports():
 
 
 @pytest.mark.asyncio
+async def test_failed_metadata_fetch_retried_by_later_sync():
+    """A failed metadata fetch must leave no table trace, so a LATER record
+    at the SAME incarnation re-triggers the fetch and the member becomes
+    visible (the reference applies ALIVE records only in fetchMetadata's
+    doOnSuccess, MembershipProtocolImpl.java:518-543 — regression test for
+    the round-3 fix where a pre-fetch table write blocked every retry)."""
+    # FD probing disabled (one-hour ping interval): C must stay a plain
+    # ALIVE-at-incarnation-0 record everywhere, so D's admission can only
+    # come from a retried fetch on a SAME-incarnation record — the exact
+    # regression path (a SUSPECT rumor would route admission through the
+    # refutation/incarnation-bump channel instead and mask it).
+    cfg = lambda: fast_test_config().failure_detector(
+        lambda f: f.with_(ping_interval=3_600_000)
+    )
+    a = await start_node(cfg())
+    b = await start_node(cfg(), seeds=(a.address,))
+    c = await start_node(cfg(), seeds=(a.address,), metadata={"who": "c"})
+    live = [a, b, c]
+    try:
+        await await_until(lambda: views_converged([a, b, c], 3), timeout=10)
+        # C goes inbound-dark BEFORE D exists: D's entire knowledge of C
+        # arrives as same-incarnation records from A/B, and every metadata
+        # fetch D sends C fails.
+        c.network_emulator.block_all_inbound()
+        d = await start_node(cfg(), seeds=(a.address,))
+        live.append(d)
+        await await_until(
+            lambda: d.member_by_id(a.member().id) is not None
+            and d.member_by_id(b.member().id) is not None,
+            timeout=10,
+        )
+        await asyncio.sleep(1.5)  # several sync periods of failed fetches
+        assert d.member_by_id(c.member().id) is None
+        # Heal the metadata path: the next same-incarnation record from
+        # A/B's SYNC must retry the fetch and admit C at D.
+        c.network_emulator.unblock_all_inbound()
+        await await_until(
+            lambda: d.member_by_id(c.member().id) is not None, timeout=10
+        )
+        assert d.metadata(d.member_by_id(c.member().id)) == {"who": "c"}
+    finally:
+        await shutdown_all(*live)
+
+
+@pytest.mark.asyncio
 async def test_heterogeneous_fd_timings_stay_alive():
     """Nodes running different ping intervals/timeouts still converge with
     no false suspicion (FailureDetectorTest.java:149-177)."""

@@ -91,8 +91,13 @@ while done < ticks:
     int(state.view_T[0, 0])
     dt += time.perf_counter() - t0
     done += chunk
+    # Outside the timed region: drain this chunk's queued overflow scalars
+    # so a killed multi-hour run still showed its saturation signal.
+    chunk_overflow = [float(o) for o in overflow_per_tick[-chunk:]]
     print(
         f"chunk done: tick={int(state.tick)} "
+        f"overflow_so_far={sum(float(o) for o in overflow_per_tick):.0f} "
+        f"chunk_peak={max(chunk_overflow):.0f} "
         f"active={int(jnp.sum(state.slot_subj >= 0))} "
         f"({(time.perf_counter() - t_all) / 60:.1f} min elapsed)",
         flush=True,
