@@ -69,6 +69,16 @@ PYEOF
   timeout 700 python tools/sparse_times.py 32768 2048 48 1 >>"$LOG" 2>&1
   sleep 10
 
+  echo "--- [3b/6] S-sensitivity + n-scaling attribution ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  # Round-3 perf levers (tools written this session): slot-budget cost is
+  # ~linear in S and legitimate to shrink if slot_overflow stays 0; the
+  # super-linear per-tick growth past 32768 needs per-piece attribution.
+  timeout 900 python tools/s_sensitivity.py 32768 1024 1536 2048 >>"$LOG" 2>&1
+  sleep 10
+  timeout 900 python tools/nscale_profile.py full kernel select ring -- 32768 49152 >>"$LOG" 2>&1
+  sleep 10
+  cp "$LOG" /root/repo/TPU_RUN_r3.log 2>/dev/null
+
   echo "--- [4/6] dense control ($(date -u +%FT%TZ)) ---" >>"$LOG"
   timeout 600 python tools/chunk_times.py 2>&1 | tail -30 >>"$LOG"
   cp "$LOG" /root/repo/TPU_RUN_r3.log 2>/dev/null
