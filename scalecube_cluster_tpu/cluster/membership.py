@@ -79,6 +79,27 @@ class UpdateReason(Enum):
 _NO_REGOSSIP = frozenset({UpdateReason.GOSSIP, UpdateReason.INITIAL_SYNC})
 
 
+class _PendingFetch:
+    """An in-flight metadata fetch for one member (ADVICE r3 item 1).
+
+    ``reason`` is mutable: when a same-incarnation duplicate record is
+    deduped against this fetch but carries a re-gossipable reason (e.g. the
+    first record came via GOSSIP and a SYNC duplicate arrives mid-fetch),
+    the stored reason is upgraded so the post-fetch apply re-gossips — the
+    reference reaches the same outcome by letting duplicate fetches race
+    and re-gossiping from whichever succeeds (MembershipProtocolImpl.java
+    :518-543, :649-656)."""
+
+    __slots__ = ("incarnation", "task", "reason")
+
+    def __init__(
+        self, incarnation: int, task: asyncio.Task, reason: UpdateReason
+    ):
+        self.incarnation = incarnation
+        self.task = task
+        self.reason = reason
+
+
 class MembershipProtocol:
     """One node's membership engine (MembershipProtocolImpl.java:52-792)."""
 
@@ -106,8 +127,8 @@ class MembershipProtocol:
         self._table: dict[str, MembershipRecord] = {}
         self._members: dict[str, Member] = {}
         self._suspicion_tasks: dict[str, asyncio.Task] = {}
-        #: member id -> (incarnation being fetched, fetch task)
-        self._fetch_tasks: dict[str, tuple[int, asyncio.Task]] = {}
+        #: member id -> in-flight metadata fetch (incarnation, task, reason)
+        self._fetch_tasks: dict[str, _PendingFetch] = {}
         self._removed_history: deque[Member] = deque(
             maxlen=self._membership_config.removed_members_history_size
         )
@@ -139,7 +160,7 @@ class MembershipProtocol:
         for task in (
             self._tasks
             + list(self._suspicion_tasks.values())
-            + [entry[1] for entry in self._fetch_tasks.values()]
+            + [entry.task for entry in self._fetch_tasks.values()]
         ):
             task.cancel()
         self._tasks.clear()
@@ -413,7 +434,15 @@ class MembershipProtocol:
     ) -> None:
         """Remove a dead member and emit REMOVED (:571-587)."""
         self._cancel_suspicion(r1.member.id)
-        self._cancel_fetch(r1.member.id)
+        # ADVICE r3 item 4: a strictly-higher-incarnation refutation fetch
+        # (ALIVE@N+1) in flight survives a lower-incarnation DEAD — when it
+        # completes, ALIVE overrides the (now absent) table entry and the
+        # member is re-admitted immediately, as in the reference where the
+        # racing fetch's memberExists check passes (:518-543). A fetch at
+        # the dead record's own (or lower) incarnation is stale and dies.
+        pending = self._fetch_tasks.get(r1.member.id)
+        if pending is None or pending.incarnation <= r1.incarnation:
+            self._cancel_fetch(r1.member.id)
         self._table.pop(r1.member.id, None)
         if reason not in _NO_REGOSSIP:
             self._spread_membership_gossip(r1)
@@ -470,12 +499,26 @@ class MembershipProtocol:
         on the memberExists check — we keep at most one fetch in flight per
         member, keyed by incarnation."""
         pending = self._fetch_tasks.get(r1.member.id)
-        if pending is not None and pending[0] >= r1.incarnation:
-            return  # an equal-or-newer fetch is already in flight
+        if pending is not None and pending.incarnation >= r1.incarnation:
+            # An equal-or-newer fetch is already in flight; if a SAME-
+            # incarnation duplicate would re-gossip but the pending one
+            # wouldn't, upgrade the stored reason so dissemination isn't
+            # lost (ADVICE r3 item 1). A strictly-lower-incarnation record
+            # must NOT upgrade: re-gossiping the newer record on its
+            # account would violate the :649-656 no-regossip rule for the
+            # records that actually carried the pending incarnation.
+            if (
+                pending.incarnation == r1.incarnation
+                and reason not in _NO_REGOSSIP
+                and pending.reason in _NO_REGOSSIP
+            ):
+                pending.reason = reason
+            return
         self._cancel_fetch(r1.member.id)
-        self._fetch_tasks[r1.member.id] = (
+        self._fetch_tasks[r1.member.id] = _PendingFetch(
             r1.incarnation,
             asyncio.create_task(self._fetch_then_emit(r1, reason)),
+            reason,
         )
 
     async def _fetch_then_emit(
@@ -484,14 +527,23 @@ class MembershipProtocol:
         member = r1.member
         try:
             metadata = await self._metadata.fetch_metadata(member)
-        except (asyncio.TimeoutError, ConnectionError, OSError) as exc:
+        except Exception as exc:
             # Nothing applied; the next sync/gossip record retries (:534-541).
+            # All Exceptions are contained — a malformed METADATA payload
+            # (deserialization error) takes the same skip-and-retry path as
+            # a timeout, matching the reference's onErrorResume(Exception)
+            # (ADVICE r3 item 3). CancelledError is BaseException: a newer
+            # fetch replacing us still propagates cancellation.
             logger.debug("%s: metadata fetch from %s failed: %s", self._local, member, exc)
             return
         finally:
             # Only deregister ourselves — a newer fetch may have replaced us.
             entry = self._fetch_tasks.get(member.id)
-            if entry is not None and entry[1] is asyncio.current_task():
+            if entry is not None and entry.task is asyncio.current_task():
+                # Pick up a reason upgraded by a mid-fetch deduped duplicate
+                # (see _PendingFetch): the apply below must re-gossip if ANY
+                # record that fed this fetch would have.
+                reason = entry.reason
                 del self._fetch_tasks[member.id]
         # Metadata arrived: member is alive — apply the record now
         # (onAliveMemberDetected, :589-610). For a KNOWN member the table
@@ -532,7 +584,7 @@ class MembershipProtocol:
     def _cancel_fetch(self, member_id: str) -> None:
         entry = self._fetch_tasks.pop(member_id, None)
         if entry is not None:
-            entry[1].cancel()
+            entry.task.cancel()
 
     def _emit(self, event: MembershipEvent) -> None:
         logger.debug("%s: %s", self._local, event)

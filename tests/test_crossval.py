@@ -12,18 +12,20 @@ the host side), so trials are averaged and completion periods are compared
 within a small window rather than bit-exactly. The period-indexed mesh
 comparison asserts aligned coverage gap <= 5% and message counts within 10%.
 
-What blocks the BASELINE ±2% aspiration (tracked statement, VERDICT round-1
-item 5): (a) sampling error — at the trial counts a CPU CI run affords
-(~10 trials of n<=48 sockets), the per-period coverage std-error alone is
-2-4%; (b) the host backend's period boundaries are wall-clock
-(gossipInterval timers racing asyncio scheduling under CI load), so curves
-jitter by a fraction of a period whereas the sim's ticks are exact — a
-sub-period phase offset shows up as a few % in mid-curve coverage; (c) loss
-draws are independent between backends by design (no shared RNG). (a) and
-(b) average out with O(100) trials on quiet hardware; (c) is irreducible
-but contributes <1% at the asserted scales. The 5% gate is therefore the
-tight-but-stable envelope for CI, with the measured gap reported in the
-assertion message every run.
+The ±2% BASELINE aspiration HOLDS at scale (measured round 4,
+artifacts/crossval_r4.json via tools/crossval_100.py): averaging 100
+independent host trials per setting on a quiet box, the aligned mean gap is
+0.46% at loss=0 and 0.30% at loss=25, with sends ratios 1.022/1.025 —
+sampling error (max per-period SEM 1.2-1.6% even at 100 trials) was the
+dominant term in the few-trial runs, exactly as the round-1 blocker
+analysis predicted. What remains in CI: (a) at CI trial counts (~3), the
+per-period coverage std-error alone is 2-4%; (b) the host backend's period
+boundaries are wall-clock (gossipInterval timers racing asyncio scheduling
+under CI load) — handled by the period-indexed x-axis plus the 0-2-period
+alignment search; (c) loss draws are independent between backends by design
+(<1%, irreducible). The 5% gate is therefore the tight-but-stable envelope
+for CI, with the measured gap reported in the assertion message every run;
+the 100-trial artifact is the ±2% evidence on record.
 """
 
 import numpy as np

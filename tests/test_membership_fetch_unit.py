@@ -1,0 +1,254 @@
+"""Unit tests for the metadata-fetch bookkeeping inside MembershipProtocol.
+
+These pin the three ADVICE-r3 behaviors around the one-fetch-per-member
+deviation (the reference lets duplicate fetches race,
+MembershipProtocolImpl.java:518-543; we keep at most one in flight):
+
+1. a deduped same-incarnation duplicate with a re-gossipable reason upgrades
+   the pending fetch's stored reason, so the post-fetch apply re-gossips;
+2. ANY exception from the fetch (not just timeouts) takes the contained
+   skip-and-retry path, like the reference's onErrorResume(Exception);
+3. a strictly-higher-incarnation refutation fetch survives a
+   lower-incarnation DEAD and re-admits the member when it completes.
+
+The protocol object is driven directly (no start(), no sockets): records are
+fed through ``_update_membership`` exactly as the SYNC/gossip/FD handler
+loops would.
+"""
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.cluster.membership import (
+    MembershipProtocol,
+    UpdateReason,
+)
+from scalecube_cluster_tpu.cluster.payloads import MEMBERSHIP_GOSSIP
+from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
+from scalecube_cluster_tpu.cluster_api.membership_record import MembershipRecord
+from scalecube_cluster_tpu.testlib.fixtures import fast_test_config
+from scalecube_cluster_tpu.utils.address import Address
+from scalecube_cluster_tpu.utils.ids import CorrelationIdGenerator
+
+
+class _StubTransport:
+    address = Address("127.0.0.1", 1)
+
+
+class _StubFD:
+    def on_membership_event(self, event) -> None:
+        pass
+
+
+class _StubGossip:
+    def __init__(self) -> None:
+        self.spread_records: list[MembershipRecord] = []
+
+    def spread(self, message):
+        assert message.qualifier == MEMBERSHIP_GOSSIP
+        self.spread_records.append(message.data)
+        fut = asyncio.get_event_loop().create_future()
+        fut.set_result(None)
+        return fut
+
+    def on_membership_event(self, event) -> None:
+        pass
+
+
+class _StubMetadata:
+    """Controllable metadata store: fetches block on a gate, then either
+    succeed or raise whatever ``failure`` holds."""
+
+    def __init__(self) -> None:
+        self._cache: dict[str, object] = {}
+        self.gate = asyncio.Event()
+        self.failure: Exception | None = None
+        self.fetch_count = 0
+
+    async def fetch_metadata(self, member: Member):
+        self.fetch_count += 1
+        await self.gate.wait()
+        if self.failure is not None:
+            raise self.failure
+        return {"who": member.id}
+
+    def put_metadata(self, member: Member, metadata) -> None:
+        self._cache[member.id] = metadata
+
+    def remove_metadata(self, member: Member):
+        return self._cache.pop(member.id, None)
+
+
+def _make_protocol() -> tuple[MembershipProtocol, _StubGossip, _StubMetadata]:
+    local = Member.create(Address("127.0.0.1", 1))
+    gossip = _StubGossip()
+    metadata = _StubMetadata()
+    proto = MembershipProtocol(
+        _StubTransport(),
+        local,
+        fast_test_config(),
+        _StubFD(),
+        gossip,
+        metadata,
+        CorrelationIdGenerator(local.id),
+    )
+    # The self record start() would install (no handler loops needed here).
+    proto._table[local.id] = MembershipRecord(local, MemberStatus.ALIVE, 0)
+    proto._members[local.id] = local
+    return proto, gossip, metadata
+
+
+def _remote(port: int = 2) -> Member:
+    return Member.create(Address("127.0.0.1", port))
+
+
+async def _drain(proto: MembershipProtocol) -> None:
+    """Let pending fetch tasks run to completion."""
+    for _ in range(10):
+        await asyncio.sleep(0)
+
+
+@pytest.mark.asyncio
+async def test_deduped_sync_duplicate_upgrades_gossip_reason():
+    """GOSSIP-learned fetch + SYNC duplicate mid-fetch -> the apply
+    re-gossips (ADVICE r3 item 1: without the upgrade, dissemination of the
+    record silently stops at this node)."""
+    proto, gossip, metadata = _make_protocol()
+    x = _remote()
+    alive1 = MembershipRecord(x, MemberStatus.ALIVE, 1)
+    proto._update_membership(alive1, UpdateReason.GOSSIP)
+    assert metadata.fetch_count == 0  # task not yet scheduled
+    await _drain(proto)
+    assert metadata.fetch_count == 1  # fetch in flight, blocked on the gate
+    # Same-incarnation duplicate learned via SYNC: deduped, but its
+    # re-gossipable reason must stick to the pending fetch.
+    proto._update_membership(alive1, UpdateReason.SYNC)
+    await _drain(proto)
+    assert metadata.fetch_count == 1, "duplicate must not start a second fetch"
+    metadata.gate.set()
+    await _drain(proto)
+    assert proto.member_by_id(x.id) is not None
+    assert gossip.spread_records == [alive1]
+
+
+@pytest.mark.asyncio
+async def test_deduped_gossip_duplicate_does_not_regossip():
+    """Control for the reason upgrade: GOSSIP + GOSSIP duplicate stays in
+    the no-re-gossip path (MembershipProtocolImpl.java:649-656)."""
+    proto, gossip, metadata = _make_protocol()
+    x = _remote()
+    alive1 = MembershipRecord(x, MemberStatus.ALIVE, 1)
+    proto._update_membership(alive1, UpdateReason.GOSSIP)
+    await _drain(proto)
+    proto._update_membership(alive1, UpdateReason.GOSSIP)
+    metadata.gate.set()
+    await _drain(proto)
+    assert proto.member_by_id(x.id) is not None
+    assert gossip.spread_records == []
+
+
+@pytest.mark.asyncio
+async def test_stale_lower_incarnation_duplicate_does_not_upgrade_reason():
+    """A strictly-LOWER-incarnation record hitting the dedup gate must not
+    upgrade the pending fetch's reason: the records that actually carried
+    the pending incarnation all came via no-regossip paths, and re-gossiping
+    on the stale record's account would violate the :649-656 rule."""
+    proto, gossip, metadata = _make_protocol()
+    x = _remote()
+    alive2 = MembershipRecord(x, MemberStatus.ALIVE, 2)
+    proto._update_membership(alive2, UpdateReason.GOSSIP)
+    await _drain(proto)
+    assert metadata.fetch_count == 1
+    # Stale SYNC record at a lower incarnation: deduped, no reason upgrade.
+    proto._update_membership(
+        MembershipRecord(x, MemberStatus.ALIVE, 1), UpdateReason.SYNC
+    )
+    await _drain(proto)
+    assert metadata.fetch_count == 1
+    metadata.gate.set()
+    await _drain(proto)
+    assert proto.member_by_id(x.id) is not None
+    assert gossip.spread_records == []
+
+
+@pytest.mark.asyncio
+async def test_malformed_metadata_response_is_contained_and_retried():
+    """A deserialization error (ValueError) from the fetch takes the same
+    skip-and-retry path as a timeout (ADVICE r3 item 3; the reference's
+    onErrorResume(Exception.class)): nothing applied, no task crash, and a
+    later same-incarnation record retries successfully."""
+    proto, gossip, metadata = _make_protocol()
+    x = _remote()
+    alive1 = MembershipRecord(x, MemberStatus.ALIVE, 1)
+    metadata.failure = ValueError("malformed METADATA payload")
+    metadata.gate.set()
+    proto._update_membership(alive1, UpdateReason.SYNC)
+    await _drain(proto)
+    assert metadata.fetch_count == 1
+    assert proto.member_by_id(x.id) is None
+    assert x.id not in proto._table, "failed fetch must leave no table trace"
+    assert x.id not in proto._fetch_tasks
+    # The payload problem clears; the next SYNC record retries and admits.
+    metadata.failure = None
+    proto._update_membership(alive1, UpdateReason.SYNC)
+    await _drain(proto)
+    assert metadata.fetch_count == 2
+    assert proto.member_by_id(x.id) is not None
+
+
+@pytest.mark.asyncio
+async def test_higher_incarnation_fetch_survives_lower_dead():
+    """SUSPECT@0 member, refutation ALIVE@1 fetch in flight, suspicion
+    timeout applies DEAD@0: the member is removed but the higher-incarnation
+    fetch survives and re-admits it on completion (ADVICE r3 item 4; the
+    reference's racing fetch passes its memberExists check and re-adds)."""
+    proto, gossip, metadata = _make_protocol()
+    x = _remote()
+    # Known, visible, currently suspected member.
+    proto._table[x.id] = MembershipRecord(x, MemberStatus.SUSPECT, 0)
+    proto._members[x.id] = x
+    metadata.put_metadata(x, {"who": x.id})
+    # Refutation at the bumped incarnation arrives; its fetch blocks.
+    alive1 = MembershipRecord(x, MemberStatus.ALIVE, 1)
+    proto._update_membership(alive1, UpdateReason.SYNC)
+    await _drain(proto)
+    assert metadata.fetch_count == 1
+    # Suspicion timeout fires while the fetch is still in flight.
+    proto._update_membership(
+        MembershipRecord(x, MemberStatus.DEAD, 0), UpdateReason.SUSPICION_TIMEOUT
+    )
+    assert proto.member_by_id(x.id) is None, "DEAD removes the member"
+    assert x.id in proto._fetch_tasks, "higher-incarnation fetch must survive"
+    # Fetch completes: ALIVE@1 overrides the (absent) entry -> re-admitted.
+    metadata.gate.set()
+    await _drain(proto)
+    assert proto.member_by_id(x.id) is not None
+    assert proto._table[x.id] == alive1
+
+
+@pytest.mark.asyncio
+async def test_same_incarnation_fetch_cancelled_by_dead():
+    """Control: a pending fetch at the DEAD record's own incarnation is
+    stale and is cancelled with the removal (no ghost re-admission). The
+    member must already be visible — a DEAD rumor about an unknown member
+    is dropped by is_overrides (MembershipRecord.java:67-69), leaving an
+    unknown member's fetch untouched by design."""
+    proto, gossip, metadata = _make_protocol()
+    x = _remote()
+    # Known, visible at incarnation 0; an update to ALIVE@1 starts a fetch.
+    proto._table[x.id] = MembershipRecord(x, MemberStatus.ALIVE, 0)
+    proto._members[x.id] = x
+    metadata.put_metadata(x, {"who": x.id})
+    alive1 = MembershipRecord(x, MemberStatus.ALIVE, 1)
+    proto._update_membership(alive1, UpdateReason.SYNC)
+    await _drain(proto)
+    assert metadata.fetch_count == 1
+    proto._update_membership(
+        MembershipRecord(x, MemberStatus.DEAD, 1), UpdateReason.GOSSIP
+    )
+    await _drain(proto)
+    assert x.id not in proto._fetch_tasks, "same-incarnation fetch is stale"
+    metadata.gate.set()
+    await _drain(proto)
+    assert proto.member_by_id(x.id) is None

@@ -1,0 +1,167 @@
+"""O(100)-trial cross-backend gossip-mesh comparison (VERDICT r3 item 8).
+
+The ±2% BASELINE aspiration ("convergence curves matching a Netty-backend
+run ±2%") has been gated at 5% in CI because ~3-trial runs carry 2-4% of
+pure sampling error (tests/test_crossval.py docstring).  This runner removes
+blocker (a) — sampling — by averaging O(100) independent host and sim
+trials of the period-indexed n=32 gossip mesh, the tightest comparison the
+suite has.  Blocker (b) — wall-clock phase jitter — is already handled by
+the period-indexed x-axis plus the 0-2-period alignment search; blocker (c)
+— independent loss draws — is irreducible <1%.
+
+Each host trial is appended to artifacts/crossval_r4_trials.jsonl as it
+completes (a kill loses nothing), with the 1-minute load average recorded so
+trials contaminated by background compile jobs can be identified.  The
+final summary (raw gap, aligned gap, per-period std-error, sends ratio)
+goes to artifacts/crossval_r4.json.
+
+Usage: python tools/crossval_100.py [trials] [loss_percent ...]
+Defaults: 100 trials, losses 0 and 25.
+"""
+
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np
+
+from scalecube_cluster_tpu.utils import jaxcache
+
+TRIALS_PATH = "/root/repo/artifacts/crossval_r4_trials.jsonl"
+SUMMARY_PATH = "/root/repo/artifacts/crossval_r4.json"
+
+
+def _append(row: dict) -> None:
+    with open(TRIALS_PATH, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+async def run(trials: int, losses: list[float]) -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jaxcache.enable_repo_jax_cache()
+
+    from scalecube_cluster_tpu.testlib.crossval import (
+        host_gossip_mesh_run,
+        sim_gossip_run,
+    )
+
+    n = 32
+    for loss in losses:
+        periods = 24 if loss == 0.0 else 30
+        for trial in range(trials):
+            t0 = time.time()
+            try:
+                cov, sends = await host_gossip_mesh_run(
+                    n, loss, periods, seed=10_000 + trial
+                )
+            except Exception as e:  # record and continue: one flaky trial
+                _append(
+                    {
+                        "backend": "host",
+                        "loss": loss,
+                        "trial": trial,
+                        "error": repr(e),
+                    }
+                )
+                continue
+            _append(
+                {
+                    "backend": "host",
+                    "loss": loss,
+                    "trial": trial,
+                    "coverage": [float(x) for x in cov],
+                    "sends": int(sends),
+                    "wall_s": round(time.time() - t0, 2),
+                    "load1": os.getloadavg()[0],
+                }
+            )
+            if trial % 10 == 0:
+                print(
+                    f"host loss={loss} trial={trial} "
+                    f"wall={time.time() - t0:.1f}s load={os.getloadavg()[0]:.2f}",
+                    flush=True,
+                )
+        # Sim trials: deterministic per seed, fast (vectorised), run as one
+        # batch.  Use the same trial count for an apples-to-apples average.
+        t0 = time.time()
+        sim_cov, sim_sends = sim_gossip_run(n, loss, periods, trials=trials)
+        _append(
+            {
+                "backend": "sim",
+                "loss": loss,
+                "trials": trials,
+                "coverage": [float(x) for x in sim_cov],
+                "sends_mean": float(sim_sends),
+                "wall_s": round(time.time() - t0, 2),
+            }
+        )
+        print(f"sim loss={loss} done in {time.time() - t0:.1f}s", flush=True)
+
+    summarize(losses)
+
+
+def summarize(losses: list[float]) -> None:
+    rows = [json.loads(line) for line in open(TRIALS_PATH)]
+    out = {"n": 32, "trials_file": TRIALS_PATH, "per_loss": {}}
+    for loss in losses:
+        host_rows = [
+            r
+            for r in rows
+            if r["backend"] == "host" and r["loss"] == loss and "coverage" in r
+        ]
+        sim_rows = [
+            r for r in rows if r["backend"] == "sim" and r["loss"] == loss
+        ]
+        if not host_rows or not sim_rows:
+            out["per_loss"][str(loss)] = {"error": "missing rows"}
+            continue
+        host_curves = np.array([r["coverage"] for r in host_rows])
+        host_cov = host_curves.mean(axis=0)
+        # Std-error of the mean per period — the sampling-noise floor the
+        # ±2% comparison is up against.
+        host_sem = host_curves.std(axis=0, ddof=1) / np.sqrt(len(host_rows))
+        sim_cov = np.array(sim_rows[-1]["coverage"])
+        gaps = []
+        for shift in range(3):
+            a = host_cov[shift:]
+            b = sim_cov[: len(a)] if shift else sim_cov
+            gaps.append(float(np.mean(np.abs(a - b))))
+        host_sends = float(np.mean([r["sends"] for r in host_rows]))
+        sim_sends = float(sim_rows[-1]["sends_mean"])
+        out["per_loss"][str(loss)] = {
+            "host_trials": len(host_rows),
+            "raw_mean_gap": gaps[0],
+            "aligned_mean_gap": min(gaps),
+            "align_shift": int(np.argmin(gaps)),
+            "max_sem": float(host_sem.max()),
+            "mean_sem": float(host_sem.mean()),
+            "host_sends": host_sends,
+            "sim_sends": sim_sends,
+            "sends_ratio": sim_sends / host_sends if host_sends else None,
+            "host_cov": [round(float(x), 4) for x in host_cov],
+            "sim_cov": [round(float(x), 4) for x in sim_cov],
+            "host_wall_s_median": float(
+                np.median([r["wall_s"] for r in host_rows])
+            ),
+            "host_load1_median": float(
+                np.median([r["load1"] for r in host_rows])
+            ),
+        }
+    with open(SUMMARY_PATH, "w") as f:
+        json.dump(out, f, indent=2)
+    print(json.dumps(out["per_loss"], indent=2))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "summarize":
+        summarize([float(x) for x in sys.argv[2:]] or [0.0, 25.0])
+        sys.exit(0)
+    n_trials = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    loss_list = [float(x) for x in sys.argv[2:]] or [0.0, 25.0]
+    asyncio.run(run(n_trials, loss_list))

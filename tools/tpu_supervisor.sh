@@ -1,5 +1,5 @@
 #!/bin/bash
-# Round-3 TPU recovery supervisor (VERDICT.md round-2 item 1).
+# Round-4 TPU recovery supervisor (VERDICT.md round-3 item 2).
 #
 # Runs for the whole round: probes the tunneled TPU backend forever; the
 # first time it answers, runs the full on-chip measurement sequence and
@@ -39,24 +39,42 @@ run_sequence() {
 import json, datetime, os
 try:
     r = json.load(open(os.environ["ATTEMPT"]))
+    stamp = datetime.datetime.utcnow().isoformat() + "Z"
+    # ADVICE r3: record EVERY attempt (promotion gate alone made the
+    # artifact best-of-N with no audit trail of regressions). The audit
+    # append is additive, never load-bearing: a failure here must not
+    # block promoting a better run.
     try:
-        prev = json.load(open("/root/repo/BENCH_SELF_r3.json")).get("value", 0)
-    except Exception:
-        prev = 0
+        hist = dict(r)
+        hist["attempt_at"] = stamp
+        os.makedirs("/root/repo/artifacts", exist_ok=True)
+        with open("/root/repo/artifacts/bench_history.jsonl", "a") as f:
+            f.write(json.dumps(hist) + "\n")
+    except Exception as hist_err:
+        print("bench_history append failed:", hist_err)
+    best_prev = 0
+    for p in ("/root/repo/BENCH_SELF_r4.json", "/root/repo/BENCH_SELF_r3.json"):
+        try:
+            best_prev = max(best_prev, json.load(open(p)).get("value", 0))
+        except Exception:
+            pass
     # Promote only a strictly-better nonzero run, and keep PERF_SELF in
-    # lockstep with the promoted artifact (never regress either).
-    if r.get("value", 0) > prev:
-        json.dump(r, open("/root/repo/BENCH_SELF_r3.json", "w"), indent=2)
-        print("BENCH_SELF_r3.json promoted: %s > %s" % (r.get("value"), prev))
+    # lockstep with the promoted artifact (never regress either). The
+    # promoted file is explicitly best-observed; bench_history.jsonl is
+    # the representative per-run record.
+    if r.get("value", 0) > best_prev:
+        r["note"] = "best observed run this round; all runs in artifacts/bench_history.jsonl"
+        json.dump(r, open("/root/repo/BENCH_SELF_r4.json", "w"), indent=2)
+        print("BENCH_SELF_r4.json promoted: %s > %s" % (r.get("value"), best_prev))
         r["provenance"] = (
-            "self-measured round 3 by tools/tpu_supervisor.sh (driver-identical "
-            "bench.py invocation) at " + datetime.datetime.utcnow().isoformat() + "Z"
+            "self-measured round 4 by tools/tpu_supervisor.sh (driver-identical "
+            "bench.py invocation) at " + stamp
         )
-        r["measured_round"] = 3
+        r["measured_round"] = 4
         json.dump(r, open("/root/repo/PERF_SELF.json", "w"), indent=2)
-        print("PERF_SELF.json refreshed from round-3 run")
+        print("PERF_SELF.json refreshed from round-4 run")
     else:
-        print("bench attempt not promoted (%s <= %s)" % (r.get("value"), prev))
+        print("bench attempt not promoted (%s <= %s); recorded in bench_history" % (r.get("value"), best_prev))
 except Exception as e:
     print("PERF_SELF refresh skipped:", e)
 PYEOF
@@ -77,11 +95,11 @@ PYEOF
   sleep 10
   timeout 900 python tools/nscale_profile.py full kernel select ring -- 32768 49152 >>"$LOG" 2>&1
   sleep 10
-  cp "$LOG" /root/repo/TPU_RUN_r3.log 2>/dev/null
+  cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
 
   echo "--- [4/6] dense control ($(date -u +%FT%TZ)) ---" >>"$LOG"
   timeout 600 python tools/chunk_times.py 2>&1 | tail -30 >>"$LOG"
-  cp "$LOG" /root/repo/TPU_RUN_r3.log 2>/dev/null
+  cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
 
   # Compile-wall matrix LAST: an abandoned server-side XLA compile can
   # wedge the tunnel for every later process, so nothing measurement-
@@ -98,7 +116,7 @@ PYEOF
     # tick1 (single tick, no scan) is the control the wall never blocked.
     if [ "$v" != "tick1" ] && grep -q "COMPILE_OK" "$STEP"; then SCAN_OK=1; fi
     rm -f "$STEP"
-    cp "$LOG" /root/repo/TPU_RUN_r3.log 2>/dev/null
+    cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
     sleep 20
   done
 
@@ -107,7 +125,7 @@ PYEOF
     timeout 900 python tools/sparse_times.py 49152 3072 48 0 >>"$LOG" 2>&1
   fi
   echo "=== sequence done $(date -u +%FT%TZ) ===" >>"$LOG"
-  cp "$LOG" /root/repo/TPU_RUN_r3.log 2>/dev/null
+  cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
   touch /root/repo/tools/.sequence_done
 }
 
