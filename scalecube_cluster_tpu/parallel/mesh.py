@@ -109,10 +109,20 @@ def sparse_state_shardings(mesh: Mesh):
     the row, and the working-set slab ``[N_view, S]`` shards its viewer rows
     the same way. Slot tables are replicated (every device needs the full
     subject↔slot mapping for its gathers).
+
+    On a 2D viewer×subject mesh (:func:`make_mesh2d`) ``view_T``
+    additionally shards its SUBJECT rows across ``"subjects"`` — per-device
+    view memory scales 1/(dm·ds), the layout for member counts whose full
+    [N_subj, N_view/D] panel no longer fits one device (500k members:
+    ~1 TB of view). The working set ([N_view, S], S small) and the member
+    vectors stay sharded over viewers only (replicated across the subject
+    axis); write-back/load become subject-axis collectives XLA inserts.
     """
     from scalecube_cluster_tpu.sim.sparse import SparseState
 
-    row = NamedSharding(mesh, P(None, AXIS))  # view_T [subj, viewer]
+    two_d = SUBJECT_AXIS in mesh.axis_names
+    # view_T [subj, viewer]
+    row = NamedSharding(mesh, P(SUBJECT_AXIS, AXIS) if two_d else P(None, AXIS))
     slabrow = NamedSharding(mesh, P(AXIS, None))  # slab/age/susp [viewer, S]
     vec = NamedSharding(mesh, P(AXIS))
     rep = NamedSharding(mesh, P())

@@ -126,6 +126,45 @@ _SUSPECT = int(MemberStatus.SUSPECT)
 _DEAD = int(MemberStatus.DEAD)
 
 
+def slot_lifetime_ticks(base: SimParams, writeback_period: int = 1) -> int:
+    """Worst-case ticks a churn-driven slot stays pinned.
+
+    A kill's slot lives through suspicion (``suspicion_ticks`` countdown to
+    DEAD), then the tombstone's young window (re-gossip) and aging to the
+    sweep deadline (``periods_to_sweep`` — after which write-back demotes it
+    to UNKNOWN and frees the slot), plus up to ``writeback_period`` ticks
+    waiting for the next write-back. Restarts/joins pin only for the young
+    window, so kills dominate (ClusterMath.java:123-125 suspicion law +
+    :99-102 sweep law).
+    """
+    return base.suspicion_ticks + base.periods_to_sweep + writeback_period
+
+
+def slot_budget_for(
+    base: SimParams,
+    n: int,
+    churn_rate: float,
+    writeback_period: int = 1,
+    margin: float = 1.5,
+) -> int:
+    """Slot budget that keeps ``slot_overflow == 0`` under sustained churn.
+
+    Little's law on the slab: arrivals of ``churn_rate * n`` slots/tick
+    each resident ``slot_lifetime_ticks`` give a steady-state working set
+    of ``rate × lifetime``; ``margin`` absorbs arrival burstiness and the
+    anti-entropy window's own activations (``sync_window`` extra slots per
+    sync period, amortized small). The round-3 saturation measurement
+    (EXPERIMENTS_r3.jsonl, 49152 @ ~2%-churn chunks vs S=2048: overflow
+    peak 323/tick) is exactly this rule violated — that scenario's demand
+    is ``0.0015 × 49152 × 340 ≈ 25k`` slot·ticks against a 2048 budget.
+    The companion completeness guarantee when the rule is NOT met (overflow
+    merely delays verdicts, never loses them) is pinned by
+    tests/test_sparse.py::test_completeness_under_slot_overflow.
+    """
+    demand = churn_rate * n * slot_lifetime_ticks(base, writeback_period)
+    return int(np.ceil(margin * demand)) + 64  # +64: non-churn rumor floor
+
+
 @dataclass(frozen=True)
 class SparseParams:
     """Static constants: the dense protocol constants + working-set bounds."""
@@ -172,10 +211,31 @@ class SparseParams:
         in_scan_writeback: bool = True,
         pallas_core: bool = False,
         sync_window: int = 64,
+        churn_rate: float = 0.0,
         **kw,
     ):
+        """Build params for an ``n``-member cluster.
+
+        ``churn_rate`` (fraction of members churning per tick) raises
+        ``slot_budget`` and ``alloc_cap`` to the sizing rule
+        (:func:`slot_budget_for`): callers that know their churn target pass
+        it and get a working set guaranteed to keep ``slot_overflow`` at 0
+        in steady state; 0.0 keeps the explicit/default budget. The sizing
+        uses ``writeback_period`` as the slot-free cadence — callers running
+        host-boundary frees (``in_scan_writeback=False`` + chunked driver)
+        must pass their CHUNK length here so the sizing matches the real
+        residency (the engine itself ignores the value in that mode).
+        """
+        base = SimParams.from_cluster_config(n, **kw)
+        if churn_rate > 0.0:
+            slot_budget = max(
+                slot_budget,
+                slot_budget_for(base, n, churn_rate, writeback_period),
+            )
+            # The whole per-tick churn must be admittable the tick it fires.
+            alloc_cap = max(alloc_cap, int(np.ceil(churn_rate * n)) + sync_window)
         return cls(
-            base=SimParams.from_cluster_config(n, **kw),
+            base=base,
             slot_budget=slot_budget,
             alloc_cap=alloc_cap,
             writeback_period=writeback_period,

@@ -417,6 +417,136 @@ def test_sparse_sharded_equals_single():
         assert (a == b).all(), field
 
 
+def test_completeness_under_slot_overflow():
+    """SWIM's time-bounded completeness survives sustained slot overflow
+    (VERDICT round-3 item 6): with a slab far smaller than the churn batch,
+    activation requests are dropped and retried for many consecutive ticks,
+    yet EVERY killed member is declared DEAD by every live member within a
+    bound computed from the engine's own constants — overflow delays
+    verdicts, it never loses them (the engine's documented bounded-memory
+    deviation; reference headline property README.md:10-17,
+    ClusterMath.java:123-125).
+
+    Bound derivation (pinned, not tuned): kills drain through the slab in
+    waves of at most S slots. A wave's slot lives ``slot_lifetime_ticks`` =
+    suspicion_ticks + periods_to_sweep + writeback_period (countdown to
+    DEAD, tombstone re-gossip + aging, write-back); refilling freed slots
+    takes up to ceil(S/alloc_cap) grant ticks spaced fd_period apart (the
+    FD re-fires for a still-unslabbed dead member every probe that hits
+    it). After the LAST wave activates, the SUSPECT rumor reaches every
+    live viewer within periods_to_spread and each viewer's own countdown
+    expires suspicion_ticks later. Total:
+
+        ceil(K/S) * (lifetime + ceil(S/cap)*fd_period)
+        + periods_to_spread + suspicion_ticks + slack
+    """
+    import numpy as np
+
+    from scalecube_cluster_tpu.sim.sparse import slot_lifetime_ticks
+
+    n, S, cap, K = 128, 16, 4, 48
+    p = dataclasses.replace(
+        sparse_params(
+            n,
+            slot_budget=S,
+            periods_to_spread=6,
+            periods_to_sweep=14,
+            fd_period_ticks=2,
+            suspicion_ticks=12,
+            sync_period_ticks=10,
+        ),
+        alloc_cap=cap,
+    )
+    base = p.base
+    lifetime = slot_lifetime_ticks(base, p.writeback_period)
+    waves = int(np.ceil(K / S))
+    refill = int(np.ceil(S / cap)) * base.fd_period_ticks
+    slack = 4 * base.fd_period_ticks + p.writeback_period  # detection jitter
+    bound = (
+        waves * (lifetime + refill)
+        + base.periods_to_spread
+        + base.suspicion_ticks
+        + slack
+    )
+
+    st = init_sparse_full_view(n, S, seed=3)
+    killed = list(range(40, 40 + K))
+    for j in killed:
+        st = kill_sparse(st, j)
+    live = np.ones(n, bool)
+    live[killed] = False
+    plan = FaultPlan.clean(n)
+
+    seen_dead = np.zeros((n, K), bool)  # viewer x killed, cumulative
+    overflow_ticks, overflow_total = 0, 0
+    all_seen_at = None
+    for t in range(1, bound + 40):
+        st, m = run_sparse_ticks(p, st, plan, 1)
+        ov = int(jnp.stack(m["slot_overflow"])[0])
+        overflow_ticks += ov > 0
+        overflow_total += ov
+        stat = np.asarray(statuses(st))  # [viewer, subject]
+        seen_dead |= stat[:, killed] == DEAD
+        if all_seen_at is None and bool(seen_dead[live].all()):
+            all_seen_at = t
+            break
+    # The premise: the budget was genuinely and persistently overwhelmed.
+    assert overflow_ticks >= 5, (overflow_ticks, overflow_total)
+    assert overflow_total >= K - S, (overflow_ticks, overflow_total)
+    # The property: complete within the derived bound.
+    assert all_seen_at is not None, (
+        f"incomplete after {bound + 39} ticks: "
+        f"{int(seen_dead[live].all(axis=0).sum())}/{K} killed seen by all"
+    )
+    assert all_seen_at <= bound, (all_seen_at, bound)
+    slot_invariants(st)
+
+    # Control: the S-sizing rule (slot_budget_for) admits the same batch
+    # with ZERO overflow — the rule and the degradation bound are the two
+    # sides of the working-set contract.
+    from scalecube_cluster_tpu.sim.sparse import slot_budget_for
+
+    churn_rate = K / n / lifetime  # amortized: one batch per lifetime
+    S_ok = slot_budget_for(base, n, churn_rate, p.writeback_period)
+    assert S_ok >= K, (S_ok, K)  # a one-shot batch needs >= K slots
+    p_ok = dataclasses.replace(p, slot_budget=S_ok, alloc_cap=64)
+    st2 = init_sparse_full_view(n, S_ok, seed=3)
+    for j in killed:
+        st2 = kill_sparse(st2, j)
+    total_ov = 0
+    for _ in range(lifetime + base.periods_to_spread):
+        st2, m2 = run_sparse_ticks(p_ok, st2, plan, 1)
+        total_ov += int(jnp.stack(m2["slot_overflow"])[0])
+    assert total_ov == 0, total_ov
+
+
+def test_sparse_sharded_full_cadence_certification():
+    """The deepened sharded certification (VERDICT round-3 item 5): the full
+    kill → suspicion-expiry → DEAD → restart/epoch-bump → re-admission
+    lifecycle over >2 sync periods, executed sharded on 8 devices — on BOTH
+    the 1D viewer mesh and the 2D viewer×subject mesh (round-3 stretch item
+    9) — with bit-for-bit sharded==single parity at every segment boundary
+    and on the metric traces. CI runs the same sequence the driver's dryrun
+    runs at 8192, at a CI-sized n (the sharded code paths are n-invariant;
+    the 8192-scale run is the driver artifact MULTICHIP_r04)."""
+    import jax
+
+    from scalecube_cluster_tpu.parallel import (
+        make_mesh,
+        make_mesh2d,
+        shard_plan,
+        shard_sparse_state,
+    )
+    from scalecube_cluster_tpu.testlib.certify import sparse_full_cadence_certify
+
+    assert len(jax.devices()) >= 8
+    meshes = [make_mesh(jax.devices()[:8]), make_mesh2d((4, 2))]
+    events = sparse_full_cadence_certify(meshes, 1024, shard_plan, shard_sparse_state)
+    assert events["meshes"] == 2
+    assert events["sync_periods"] >= 2
+    assert events["segments"][0]["peak_suspected"] > 0, "suspicion must arm"
+
+
 def test_window_sync_heals_without_gossip():
     """Anti-entropy must heal even with dissemination silenced (the
     reference's SYNC is the partition healer independent of gossip,

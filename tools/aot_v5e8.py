@@ -1,0 +1,94 @@
+"""AOT-compile the 8-way-sharded 100k sparse program with the REAL TPU
+compiler against a v5e-8 topology (VERDICT r3 item 4).
+
+The round-3 multi-chip story for 100k members rested on an HBM arithmetic
+table plus a CPU-mesh dryrun; nothing showed the XLA **TPU** backend
+compiles the sharded 102400 program. This tool does exactly that — no TPU
+hardware needed: ``jax.experimental.topologies.get_topology_desc`` builds
+compile-only v5e-8 devices from the locally-installed libtpu, and
+``jit(...).lower(...).compile()`` runs the real TPU compiler client-side
+(killable; nothing touches the axon tunnel).
+
+Compiles both production forms:
+- the scan-chunk program (``in_scan_writeback=False``, the bench/churn
+  driver form) over a ticks-long chunk;
+- the single-tick dryrun form (``in_scan_writeback=True``).
+
+Reports compile wall time and the compiler's own per-device memory
+accounting (CompiledMemoryStats are per-device for SPMD programs) against
+the 16 GiB v5e HBM budget.
+
+Usage: python tools/aot_v5e8.py [n] [S] [chunk] [topology]
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import numpy as np
+from jax.experimental import topologies
+from jax.sharding import Mesh
+
+n = int(sys.argv[1]) if len(sys.argv) > 1 else 102400
+S = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
+chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 48
+topo_name = sys.argv[4] if len(sys.argv) > 4 else "v5e:2x4"
+
+from scalecube_cluster_tpu.parallel.mesh import AXIS, sparse_state_shardings
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    run_sparse_ticks,
+)
+
+topo = topologies.get_topology_desc(topo_name, "tpu")
+print(f"topology {topo_name}: {len(topo.devices)} compile-only devices, "
+      f"kind={topo.devices[0].device_kind}", flush=True)
+mesh = Mesh(np.array(topo.devices), (AXIS,))
+
+GIB = 2**30
+
+
+def report(tag, params, ticks):
+    state = jax.eval_shape(lambda: init_sparse_full_view(n, slot_budget=S))
+    sh = sparse_state_shardings(mesh)
+    state = jax.tree.map(
+        lambda s, d: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=d),
+        state,
+        sh,
+    )
+    plan = jax.eval_shape(lambda: FaultPlan.uniform())
+    t0 = time.time()
+    lowered = run_sparse_ticks.lower(params, state, plan, ticks, collect=False)
+    t1 = time.time()
+    compiled = lowered.compile()
+    t2 = time.time()
+    ma = compiled.memory_analysis()
+    args_gib = ma.argument_size_in_bytes / GIB
+    temp_gib = ma.temp_size_in_bytes / GIB
+    # Arguments alias outputs (donated carry): live set = args + temps.
+    print(
+        f"AOT_OK {tag}: n={n} S={S} ticks={ticks} on {topo_name} — "
+        f"lower {t1 - t0:.1f}s, TPU compile {t2 - t1:.1f}s; per-device "
+        f"HBM: args {args_gib:.2f} GiB (alias {ma.alias_size_in_bytes / GIB:.2f}), "
+        f"temps {temp_gib:.2f} GiB, code "
+        f"{ma.generated_code_size_in_bytes / 2**20:.1f} MiB -> live "
+        f"{args_gib + temp_gib:.2f} GiB of 16 GiB v5e HBM",
+        flush=True,
+    )
+
+
+report(
+    "scan-chunk (bench/churn form)",
+    SparseParams.for_n(n, slot_budget=S, in_scan_writeback=False),
+    chunk,
+)
+report(
+    "single-tick (dryrun form, in-scan writeback)",
+    SparseParams.for_n(n, slot_budget=S, in_scan_writeback=True),
+    1,
+)

@@ -1,15 +1,20 @@
-"""100k churn row, eager per-tick fallback (no lax.scan).
+"""100k churn row, eager per-tick driver (no lax.scan).
 
-The scan-wrapped XLA tick chain's compile degenerates somewhere past
-n=40960 (PERF.md "Ceiling"; round-3 showed the fused-kernel scan escapes
-it on TPU, but the kernel doesn't lower on CPU outside interpret mode).
-A SINGLE jitted tick ("tick1" in tools/compile_wall.py) never hit the
-wall, so this driver steps jit(sparse_tick) in a Python loop — identical
-protocol semantics, chunk-boundary slot frees via writeback_free, just
-host-side loop control — and appends the churn row with slot_overflow
-stats to EXPERIMENTS_r3.jsonl.
+Round-3 ran this because the scan-wrapped chain's compile degenerated past
+~40k on that round's box; round-4 measurement (tools/compile_diag.py)
+shows THIS box compiles even the 102400 single tick in ~7 s and the scan
+chunk fine — compile walls are machine-dependent. The eager driver is kept
+as the churn-row vehicle anyway: identical protocol semantics,
+chunk-boundary slot frees via writeback_free, host-side loop control, and
+per-tick overflow visibility. Appends the churn row with slot_overflow
+stats to EXPERIMENTS_r4.jsonl.
 
-Usage: python tools/churn100k_eager.py [n] [ticks] [chunk]
+``S`` (4th arg) overrides the slot budget — 0 means apply the round-4
+sizing rule ``slot_budget_for(base, n, churn_rate)`` (sim/sparse.py) so
+the row demonstrates the rule keeping ``slot_overflow == 0`` at the same
+churn the default budget saturates under.
+
+Usage: python tools/churn100k_eager.py [n] [ticks] [chunk] [S]
 """
 
 import json
@@ -37,6 +42,7 @@ from scalecube_cluster_tpu.sim.sparse import (
     init_sparse_full_view,
     kill_sparse,
     restart_many_sparse,
+    slot_budget_for,
     sparse_tick,
     writeback_free,
 )
@@ -44,9 +50,25 @@ from scalecube_cluster_tpu.sim.sparse import (
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 102400
 ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 96
 chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 48
+S_arg = int(sys.argv[4]) if len(sys.argv) > 4 else None
 churn_per_chunk = 1024
 
-params = SparseParams.for_n(n, in_scan_writeback=False)
+if S_arg == 0:
+    # Round-4 sizing rule for this scenario: arrivals per chunk are the
+    # kills PLUS the revived half (restarts activate the new ALIVE@epoch+1
+    # record's slot too), and slots free only at chunk boundaries here
+    # (host-boundary writeback_free), so the free cadence is `chunk`.
+    base = SparseParams.for_n(n).base
+    arrivals_per_tick = (churn_per_chunk * 1.5) / chunk
+    S_arg = slot_budget_for(
+        base, n, arrivals_per_tick / n, writeback_period=chunk
+    )
+    print(f"sizing rule: S = {S_arg}", flush=True)
+params = SparseParams.for_n(
+    n,
+    in_scan_writeback=False,
+    **({"slot_budget": S_arg} if S_arg else {}),
+)
 state = init_sparse_full_view(n, params.slot_budget)
 plan = FaultPlan.uniform(loss_percent=1.0)
 rng = np.random.default_rng(0)
@@ -122,17 +144,16 @@ row = {
     "note": (
         f"churn at n={n}"
         + (" (the BASELINE 100k config)" if n == 102400 else "")
-        + ", eager per-tick driver (tools/churn100k_eager.py): the "
-        "scan-wrapped XLA chain's compile degenerates at this n; "
-        "single-tick jit does not. First tick includes compile; throughput "
-        "here is a CPU floor, not a TPU number."
+        + ", eager per-tick driver (tools/churn100k_eager.py). First tick "
+        "includes compile; throughput here is a CPU floor, not a TPU "
+        "number."
     ),
 }
 print(json.dumps(row), flush=True)
 with open(
     os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "EXPERIMENTS_r3.jsonl",
+        "EXPERIMENTS_r4.jsonl",
     ),
     "a",
 ) as fh:

@@ -1,0 +1,90 @@
+"""Diagnose the XLA:CPU compile degeneration of the sparse tick at large n.
+
+PERF.md round-3 finding: at n=102400 even a SINGLE sparse tick's XLA:CPU
+compile runs >55 min without completing on this box, while 49152 compiles
+in minutes — super-linear compile scaling that blocks the literal 100k
+churn row (VERDICT r3 item 3). This tool measures where that time goes:
+
+- ``ladder``: AOT lower+compile (eval_shape args — no state materialized)
+  at a ladder of n, printing lowering and compile wall times separately.
+  Run each rung in a fresh process with a timeout; a timeout IS the data
+  point (compile > limit).
+- ``dump``: one rung with ``--xla_dump_hlo_pass_re`` enabled; after a
+  kill/timeout the dump directory's file mtimes identify the pass that
+  degenerates (the last dumped file precedes the stuck pass). If every HLO
+  pass completes and it still hangs, the time is in LLVM backend emission.
+
+Usage:
+  python tools/compile_diag.py ladder <n> [chunk] [S]
+  python tools/compile_diag.py sharded <n> [chunk] [S]   # 8-dev SPMD compile
+  python tools/compile_diag.py dump <n> <dumpdir> [chunk] [S]
+
+CPU-only and fully local (client-side XLA:CPU): killing this process
+aborts the compile — unlike TPU-tunnel compiles, safe to timeout freely.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+mode = sys.argv[1] if len(sys.argv) > 1 else "ladder"
+n = int(sys.argv[2]) if len(sys.argv) > 2 else 49152
+
+if mode == "dump":
+    dumpdir = sys.argv[3]
+    chunk = int(sys.argv[4]) if len(sys.argv) > 4 else 1
+    S = int(sys.argv[5]) if len(sys.argv) > 5 else 2048
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_dump_to={dumpdir} --xla_dump_hlo_pass_re=.*"
+    )
+else:
+    chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 1
+    S = int(sys.argv[4]) if len(sys.argv) > 4 else 2048
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+if mode == "sharded":
+    jax.config.update("jax_num_cpu_devices", 8)
+
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    run_sparse_ticks,
+)
+
+# in_scan_writeback=False matches every production big-n driver
+# (dryrun_sparse, bench.py, the churn tools — all use host-boundary
+# writeback_free since round 4; the in-scan form's cond write-back costs a
+# resident [N, N/D] temp per device and is only used at small n).
+params = SparseParams.for_n(n, slot_budget=S, in_scan_writeback=False)
+state = jax.eval_shape(lambda: init_sparse_full_view(n, slot_budget=S))
+plan = jax.eval_shape(lambda: FaultPlan.uniform())
+
+if mode == "sharded":
+    from scalecube_cluster_tpu.parallel import make_mesh
+    from scalecube_cluster_tpu.parallel.mesh import sparse_state_shardings
+
+    mesh = make_mesh(jax.devices()[:8])
+    sh = sparse_state_shardings(mesh)
+    state = jax.tree.map(
+        lambda s, d: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=d),
+        state,
+        sh,
+    )
+
+t0 = time.time()
+lowered = run_sparse_ticks.lower(params, state, plan, chunk, collect=False)
+t1 = time.time()
+print(f"LOWERED mode={mode} n={n} S={S} chunk={chunk} in {t1 - t0:.1f}s", flush=True)
+compiled = lowered.compile()
+t2 = time.time()
+print(f"COMPILE_OK mode={mode} n={n} S={S} chunk={chunk} in {t2 - t1:.1f}s", flush=True)
+try:
+    print(compiled.memory_analysis(), flush=True)
+except Exception as e:
+    print("memory_analysis unavailable:", e)

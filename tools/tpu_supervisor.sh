@@ -91,7 +91,10 @@ PYEOF
   # Round-3 perf levers (tools written this session): slot-budget cost is
   # ~linear in S and legitimate to shrink if slot_overflow stays 0; the
   # super-linear per-tick growth past 32768 needs per-piece attribution.
-  timeout 900 python tools/s_sensitivity.py 32768 1024 1536 2048 >>"$LOG" 2>&1
+  # 512 leads: artifacts/s_overflow_check.json proved the bench trajectory
+  # peaks at 455 slots (overflow 0 at 512/1024), so 512 is the candidate
+  # headline S; 2048 is the round-3 control.
+  timeout 900 python tools/s_sensitivity.py 32768 512 1024 2048 >>"$LOG" 2>&1
   sleep 10
   timeout 900 python tools/nscale_profile.py full kernel select ring -- 32768 49152 >>"$LOG" 2>&1
   sleep 10
@@ -99,6 +102,12 @@ PYEOF
 
   echo "--- [4/6] dense control ($(date -u +%FT%TZ)) ---" >>"$LOG"
   timeout 600 python tools/chunk_times.py 2>&1 | tail -30 >>"$LOG"
+  cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
+
+  echo "--- [4b/6] BASELINE grid on-chip -> EXPERIMENTS_r4 ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  if [ ! -f /root/repo/tools/.grid_done ]; then
+    timeout 1800 python tools/run_grid.py large >>"$LOG" 2>&1 && touch /root/repo/tools/.grid_done
+  fi
   cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
 
   # Compile-wall matrix LAST: an abandoned server-side XLA compile can
