@@ -49,7 +49,10 @@ _PARITY_FIELDS = (
     "alive",
     "useen",
     "uage",
+    "uinf_ids",
+    "uptr",
     "tick",
+    "rng",
 )
 
 #: Segment plan: (ticks, host_op) — op applied BEFORE the segment runs.
@@ -67,12 +70,16 @@ def certify_params(n: int) -> SparseParams:
     return dataclasses.replace(SparseParams.for_n(n), base=base)
 
 
-def _subject_statuses(state: SparseState, j: int) -> jax.Array:
-    """Every viewer's status belief about subject ``j`` (slab overlays
-    view_T) — O(N), no [N, N] materialization."""
+def _subject_col(state: SparseState, j: int) -> jax.Array:
+    """Every viewer's record key for subject ``j`` (slab overlays view_T)
+    — O(N), no [N, N] materialization. The ONE place the overlay rule
+    lives in this module."""
     s = int(state.subj_slot[j])
-    col = state.slab[:, s] if s >= 0 else state.view_T[j, :]
-    return decode_status(col)
+    return state.slab[:, s] if s >= 0 else state.view_T[j, :]
+
+
+def _subject_statuses(state: SparseState, j: int) -> jax.Array:
+    return decode_status(_subject_col(state, j))
 
 
 def _assert_parity(ref: SparseState, sh: SparseState, where: str) -> None:
@@ -167,8 +174,7 @@ def sparse_full_cadence_certify(
     # Early-killed member was declared DEAD, restarted with an epoch bump,
     # and the new identity has been re-admitted by (at least most) viewers.
     assert int(jax.device_get(ref.epoch[KILLED_EARLY])) == 1, "epoch must bump"
-    s = int(ref.subj_slot[KILLED_EARLY])
-    col = ref.slab[:, s] if s >= 0 else ref.view_T[KILLED_EARLY, :]
+    col = _subject_col(ref, KILLED_EARLY)
     readmitted = (st_early == alive) & (jax.device_get(decode_epoch(col)) == 1)
     events["readmitted_viewers"] = int((readmitted & live).sum())
     assert events["readmitted_viewers"] > 0.9 * live.sum(), (

@@ -14,7 +14,7 @@ sizing rule ``slot_budget_for(base, n, churn_rate)`` (sim/sparse.py) so
 the row demonstrates the rule keeping ``slot_overflow == 0`` at the same
 churn the default budget saturates under.
 
-Usage: python tools/churn100k_eager.py [n] [ticks] [chunk] [S]
+Usage: python tools/churn100k_eager.py [n] [ticks] [chunk] [S] [churn_per_chunk]
 """
 
 import json
@@ -51,8 +51,9 @@ n = int(sys.argv[1]) if len(sys.argv) > 1 else 102400
 ticks = int(sys.argv[2]) if len(sys.argv) > 2 else 96
 chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 48
 S_arg = int(sys.argv[4]) if len(sys.argv) > 4 else None
-churn_per_chunk = 1024
+churn_per_chunk = int(sys.argv[5]) if len(sys.argv) > 5 else 1024
 
+alloc_cap_override = None
 if S_arg == 0:
     # Round-4 sizing rule for this scenario: arrivals per chunk are the
     # kills PLUS the revived half (restarts activate the new ALIVE@epoch+1
@@ -63,11 +64,17 @@ if S_arg == 0:
     S_arg = slot_budget_for(
         base, n, arrivals_per_tick / n, writeback_period=chunk
     )
-    print(f"sizing rule: S = {S_arg}", flush=True)
+    # Overflow counts DROPPED requests, and alloc_cap gates grants per
+    # tick: the chunk-boundary burst (the whole fresh-churned cohort can
+    # be FD-probed within the first fd period) must be admittable, or the
+    # demo reports cap-gate overflow with slots still free.
+    alloc_cap_override = (churn_per_chunk * 3) // 2 + 64
+    print(f"sizing rule: S = {S_arg}, alloc_cap = {alloc_cap_override}", flush=True)
 params = SparseParams.for_n(
     n,
     in_scan_writeback=False,
     **({"slot_budget": S_arg} if S_arg else {}),
+    **({"alloc_cap": alloc_cap_override} if alloc_cap_override else {}),
 )
 state = init_sparse_full_view(n, params.slot_budget)
 plan = FaultPlan.uniform(loss_percent=1.0)
@@ -111,7 +118,8 @@ while done < ticks:
             )
     state = writeback_free(params, state)
     int(state.view_T[0, 0])
-    dt += time.perf_counter() - t0
+    chunk_dt = time.perf_counter() - t0
+    dt += chunk_dt
     done += chunk
     # Outside the timed region: drain this chunk's queued overflow scalars
     # so a killed multi-hour run still showed its saturation signal.
@@ -121,9 +129,39 @@ while done < ticks:
         f"overflow_so_far={sum(float(o) for o in overflow_per_tick):.0f} "
         f"chunk_peak={max(chunk_overflow):.0f} "
         f"active={int(jnp.sum(state.slot_subj >= 0))} "
+        f"chunk_dt={chunk_dt:.1f}s "
         f"({(time.perf_counter() - t_all) / 60:.1f} min elapsed)",
         flush=True,
     )
+    # Crash-proof cumulative snapshot: a timeout-killed multi-hour run
+    # keeps its latest complete-chunk stats (attempt 1 of the 102400 row
+    # lost its EXPERIMENTS row exactly this way).
+    snap = {
+        "scenario": "sparse_churn",
+        "n": n,
+        "churn_per_chunk": churn_per_chunk,
+        "ticks": done,
+        "partial": done < ticks,
+        "slot_overflow_total": float(sum(float(o) for o in overflow_per_tick)),
+        # Whole-run peak, not last-chunk peak: the snapshot exists to
+        # preserve the saturation signal of a later-killed run.
+        "slot_overflow_max_per_tick": float(
+            max(float(o) for o in overflow_per_tick)
+        ),
+        "active_slots": int(jnp.sum(state.slot_subj >= 0)),
+        "slot_budget": params.slot_budget,
+        "member_rounds_per_sec": round(n * done / dt, 1),
+        "backend": "cpu",
+    }
+    with open(
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "artifacts",
+            f"churn_partial_{n}_S{params.slot_budget}.json",
+        ),
+        "w",
+    ) as fh:
+        json.dump(snap, fh, indent=2)
 
 overflow_arr = np.asarray([float(o) for o in overflow_per_tick])
 max_overflow = float(overflow_arr.max()) if overflow_arr.size else 0.0

@@ -30,6 +30,11 @@ OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
 from scalecube_cluster_tpu.experiments.scenarios import run_all
 
 platform = jax.devices()[0].platform
+if os.environ.get("REQUIRE_TPU") and platform not in ("tpu", "axon"):
+    # The supervisor gates its done-marker on this exit code: a silent
+    # CPU fallback must not permanently suppress the on-chip grid.
+    print(f"REQUIRE_TPU set but backend is {platform}; refusing to run")
+    sys.exit(3)
 commit = subprocess.run(
     ["git", "rev-parse", "--short", "HEAD"], capture_output=True, text=True,
     cwd=os.path.dirname(OUT),

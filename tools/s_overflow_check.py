@@ -75,6 +75,12 @@ base = SparseParams.for_n(n).base
 out["sizing_rule_min_S"] = slot_budget_for(
     base, n, churn_rate=1.0 / n / (CHUNK * (REPS + 1))
 )
-with open("/root/repo/artifacts/s_overflow_check.json", "w") as f:
+_ART = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "artifacts",
+    "s_overflow_check.json",
+)
+os.makedirs(os.path.dirname(_ART), exist_ok=True)
+with open(_ART, "w") as f:
     json.dump(out, f, indent=2)
 print(json.dumps(out, indent=2))

@@ -106,7 +106,7 @@ PYEOF
 
   echo "--- [4b/6] BASELINE grid on-chip -> EXPERIMENTS_r4 ($(date -u +%FT%TZ)) ---" >>"$LOG"
   if [ ! -f /root/repo/tools/.grid_done ]; then
-    timeout 1800 python tools/run_grid.py large >>"$LOG" 2>&1 && touch /root/repo/tools/.grid_done
+    REQUIRE_TPU=1 timeout 1800 python tools/run_grid.py large >>"$LOG" 2>&1 && touch /root/repo/tools/.grid_done
   fi
   cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
 
