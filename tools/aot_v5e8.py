@@ -18,7 +18,10 @@ Reports compile wall time and the compiler's own per-device memory
 accounting (CompiledMemoryStats are per-device for SPMD programs) against
 the 16 GiB v5e HBM budget.
 
-Usage: python tools/aot_v5e8.py [n] [S] [chunk] [topology]
+Usage: python tools/aot_v5e8.py [n] [S] [chunk] [topology] [mesh2d_dm,ds]
+The optional 5th arg selects the 2D viewer×subject layout (e.g. "8,2" on
+a v5e:4x4 16-device topology) — the memory layout for member counts whose
+full [N_subj, N_view/D] panel no longer fits one device.
 """
 
 import os
@@ -28,16 +31,19 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
-import numpy as np
 from jax.experimental import topologies
-from jax.sharding import Mesh
 
 n = int(sys.argv[1]) if len(sys.argv) > 1 else 102400
 S = int(sys.argv[2]) if len(sys.argv) > 2 else 2048
 chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 48
 topo_name = sys.argv[4] if len(sys.argv) > 4 else "v5e:2x4"
+mesh2d = sys.argv[5] if len(sys.argv) > 5 else None
 
-from scalecube_cluster_tpu.parallel.mesh import AXIS, sparse_state_shardings
+from scalecube_cluster_tpu.parallel.mesh import (
+    make_mesh,
+    make_mesh2d,
+    sparse_state_shardings,
+)
 from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.sparse import (
     SparseParams,
@@ -48,7 +54,14 @@ from scalecube_cluster_tpu.sim.sparse import (
 topo = topologies.get_topology_desc(topo_name, "tpu")
 print(f"topology {topo_name}: {len(topo.devices)} compile-only devices, "
       f"kind={topo.devices[0].device_kind}", flush=True)
-mesh = Mesh(np.array(topo.devices), (AXIS,))
+if mesh2d:
+    dm, ds = (int(x) for x in mesh2d.split(","))
+    # The production mesh constructors, so this tool certifies the exact
+    # layout the engine ships with.
+    mesh = make_mesh2d((dm, ds), topo.devices)
+    print(f"2D viewer×subject mesh: {dm}x{ds}", flush=True)
+else:
+    mesh = make_mesh(topo.devices)
 
 GIB = 2**30
 
