@@ -42,6 +42,22 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "asyncio: run the (async) test function on a fresh event loop"
     )
+    config.addinivalue_line(
+        "markers",
+        "deep: minutes-long validation runs (full-cadence certification, "
+        "big-n heal crossvals, long overflow properties). The fast inner "
+        "loop is `-m fast` (everything else, <5 min); CI runs both.",
+    )
+    config.addinivalue_line(
+        "markers", "fast: auto-applied complement of `deep` — see that marker"
+    )
+
+
+def pytest_collection_modifyitems(config, items):
+    # `-m fast` == `-m "not deep"`: every un-marked test is the fast tier.
+    for item in items:
+        if "deep" not in item.keywords:
+            item.add_marker(pytest.mark.fast)
 
 
 def pytest_pyfunc_call(pyfuncitem):
