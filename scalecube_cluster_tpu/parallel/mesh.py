@@ -79,6 +79,7 @@ def state_shardings(mesh: Mesh) -> SimState:
         useen=srow,
         uage=srow,
         uinf=NamedSharding(mesh, P(AXIS, None, None)),
+        uflight=NamedSharding(mesh, P(AXIS, None, None)),
         tick=rep,
         rng=rep,
     )

@@ -68,6 +68,15 @@ def load_checkpoint(path: str | Path) -> tuple[SimState, SimParams]:
             arrays["rows"] = jnp.where(
                 arrays["rumor_age"] < params.periods_to_spread, arrays["view"], -1
             )
+        if "uflight" not in arrays:
+            # Pre-delay-model snapshot: nothing was in flight (the model did
+            # not exist), so an all-false ledger is exact — stub-sized
+            # unless the loaded params arm the model (full [N, N, G] would
+            # silently double tracked snapshots' O(N²G) state on resume).
+            src = arrays["uinf"]
+            arrays["uflight"] = jnp.zeros_like(
+                src if getattr(params, "gossip_delay_model", False) else src[:, :1]
+            )
         if "known_cnt" not in arrays:
             view = arrays["view"]
             diag = jnp.eye(view.shape[0], dtype=bool)

@@ -136,6 +136,30 @@ def link_pass(
     return ~blocked & (u >= loss)
 
 
+def link_delay_within_tick(
+    rng: jax.Array, plan: FaultPlan, src: jax.Array, dst: jax.Array, tick_ms: float
+) -> jax.Array:
+    """Sample "an exponential link delay elapses within one tick" per edge.
+
+    ``P(Exp(mean) < tick_ms) = 1 - exp(-tick_ms / mean)``; a zero mean is a
+    delay-free link (always True — and since ``jax.random.uniform`` draws in
+    ``[0, 1)``, the draw is a no-op bit-for-bit, so delay-free trajectories
+    are unchanged by the model being armed). The exponential is memoryless,
+    so re-drawing this SAME predicate each tick for a still-in-flight message
+    bins its true arrival time to tick granularity *exactly* — the geometric
+    number of failed draws is the floor of the exponential in tick units.
+    Used by the dense engine's delay-aware user-gossip path
+    (sim/tick.py step 6; OutboundSettings.evaluateDelay semantics,
+    NetworkEmulator.java:363-368).
+    """
+    mean = _edge_lookup(plan.mean_delay, src, dst)
+    p = jnp.where(
+        mean > 0, 1.0 - jnp.exp(-tick_ms / jnp.maximum(mean, 1e-9)), 1.0
+    )
+    u = jax.random.uniform(rng, jnp.shape(mean))
+    return u < p
+
+
 def round_trip_in_time(
     rng: jax.Array,
     plan: FaultPlan,

@@ -56,6 +56,21 @@ class SimParams:
     #: memory — validation scale only; the state must be built with a
     #: matching ``track_infected`` (sim/state.py::init_full_view).
     track_user_infected: bool = False
+    #: One tick's wall-clock span in ms (the gossip interval,
+    #: sim/params.py module doc) — the unit that bins FaultPlan's
+    #: millisecond exponential delays to periods.
+    tick_ms: int = 200
+    #: Model per-link delivery delay for USER gossip (period-binned
+    #: exponential, faults.py::link_delay_within_tick): messages whose delay
+    #: outlives the tick go in flight (SimState.uflight) and re-draw each
+    #: period — exact for exponential delays by memorylessness. Requires
+    #: ``track_user_infected`` (the in-flight ledger is keyed by sender for
+    #: the infected-set record on arrival). Membership rumors keep the
+    #: delayed⇒dropped-this-tick model and FD probes their Erlang deadline
+    #: draw (sim/faults.py module doc) — the delay-bearing reference grid
+    #: (GossipProtocolTest.java:48-64) measures user-gossip dissemination,
+    #: which this flag makes faithful.
+    gossip_delay_model: bool = False
 
     def __post_init__(self):
         # Dtype envelopes of the state arrays (sim/state.py): rumor_age is
@@ -103,4 +118,5 @@ class SimParams:
             ping_timeout_ms=fd.ping_timeout,
             ping_req_timeout_ms=max(1, fd.ping_interval - fd.ping_timeout),
             user_gossip_slots=user_gossip_slots,
+            tick_ms=tick_ms,
         )

@@ -73,6 +73,13 @@ class SimState:
     useen: jax.Array  # [N, G] bool
     uage: jax.Array  # [N, G] int32
     uinf: jax.Array  # [N, N, G] bool (or [N, 1, G] stub when untracked)
+    #: In-flight user-gossip messages under the period-binned delay model
+    #: (SimParams.gossip_delay_model): [recv, sender, G] — a sent copy that
+    #: outlived its send tick's delay draw waits here, re-drawing each tick
+    #: (memoryless-exact for exponential delays). Full-size only when the
+    #: state is built with ``delay_model=True``; a [N, 1, G] stub otherwise,
+    #: so tracked runs without the model don't double their O(N²G) state.
+    uflight: jax.Array  # [N, N, G] bool (or [N, 1, G] stub)
     tick: jax.Array  # [] int32
     rng: jax.Array  # PRNG key
 
@@ -80,7 +87,9 @@ class SimState:
         return dataclasses.replace(self, **changes)
 
 
-def _blank(n: int, slots: int, seed: int, track_infected: bool) -> SimState:
+def _blank(
+    n: int, slots: int, seed: int, track_infected: bool, delay_model: bool = False
+) -> SimState:
     return SimState(
         view=jnp.full((n, n), merge_ops.UNKNOWN_KEY, jnp.int32),
         rumor_age=jnp.full((n, n), AGE_STALE, jnp.int8),
@@ -93,6 +102,9 @@ def _blank(n: int, slots: int, seed: int, track_infected: bool) -> SimState:
         useen=jnp.zeros((n, slots), bool),
         uage=jnp.zeros((n, slots), jnp.int32),
         uinf=jnp.zeros((n, n if track_infected else 1, slots), bool),
+        uflight=jnp.zeros(
+            (n, n if (track_infected and delay_model) else 1, slots), bool
+        ),
         tick=jnp.zeros((), jnp.int32),
         rng=jax.random.PRNGKey(seed),
     )
@@ -103,15 +115,17 @@ def init_full_view(
     user_gossip_slots: int = 4,
     seed: int = 0,
     track_infected: bool = False,
+    delay_model: bool = False,
 ) -> SimState:
     """Post-join steady state: everyone knows everyone ALIVE at incarnation 0.
 
     The standard starting point for convergence / failure studies (the state
     the reference reaches after ClusterTest.java:88-114's join phase).
     ``track_infected`` sizes ``uinf`` for per-rumor suppression accounting
-    (SimParams.track_user_infected must match).
+    (SimParams.track_user_infected must match); ``delay_model`` additionally
+    sizes the ``uflight`` in-flight ledger (SimParams.gossip_delay_model).
     """
-    state = _blank(n, user_gossip_slots, seed, track_infected)
+    state = _blank(n, user_gossip_slots, seed, track_infected, delay_model)
     alive_keys = merge_ops.encode_key(
         jnp.zeros((n, n), jnp.int32), jnp.zeros((n, n), jnp.int32)
     )
@@ -128,6 +142,7 @@ def init_seeded(
     user_gossip_slots: int = 4,
     seed: int = 0,
     track_infected: bool = False,
+    delay_model: bool = False,
 ) -> SimState:
     """Cold join: node i knows only itself; seed addresses are config-known.
 
@@ -137,7 +152,7 @@ def init_seeded(
     always treats the seed mask as eligible partners, which reproduces the
     initial-sync join flow tick by tick.
     """
-    state = _blank(n, user_gossip_slots, seed, track_infected)
+    state = _blank(n, user_gossip_slots, seed, track_infected, delay_model)
     diag = jnp.eye(n, dtype=bool)
     self_key = merge_ops.encode_key(jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
     view = jnp.where(diag, self_key, merge_ops.UNKNOWN_KEY)
@@ -227,6 +242,10 @@ def restart(state: SimState, idx) -> SimState:
             if state.uinf.shape[1] == state.view.shape[0]
             else state.uinf.at[idx].set(False)
         ),
+        # A restarted process has a fresh socket: copies in flight TO the old
+        # incarnation are lost (row idx); copies it SENT keep flying (the
+        # bytes are on the wire regardless of the sender's fate).
+        uflight=state.uflight.at[idx].set(False),
     )
 
 

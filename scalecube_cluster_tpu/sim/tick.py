@@ -113,7 +113,12 @@ from scalecube_cluster_tpu.ops.select import (
     masked_random_topk,
     probe_cursor_targets,
 )
-from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass, round_trip_in_time
+from scalecube_cluster_tpu.sim.faults import (
+    FaultPlan,
+    link_delay_within_tick,
+    link_pass,
+    round_trip_in_time,
+)
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import AGE_STALE, SimState
 from scalecube_cluster_tpu.sim.usergossip import AGE_CAP as _AGE_CAP, user_gossip_step
@@ -236,6 +241,16 @@ def sim_tick(
         raise ValueError(
             "track_user_infected needs state built with track_infected=True "
             f"(uinf is {state.uinf.shape}, want ({n}, {n}, G))"
+        )
+    if params.gossip_delay_model and not params.track_user_infected:
+        raise ValueError(
+            "gossip_delay_model needs track_user_infected=True (the "
+            "in-flight ledger is keyed by sender for the infected-set record)"
+        )
+    if params.gossip_delay_model and state.uflight.shape[1] != n:
+        raise ValueError(
+            "gossip_delay_model needs state built with delay_model=True "
+            f"(uflight is {state.uflight.shape}, want ({n}, {n}, G))"
         )
     t = state.tick + 1
     keys = jax.random.split(state.rng, 8)
@@ -512,13 +527,61 @@ def sim_tick(
             sent_cols.append(sent_c)
         got = jnp.zeros_like(urows)
         uinf_new = uinf
+        uflight = state.uflight
         onehots = col[None, :] == inv_perm[:, :, None]  # [f, N(recv), N]
-        for c in range(params.gossip_fanout):
-            arrived = sent_cols[c] & edge_ok[c][:, None]  # [N, G]
-            got = got | arrived
-            # Receiver j marks sender inv_perm[c, j] infected for each slot
-            # that arrived (onGossipReq, GossipProtocolImpl.java:171-183).
-            uinf_new = uinf_new | (onehots[c][:, :, None] & arrived[:, None, :])
+        if params.gossip_delay_model:
+            # Period-binned exponential delivery delay (NetworkEmulator
+            # evaluateDelay semantics, :363-368): a loss-surviving copy
+            # arrives this tick iff its delay draw beats tick_ms — ONE draw
+            # per edge, because the host batches all slots for a peer into
+            # one gossip request (GossipProtocolImpl.java:139-157), so the
+            # whole batch shares one delay. Late copies enter the in-flight
+            # ledger and re-draw per tick (memoryless-exact; see
+            # faults.py::link_delay_within_tick). Keys derive by fold_in so
+            # every OTHER protocol stream keeps its exact bits.
+            dkeys = jax.random.split(
+                jax.random.fold_in(k_glink, 7), params.gossip_fanout + 1
+            )
+            # In-flight re-draw FIRST, against the PRE-merge ledger: copies
+            # held from earlier ticks get exactly one draw per tick, and a
+            # copy first held THIS tick draws again only next tick — so
+            # P(arrive k ticks after send) is exactly q(1-q)^k, the
+            # period-binned exponential. (Drawing against the merged ledger
+            # would give same-tick copies a second chance: 1-(1-q)².) One
+            # draw per (recv, sender) link: same-tick batches on a link
+            # share fate (one message), and different-tick copies on one
+            # link share a draw too — a FIFO-connection approximation the
+            # cached-TCP host transport also exhibits.
+            dlv = link_delay_within_tick(
+                dkeys[-1], plan, col[None, :], col[:, None], params.tick_ms
+            )  # [N(recv), N(sender)]
+            delivered = uflight & dlv[:, :, None]
+            got = got | jnp.any(delivered, axis=1)
+            uinf_new = uinf_new | delivered
+            uflight = uflight & ~delivered
+            for c in range(params.gossip_fanout):
+                in_transit = sent_cols[c] & edge_ok[c][:, None]  # [N, G]
+                dnow = link_delay_within_tick(
+                    dkeys[c], plan, inv_perm[c], i_idx, params.tick_ms
+                )  # [N(recv)]
+                arrived = in_transit & dnow[:, None]
+                got = got | arrived
+                uinf_new = uinf_new | (
+                    onehots[c][:, :, None] & arrived[:, None, :]
+                )
+                uflight = uflight | (
+                    onehots[c][:, :, None] & (in_transit & ~dnow[:, None])[:, None, :]
+                )
+        else:
+            for c in range(params.gossip_fanout):
+                arrived = sent_cols[c] & edge_ok[c][:, None]  # [N, G]
+                got = got | arrived
+                # Receiver j marks sender inv_perm[c, j] infected for each
+                # slot that arrived (onGossipReq,
+                # GossipProtocolImpl.java:171-183).
+                uinf_new = uinf_new | (
+                    onehots[c][:, :, None] & arrived[:, None, :]
+                )
         msgs_user = sum(jnp.sum(s, axis=0) for s in sent_cols)  # [G] sends
         new_seen = state.useen | (got & alive[:, None])
         first_seen = new_seen & ~state.useen
@@ -530,8 +593,10 @@ def sim_tick(
         # sim/monitor.py::user_gossip_swept.
         swept = new_seen & (uage > params.periods_to_sweep)
         new_seen = new_seen & ~swept
-        # Sweeping drops the whole GossipState, infected set included.
+        # Sweeping drops the whole GossipState, infected set AND any copies
+        # still in flight to this receiver (dedup-map removal, :281-304).
         uinf_new = uinf_new & ~swept[:, None, :]
+        uflight = uflight & ~swept[:, None, :]
     else:
         # Untracked lifecycle: the engine-shared helper (also used by the
         # compact-rumor engine, sim/sparse.py step 8).
@@ -545,6 +610,7 @@ def sim_tick(
             params.periods_to_sweep,
         )
         uinf_new = state.uinf
+        uflight = state.uflight
 
     # ------------------------------------------------------------- metrics
     new_state = state.replace(
@@ -557,6 +623,7 @@ def sim_tick(
         useen=new_seen,
         uage=uage,
         uinf=uinf_new,
+        uflight=uflight,
         tick=t,
         rng=rng_next,
     )

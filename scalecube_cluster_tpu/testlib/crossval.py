@@ -162,13 +162,29 @@ async def host_gossip_mesh_run(
     loss_percent: float,
     periods: int,
     seed: int = 0,
-) -> tuple[np.ndarray, int]:
+    mean_delay_ms: float = 0.0,
+    gossip_interval_ms: int = 50,
+    with_events: bool = False,
+):
     """Gossip-only mesh trial: ``(coverage[periods] by period, total sends)``.
 
     Mirrors GossipProtocolTest.java:48-64's experiment setup (protocol
-    instances over emulator transports, no membership machinery).
+    instances over emulator transports, no membership machinery), including
+    the grid's loss AND mean-delay axes.
+
+    ``with_events=True`` appends a third element: an event record with each
+    node's infection wall-time and the origin's period-boundary wall-times.
+    This is the instrumentation that settles the align_shift question
+    (round-4 verdict weak #6): ``coverage[p]`` above is sampled AT the
+    (p+1)-th timer fire — i.e. it counts infections from fan-outs 1..p,
+    because fan-out p+1's sends haven't been delivered yet when the counter
+    increments — while the sim's tick is atomic (tick p's sends land inside
+    coverage[p]). Event-time re-binning (``event_binned_coverage``) counts
+    infections delivered by fan-out p+1 into period p, which is the sim's
+    own convention — no alignment search needed.
     """
     import random
+    import time
 
     from scalecube_cluster_tpu.cluster.gossip import GossipProtocol
     from scalecube_cluster_tpu.cluster_api.config import GossipConfig
@@ -179,12 +195,16 @@ async def host_gossip_mesh_run(
     )
     from scalecube_cluster_tpu.transport.tcp import TcpTransport
 
-    config = GossipConfig(gossip_interval=50, gossip_fanout=3, gossip_repeat_mult=3)
+    config = GossipConfig(
+        gossip_interval=gossip_interval_ms, gossip_fanout=3, gossip_repeat_mult=3
+    )
     transports, members, protocols = [], [], []
     for i in range(n):
         t = NetworkEmulatorTransport(await TcpTransport.bind(), seed=seed * 1000 + i)
-        if loss_percent:
-            t.network_emulator.set_default_outbound_settings(loss_percent)
+        if loss_percent or mean_delay_ms:
+            t.network_emulator.set_default_outbound_settings(
+                loss_percent, mean_delay_ms
+            )
         m = Member.create(t.address)
         transports.append(t)
         members.append(m)
@@ -193,10 +213,15 @@ async def host_gossip_mesh_run(
         )
     got = [False] * n
     got[0] = True
+    infect_t: list[float | None] = [None] * n
+    infect_t[0] = 0.0
+    boundary_t: list[float] = []
     watchers = []
 
     async def watch(idx, proto):
         async for _ in proto.listen():
+            if not got[idx]:
+                infect_t[idx] = time.monotonic()
             got[idx] = True
 
     try:
@@ -216,7 +241,9 @@ async def host_gossip_mesh_run(
             if origin.period > p_seen:
                 # Record one sample per elapsed origin period (period-indexed
                 # x-axis — immune to event-loop scheduling jitter).
+                now = time.monotonic()
                 for _ in range(origin.period - p_seen):
+                    boundary_t.append(now)
                     if filled < periods:
                         coverage[filled] = sum(got) / n
                         filled += 1
@@ -224,7 +251,14 @@ async def host_gossip_mesh_run(
         sends = sum(
             t.network_emulator.total_message_sent_count for t in transports
         )
-        return coverage, sends
+        if not with_events:
+            return coverage, sends
+        events = {
+            "infect_t": list(infect_t),
+            "boundary_t": boundary_t,
+            "interval_s": config.gossip_interval / 1000.0,
+        }
+        return coverage, sends, events
     finally:
         for w in watchers:
             w.cancel()
@@ -235,15 +269,42 @@ async def host_gossip_mesh_run(
         )
 
 
+def event_binned_coverage(events: dict, periods: int, n: int) -> np.ndarray:
+    """Re-bin a host trial's infection events onto the sim's x-axis.
+
+    Sim convention: ``coverage[p]`` includes everything the (p+1)-th fan-out
+    delivered. Host fan-out p+1 fires at ``boundary_t[p]`` and its deliveries
+    land shortly after, so period p's bin closes at the NEXT boundary
+    (``boundary_t[p+1]``): an infection belongs to period p when
+    ``t < boundary_t[p+1]``. This is exactly the boundary-sampled curve
+    shifted one period — computing it from raw event timestamps (rather than
+    shifting) makes the phase story empirical instead of a fitted offset.
+    """
+    bt = events["boundary_t"]
+    cov = np.zeros(periods)
+    times = [t for t in events["infect_t"] if t is not None]
+    for p in range(periods):
+        # Bin closes at boundary p+1; the final bin extrapolates one interval.
+        close = bt[p + 1] if p + 1 < len(bt) else bt[-1] + events["interval_s"]
+        cov[p] = sum(1 for t in times if t < close) / n
+    return cov
+
+
 def sim_gossip_run(
     n: int,
     loss_percent: float,
     periods: int,
     trials: int = 5,
     seed: int = 0,
+    mean_delay_ms: float = 0.0,
+    gossip_interval_ms: int = 50,
 ) -> tuple[np.ndarray, float]:
     """Sim twin of :func:`host_gossip_mesh_run` with suppression tracking:
-    ``(mean coverage[periods], mean total rumor-bearing sends)``."""
+    ``(mean coverage[periods], mean total rumor-bearing sends)``.
+
+    ``mean_delay_ms`` arms the period-binned exponential delivery-delay
+    model (SimParams.gossip_delay_model) against ``gossip_interval_ms``
+    ticks — the sim twin of the emulator's evaluateDelay axis."""
 
     import jax.numpy as jnp
 
@@ -267,13 +328,21 @@ def sim_gossip_run(
         suspicion_ticks=10 * periods,
         user_gossip_slots=1,
         track_user_infected=True,
+        tick_ms=gossip_interval_ms,
+        gossip_delay_model=mean_delay_ms > 0,
     )
     plan = FaultPlan.clean(n).with_loss(loss_percent)
+    if mean_delay_ms:
+        plan = plan.with_mean_delay(mean_delay_ms)
     seeds = seeds_mask(n, [0])
     curves, sends = [], []
     for trial in range(trials):
         state = init_full_view(
-            n, user_gossip_slots=1, seed=seed + trial, track_infected=True
+            n,
+            user_gossip_slots=1,
+            seed=seed + trial,
+            track_infected=True,
+            delay_model=mean_delay_ms > 0,
         )
         state = inject_gossip(state, 0, 0)
         _, traces = run_ticks(params, state, plan, seeds, periods)

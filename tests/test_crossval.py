@@ -12,20 +12,28 @@ the host side), so trials are averaged and completion periods are compared
 within a small window rather than bit-exactly. The period-indexed mesh
 comparison asserts aligned coverage gap <= 5% and message counts within 10%.
 
-The ±2% BASELINE aspiration HOLDS at scale (measured round 4,
-artifacts/crossval_r4.json via tools/crossval_100.py): averaging 100
-independent host trials per setting on a quiet box, the aligned mean gap is
-0.46% at loss=0 and 0.30% at loss=25, with sends ratios 1.022/1.025 —
-sampling error (max per-period SEM 1.2-1.6% even at 100 trials) was the
-dominant term in the few-trial runs, exactly as the round-1 blocker
-analysis predicted. What remains in CI: (a) at CI trial counts (~3), the
-per-period coverage std-error alone is 2-4%; (b) the host backend's period
-boundaries are wall-clock (gossipInterval timers racing asyncio scheduling
-under CI load) — handled by the period-indexed x-axis plus the 0-2-period
-alignment search; (c) loss draws are independent between backends by design
-(<1%, irreducible). The 5% gate is therefore the tight-but-stable envelope
-for CI, with the measured gap reported in the assertion message every run;
-the 100-trial artifact is the ±2% evidence on record.
+The ±2% BASELINE aspiration HOLDS at scale **as a mean-gap statement**
+(measured round 5, artifacts/crossval_r5.json via tools/crossval_100.py):
+over a 5-setting grid on the reference's own axes (n∈{32,50},
+loss∈{0,10,25}%, mean delay∈{0,2,100} ms — GossipProtocolTest.java:48-64),
+averaging 50-100 independent host trials per setting, the EVENT-BINNED
+mean gap (host infection wall-times re-binned onto the sim's x-axis
+convention — no fitted alignment) is 0.23-0.45%, with sends ratios
+1.02-1.04. Qualification (round-4 advisor): this is a mean over curves
+whose tails saturate at 1.0 on both backends; the max per-period transient
+gap is 1.7-7.1% against a per-period sampling SEM of 0.8-1.7%, reported
+alongside in the artifact — the ±2% claim is NOT a pointwise bound. The
+round-4 align_shift is retired: the measured median delivery lag behind
+period boundaries (0.13-0.29 periods) shows boundary sampling trails event
+binning by exactly one period, which is what the alignment search was
+fitting. What remains in CI: (a) at CI trial counts (~3), the per-period
+coverage std-error alone is 2-4%; (b) wall-clock period boundaries under
+CI load — handled by the period-indexed x-axis plus the 0-2-period
+alignment search (kept HERE because CI's 3 trials are too noisy for
+event binning to pay); (c) loss draws are independent between backends by
+design (<1%, irreducible). The 5% gate is therefore the tight-but-stable
+envelope for CI, with the measured gap reported every run; the O(100)-
+trial artifact is the ±2% evidence on record.
 """
 
 import numpy as np

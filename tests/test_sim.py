@@ -16,6 +16,7 @@ assertion (SURVEY.md §4 "weakness to inherit-and-fix"):
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 from scalecube_cluster_tpu.ops.merge import decode_epoch, decode_status
@@ -348,3 +349,82 @@ def test_user_gossip_message_counts_within_cluster_math_envelope():
     assert unsuppressed <= ceiling
     # Suppression must actually suppress: strictly fewer sends.
     assert suppressed < unsuppressed
+
+
+def test_gossip_delay_model_zero_delay_is_bit_invisible():
+    """Arming gossip_delay_model with a delay-free plan changes NOTHING —
+    bit-for-bit (the immediate-delivery draw is `u < 1.0` with u in [0,1),
+    always true; sim/faults.py::link_delay_within_tick). Guards every
+    existing trajectory against the round-5 delay-model addition."""
+    import dataclasses
+
+    n, ticks = 16, 20
+    plan = FaultPlan.clean(n).with_loss(20.0)
+    outs = []
+    for armed in (False, True):
+        p = dataclasses.replace(
+            small_params(n, user_gossip_slots=1),
+            track_user_infected=True,
+            gossip_delay_model=armed,
+            tick_ms=50,
+        )
+        st = init_full_view(
+            n, user_gossip_slots=1, seed=5, track_infected=True, delay_model=True
+        )
+        st = inject_gossip(st, 0, 0)
+        st, tr = run_ticks(p, st, plan, seeds_mask(n, [0]), ticks)
+        outs.append((st, tr))
+    (st_a, tr_a), (st_b, tr_b) = outs
+    for field in ("view", "useen", "uage", "uinf", "uflight", "rng"):
+        a = jax.device_get(getattr(st_a, field))
+        b = jax.device_get(getattr(st_b, field))
+        assert (a == b).all(), f"zero-delay divergence in {field}"
+    assert not jax.device_get(st_b.uflight).any(), "nothing may be in flight"
+    a = jax.device_get(jnp.stack(tr_a["gossip_coverage"]))
+    b = jax.device_get(jnp.stack(tr_b["gossip_coverage"]))
+    assert (a == b).all()
+
+
+def test_gossip_delay_model_defers_but_completes():
+    """With mean delay ~= the tick span, dissemination slows during the
+    transient (copies are genuinely in flight across period boundaries) but
+    still completes — delayed, never lost (evaluateDelay semantics,
+    NetworkEmulator.java:363-368, period-binned)."""
+    import dataclasses
+
+    n, ticks, trials = 24, 24, 6
+    cov = {0.0: [], 50.0: []}
+    for delay_ms in cov:
+        p = dataclasses.replace(
+            small_params(n, user_gossip_slots=1, periods_to_spread=12,
+                         periods_to_sweep=26),
+            track_user_infected=True,
+            gossip_delay_model=True,
+            tick_ms=50,
+            fd_period_ticks=1000,  # gossip-only, like the crossval mesh
+            sync_period_ticks=1000,
+            suspicion_ticks=1000,
+        )
+        plan = FaultPlan.clean(n).with_mean_delay(delay_ms)
+        for trial in range(trials):
+            st = init_full_view(
+                n,
+                user_gossip_slots=1,
+                seed=50 + trial,
+                track_infected=True,
+                delay_model=True,
+            )
+            st = inject_gossip(st, 0, 0)
+            st, tr = run_ticks(p, st, plan, seeds_mask(n, [0]), ticks)
+            cov[delay_ms].append(
+                np.asarray(jax.device_get(jnp.stack(tr["gossip_coverage"])))[:, 0]
+            )
+    fast_c = np.mean(cov[0.0], axis=0)
+    slow_c = np.mean(cov[50.0], axis=0)
+    assert slow_c[-1] == 1.0, slow_c  # completes
+    # Strictly slower somewhere in the transient, never faster on average.
+    transient = slice(1, 6)
+    assert (slow_c[transient] <= fast_c[transient] + 1e-9).all(), (
+        fast_c, slow_c,
+    )
+    assert slow_c[2] < fast_c[2], (fast_c, slow_c)
