@@ -212,6 +212,7 @@ class SparseParams:
         pallas_core: bool = False,
         sync_window: int = 64,
         churn_rate: float = 0.0,
+        burst: int = 0,
         **kw,
     ):
         """Build params for an ``n``-member cluster.
@@ -219,12 +220,22 @@ class SparseParams:
         ``churn_rate`` (fraction of members churning per tick) raises
         ``slot_budget`` and ``alloc_cap`` to the sizing rule
         (:func:`slot_budget_for`): callers that know their churn target pass
-        it and get a working set guaranteed to keep ``slot_overflow`` at 0
-        in steady state; 0.0 keeps the explicit/default budget. The sizing
-        uses ``writeback_period`` as the slot-free cadence — callers running
-        host-boundary frees (``in_scan_writeback=False`` + chunked driver)
-        must pass their CHUNK length here so the sizing matches the real
-        residency (the engine itself ignores the value in that mode).
+        it and get a working set that keeps ``slot_overflow`` at 0 in steady
+        state **provided arrivals are spread evenly per tick**; 0.0 keeps
+        the explicit/default budget. The sizing uses ``writeback_period`` as
+        the slot-free cadence — callers running host-boundary frees
+        (``in_scan_writeback=False`` + chunked driver) must pass their CHUNK
+        length here so the sizing matches the real residency (the engine
+        itself ignores the value in that mode).
+
+        ``burst`` is the worst single-tick arrival count, for callers whose
+        churn lands in boundary bursts instead of evenly (a chunked driver
+        that kills/revives a whole cohort between chunks — e.g.
+        tools/churn100k_eager.py): ``alloc_cap`` gates *grants per tick*
+        and ungranted requests count as overflow even when the steady-state
+        slot budget is ample, so it is raised to cover the burst. Even
+        callers passing ``churn_rate`` need this when arrivals are bursty —
+        the rate-derived cap only covers the per-tick average.
         """
         base = SimParams.from_cluster_config(n, **kw)
         if churn_rate > 0.0:
@@ -234,6 +245,8 @@ class SparseParams:
             )
             # The whole per-tick churn must be admittable the tick it fires.
             alloc_cap = max(alloc_cap, int(np.ceil(churn_rate * n)) + sync_window)
+        if burst > 0:
+            alloc_cap = max(alloc_cap, burst + sync_window)
         return cls(
             base=base,
             slot_budget=slot_budget,

@@ -53,28 +53,23 @@ chunk = int(sys.argv[3]) if len(sys.argv) > 3 else 48
 S_arg = int(sys.argv[4]) if len(sys.argv) > 4 else None
 churn_per_chunk = int(sys.argv[5]) if len(sys.argv) > 5 else 1024
 
-alloc_cap_override = None
+# Arrivals per chunk are the kills PLUS the revived half (restarts activate
+# the new ALIVE@epoch+1 record's slot too), all landing in a single
+# chunk-boundary burst; slots free only at chunk boundaries here
+# (host-boundary writeback_free), so the free cadence is `chunk`.
+burst = (churn_per_chunk * 3) // 2
 if S_arg == 0:
-    # Round-4 sizing rule for this scenario: arrivals per chunk are the
-    # kills PLUS the revived half (restarts activate the new ALIVE@epoch+1
-    # record's slot too), and slots free only at chunk boundaries here
-    # (host-boundary writeback_free), so the free cadence is `chunk`.
+    # Round-4 sizing rule for this scenario (for_n applies it from
+    # churn_rate + writeback_period; burst= covers the cap gate).
     base = SparseParams.for_n(n).base
-    arrivals_per_tick = (churn_per_chunk * 1.5) / chunk
-    S_arg = slot_budget_for(
-        base, n, arrivals_per_tick / n, writeback_period=chunk
-    )
-    # Overflow counts DROPPED requests, and alloc_cap gates grants per
-    # tick: the chunk-boundary burst (the whole fresh-churned cohort can
-    # be FD-probed within the first fd period) must be admittable, or the
-    # demo reports cap-gate overflow with slots still free.
-    alloc_cap_override = (churn_per_chunk * 3) // 2 + 64
-    print(f"sizing rule: S = {S_arg}, alloc_cap = {alloc_cap_override}", flush=True)
+    S_arg = slot_budget_for(base, n, (burst / chunk) / n, writeback_period=chunk)
+    print(f"sizing rule: S = {S_arg}, burst = {burst}", flush=True)
 params = SparseParams.for_n(
     n,
     in_scan_writeback=False,
+    burst=burst,
+    writeback_period=chunk,
     **({"slot_budget": S_arg} if S_arg else {}),
-    **({"alloc_cap": alloc_cap_override} if alloc_cap_override else {}),
 )
 state = init_sparse_full_view(n, params.slot_budget)
 plan = FaultPlan.uniform(loss_percent=1.0)
