@@ -1,26 +1,56 @@
 """Repo-local persistent XLA compilation cache.
 
-One helper shared by bench.py and the tools/ measurement programs so the
-cache location and threshold cannot diverge. The first on-chip run of any
-program pays its compile; every later process (including the driver's
-bench invocation) reuses the artifact from ``<repo>/.jax_cache``.
+One helper shared by bench.py, the tools/ measurement programs and the
+test harness so the cache location and threshold cannot diverge. The first
+on-chip run of any program pays its compile; every later process
+(including the driver's bench invocation) reuses the artifact from
+``<repo>/.jax_cache``.
+
+XLA:CPU caveat (learned round 4): CPU AOT entries bake in the compiling
+host's machine features (avx512 subsets, prefer-no-gather, ...). Entries
+written by a DIFFERENT host load with feature-mismatch warnings and a
+documented SIGILL risk, and their runtimes are non-representative. CPU
+processes therefore get a per-host subdirectory keyed by a fingerprint of
+/proc/cpuinfo flags; TPU entries stay in the shared root (keyed by device
+kind inside XLA's own cache key, and the tunnel's v5e is the same chip
+regardless of which host compiles).
 """
 
 from __future__ import annotations
 
+import hashlib
 import os
 
 
-def enable_repo_jax_cache() -> str:
-    """Point JAX's persistent compilation cache at ``<repo>/.jax_cache``.
+def _host_fingerprint() -> str:
+    """Stable per-host id from the CPU feature flags (what XLA:CPU bakes in)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(line.encode()).hexdigest()[:12]
+    except OSError:
+        pass
+    import platform
 
-    Call after ``import jax`` but before any computation. Returns the
+    return hashlib.sha256(platform.processor().encode()).hexdigest()[:12]
+
+
+def enable_repo_jax_cache() -> str:
+    """Point JAX's persistent compilation cache at ``<repo>/.jax_cache``
+    (CPU processes: ``<repo>/.jax_cache/cpu-<host fingerprint>``).
+
+    Call after ``import jax`` — and after any ``jax.config.update
+    ("jax_platforms", ...)`` — but before any computation. Returns the
     cache directory path.
     """
     import jax
 
     root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
     cache_dir = os.path.join(root, ".jax_cache")
+    platforms = getattr(jax.config, "jax_platforms", None) or ""
+    if platforms.split(",")[0] == "cpu":
+        cache_dir = os.path.join(cache_dir, f"cpu-{_host_fingerprint()}")
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     return cache_dir

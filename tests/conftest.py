@@ -14,6 +14,13 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# Persistent compile cache (host-fingerprinted CPU subdir — see
+# utils/jaxcache.py): the suite's wall time is compile-dominated on a
+# 1-core box, and re-runs should pay deserialization, not recompilation.
+from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache  # noqa: E402
+
+enable_repo_jax_cache()
+
 import asyncio  # noqa: E402
 import inspect  # noqa: E402
 

@@ -1,8 +1,11 @@
 """The BASELINE.json scenario grid runs end-to-end at CI scale."""
 
+import pytest
+
 from scalecube_cluster_tpu.experiments import run_all
 
 
+@pytest.mark.deep
 def test_small_grid_passes():
     results = {r["scenario"]: r for r in run_all("small")}
 

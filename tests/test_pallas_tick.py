@@ -6,6 +6,7 @@ kernel on the TPU chip (pallas child first).
 
 import dataclasses
 
+import pytest
 import jax
 import jax.numpy as jnp
 
@@ -86,6 +87,7 @@ def test_sim_tick_equal_with_fused_kernel():
     assert bool(jnp.all(tr_ref["convergence"] == tr_ker["convergence"]))
 
 
+@pytest.mark.deep
 def test_sim_tick_equal_with_fused_kernel_under_churn():
     """Parity holds through the host-op mutators (leave/restart/metadata) —
     the operations that must keep the derived rows/known_cnt invariants the

@@ -1,0 +1,93 @@
+"""Bracket the 16-chip member ceiling with the REAL TPU compiler.
+
+Round-4 left the v5e:4x4 ceiling unbracketed: 163840@S=2048 compiled
+(8.64 GiB/device scan form) but the only larger probe doubled n AND S
+together and OOM'd, confounding the two. This walks n upward at FIXED
+S=2048 on the 2D viewer×subject mesh (8x2 over v5e:4x4), compiling the
+production scan-chunk form (in_scan_writeback=False — the bench/churn
+driver) with the real TPU compiler via an offline topology
+(jax.experimental.topologies — compile-only devices, no tunnel), until
+the compiler itself refuses, and prints the per-device HBM accounting at
+every rung. The single-tick (in-scan write-back) form is NOT probed here:
+it already sits at 13.67 GiB/16 GiB at 163840
+(artifacts/aot_v5e16_163840.log) and is not the big-n production form.
+
+Usage: python tools/aot_ceiling.py [start_n] [step] [S] [topology] [mesh]
+Defaults: 184320 16384 2048 v5e:4x4 8,2  (n rungs are rounded to multiples
+of 256 = 32-row fan-out groups x 8 viewer shards).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+from jax.experimental import topologies
+
+start_n = int(sys.argv[1]) if len(sys.argv) > 1 else 184320
+step = int(sys.argv[2]) if len(sys.argv) > 2 else 16384
+S = int(sys.argv[3]) if len(sys.argv) > 3 else 2048
+topo_name = sys.argv[4] if len(sys.argv) > 4 else "v5e:4x4"
+mesh_arg = sys.argv[5] if len(sys.argv) > 5 else "8,2"
+
+from scalecube_cluster_tpu.parallel.mesh import make_mesh2d, sparse_state_shardings
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    run_sparse_ticks,
+)
+
+topo = topologies.get_topology_desc(topo_name, "tpu")
+dm, ds = (int(x) for x in mesh_arg.split(","))
+mesh = make_mesh2d((dm, ds), topo.devices)
+print(
+    f"ceiling probe: {topo_name} ({len(topo.devices)} compile-only devices), "
+    f"2D mesh {dm}x{ds}, S={S}, scan-chunk form, n from {start_n} by {step}",
+    flush=True,
+)
+
+GIB = 2**30
+chunk = 48
+n = start_n
+last_ok = None
+while True:
+    n = ((n + 255) // 256) * 256
+    params = SparseParams.for_n(n, slot_budget=S, in_scan_writeback=False)
+    state = jax.eval_shape(lambda n=n: init_sparse_full_view(n, slot_budget=S))
+    sh = sparse_state_shardings(mesh)
+    state = jax.tree.map(
+        lambda s, d: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=d), state, sh
+    )
+    plan = jax.eval_shape(lambda: FaultPlan.uniform())
+    t0 = time.time()
+    try:
+        lowered = run_sparse_ticks.lower(params, state, plan, chunk, collect=False)
+        compiled = lowered.compile()
+    except Exception as e:
+        msg = repr(e)
+        short = msg[:400] + ("..." if len(msg) > 400 else "")
+        print(
+            f"CEILING n={n}: compile refused after {time.time() - t0:.1f}s — "
+            f"{short}",
+            flush=True,
+        )
+        break
+    ma = compiled.memory_analysis()
+    live = (ma.argument_size_in_bytes + ma.temp_size_in_bytes) / GIB
+    print(
+        f"AOT_OK n={n} S={S}: compile {time.time() - t0:.1f}s; per-device "
+        f"args {ma.argument_size_in_bytes / GIB:.2f} + temps "
+        f"{ma.temp_size_in_bytes / GIB:.2f} = {live:.2f} GiB of 16 GiB",
+        flush=True,
+    )
+    last_ok = n
+    n += step
+if last_ok:
+    print(
+        f"bracket: largest compiling n = {last_ok}, first refused n = {n} "
+        f"(step {step}, S={S}, {topo_name} {dm}x{ds}, scan form)",
+        flush=True,
+    )

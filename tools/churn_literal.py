@@ -17,7 +17,10 @@ are DELAYED, never lost. It reports what the contract predicts:
 
 - sustained slot_overflow (the saturation signal, per tick);
 - verdict progress for a tracked kill cohort (fraction of live viewers
-  seeing DEAD, sampled at write-back boundaries);
+  seeing SUSPECT / DEAD, sampled at write-back boundaries — SUSPECT is
+  the short-wall observable; DEAD needs the full suspicion countdown);
+- join deferral: revivals wait for free slots (restart_many_sparse
+  refuses slot-less restarts), counted per tick;
 - the completeness bound computed from the engine's constants for the
   TOTAL kills of the run (waves * (lifetime + refill) + spread + suspicion
   — the same derivation the toy-scale property test pins), stated next to
@@ -49,7 +52,7 @@ import numpy as np
 
 from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
 
-enable_repo_jax_cache()
+enable_repo_jax_cache()  # host-fingerprinted CPU subdir — safe across boxes
 
 from scalecube_cluster_tpu.cluster_api.member import MemberStatus
 from scalecube_cluster_tpu.ops.merge import decode_status
@@ -100,25 +103,32 @@ COHORT = 64
 DEAD = int(MemberStatus.DEAD)
 
 
-def cohort_dead_fraction(state, cohort) -> float:
+def cohort_progress(state, cohort) -> dict:
     """Mean over cohort of (fraction of live viewers whose record for the
-    member is DEAD). Slab overlays view_T for active subjects — the same
-    overlay rule testlib/certify.py::_subject_col pins."""
+    member is SUSPECT / DEAD). Slab overlays view_T for active subjects —
+    the same overlay rule testlib/certify.py::_subject_col pins. SUSPECT
+    spread is the observable within a short wall budget (DEAD needs the
+    full suspicion countdown, ~425 ticks at 100k LAN cadence — the derived
+    bound names it); SUSPECT shows the detect→activate→disseminate
+    pipeline running under saturation."""
     live = np.asarray(jax.device_get(state.alive))
     subj_slot = np.asarray(jax.device_get(state.subj_slot))
-    fracs = []
+    dead_f, susp_f = [], []
     for j in cohort:
         s = int(subj_slot[j])
         col = state.slab[:, s] if s >= 0 else state.view_T[j, :]
         st = np.asarray(jax.device_get(decode_status(col)))
-        fracs.append(float((st[live] == DEAD).mean()))
-    return float(np.mean(fracs))
+        dead_f.append(float((st[live] == DEAD).mean()))
+        susp_f.append(float((st[live] == int(MemberStatus.SUSPECT)).mean()))
+    return {"dead": float(np.mean(dead_f)), "suspect": float(np.mean(susp_f))}
 
 
 down: set[int] = set()
 cohort: list[int] = []
 overflow = []
 kills_total = 0
+revived_total = 0
+deferred_joins = 0
 t_all = time.perf_counter()
 dt = 0.0
 for t in range(churn_ticks):
@@ -131,9 +141,19 @@ for t in range(churn_ticks):
         down.update(int(i) for i in kills[COHORT:])
     else:
         down.update(int(i) for i in kills)
-    revive = list(down)[: per_tick // 2]
+    # Joins under saturation: a restart's fresh ALIVE@epoch+1 record needs a
+    # slot to gossip from (restart_many_sparse refuses without one — the
+    # bounded working set gates JOINS exactly like verdicts). Revive only as
+    # many as the slab has free slots this tick; the rest stay down and are
+    # counted — join deferral is the second face of the degradation
+    # contract and is reported alongside overflow.
+    want = per_tick // 2
+    free_slots = int(jnp.sum(state.slot_subj < 0))
+    revive = list(down)[: min(want, free_slots)]
+    deferred_joins += want - len(revive)
     if revive:
         state = restart_many_sparse(state, revive)
+        revived_total += len(revive)
         down.difference_update(revive)
     t0 = time.perf_counter()
     state, metrics = tick_fn(state, plan)
@@ -147,7 +167,7 @@ for t in range(churn_ticks):
             f"tick {t + 1}: overflow_total={sum(ov):.0f} "
             f"peak/tick={max(ov):.0f} "
             f"active={int(jnp.sum(state.slot_subj >= 0))}/{S} "
-            f"cohort_dead_frac={cohort_dead_fraction(state, cohort):.3f} "
+            f"cohort={cohort_progress(state, cohort)} "
             f"({(time.perf_counter() - t_all) / 60:.1f} min)",
             flush=True,
         )
@@ -166,7 +186,7 @@ while drained < drain_ticks:
     print(
         f"drain tick {churn_ticks + drained}: "
         f"active={int(jnp.sum(state.slot_subj >= 0))}/{S} "
-        f"cohort_dead_frac={cohort_dead_fraction(state, cohort):.3f} "
+        f"cohort={cohort_progress(state, cohort)} "
         f"({(time.perf_counter() - t_all) / 60:.1f} min)",
         flush=True,
     )
@@ -181,7 +201,7 @@ bound = (
     + 4 * base.fd_period_ticks
     + WB
 )
-final_frac = cohort_dead_fraction(state, cohort)
+final_prog = cohort_progress(state, cohort)
 row = {
     "scenario": "sparse_churn_literal",
     "n": n,
@@ -196,8 +216,11 @@ row = {
     "slot_overflow_total": float(ov.sum()),
     "slot_overflow_max_per_tick": float(ov.max()) if ov.size else 0.0,
     "overflow_ticks": int((ov > 0).sum()),
+    "revived_total": revived_total,
+    "deferred_joins": deferred_joins,
     "active_slots_end": int(jnp.sum(state.slot_subj >= 0)),
-    "cohort_dead_fraction_end": final_frac,
+    "cohort_dead_fraction_end": final_prog["dead"],
+    "cohort_suspect_fraction_end": final_prog["suspect"],
     "completeness_bound_ticks": int(bound),
     "member_rounds_per_sec": round(n * (churn_ticks + drained) / dt, 1),
     "backend": "cpu",
