@@ -25,7 +25,7 @@ import jax
 
 scale = sys.argv[1] if len(sys.argv) > 1 else "large"
 OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                   "EXPERIMENTS_r4.jsonl")
+                   "EXPERIMENTS_r5.jsonl")
 
 from scalecube_cluster_tpu.experiments.scenarios import run_all
 
