@@ -26,9 +26,10 @@ are DELAYED, never lost. It reports what the contract predicts:
   — the same derivation the toy-scale property test pins), stated next to
   how far the run got within its wall budget.
 
-Kills hit fresh members each tick; half the down set revives (epoch bump)
-per tick, so the cluster hovers near full size like the reference's
-join/leave benchmark. The tracked cohort is never revived.
+Kills hit fresh members each tick; revive demand accrues at half the kill
+rate per tick and is applied in write-back-boundary batches (epoch bump),
+so the cluster hovers near full size like the reference's join/leave
+benchmark. The tracked cohort is never revived.
 
 Usage: python tools/churn_literal.py [n] [churn_ticks] [S] [rate] [drain_ticks]
 Defaults: 102400 48 8192 0.01 0 (drain_ticks: extra churn-free ticks after
@@ -125,6 +126,7 @@ def cohort_progress(state, cohort) -> dict:
 
 down: set[int] = set()
 cohort: list[int] = []
+pending_revive = 0
 overflow = []
 kills_total = 0
 revived_total = 0
@@ -143,23 +145,28 @@ for t in range(churn_ticks):
         down.update(int(i) for i in kills)
     # Joins under saturation: a restart's fresh ALIVE@epoch+1 record needs a
     # slot to gossip from (restart_many_sparse refuses without one — the
-    # bounded working set gates JOINS exactly like verdicts). Revive only as
-    # many as the slab has free slots this tick; the rest stay down and are
-    # counted — join deferral is the second face of the degradation
+    # bounded working set gates JOINS exactly like verdicts). Revives are
+    # BATCHED at write-back boundaries (where slots free): restart_many's
+    # host-side [N, :] updates copy the 42 GB view once per CALL, so a
+    # per-tick call costs ~6 min/tick at 102400 on this box — measured the
+    # hard way this round. Join demand accrues per tick; whatever the
+    # freed slab can take rejoins at the boundary, the rest stay down and
+    # are counted — join deferral is the second face of the degradation
     # contract and is reported alongside overflow.
-    want = per_tick // 2
-    free_slots = int(jnp.sum(state.slot_subj < 0))
-    revive = list(down)[: min(want, free_slots)]
-    deferred_joins += want - len(revive)
-    if revive:
-        state = restart_many_sparse(state, revive)
-        revived_total += len(revive)
-        down.difference_update(revive)
+    pending_revive += per_tick // 2
     t0 = time.perf_counter()
     state, metrics = tick_fn(state, plan)
     overflow.append(metrics["slot_overflow"])
     if (t + 1) % WB == 0:
         state = writeback_free(params, state)
+        free_slots = int(jnp.sum(state.slot_subj < 0))
+        revive = list(down)[: min(pending_revive, free_slots)]
+        deferred_joins += pending_revive - len(revive)
+        pending_revive = 0
+        if revive:
+            state = restart_many_sparse(state, revive)
+            revived_total += len(revive)
+            down.difference_update(revive)
         jax.block_until_ready(state.view_T)
         dt += time.perf_counter() - t0
         ov = [float(o) for o in overflow]
