@@ -23,6 +23,11 @@ enable_repo_jax_cache()
 
 import jax
 
+# JAX_PLATFORMS env alone does not stick on this box (the axon TPU plugin
+# overrides it); config.update before backend init is the reliable pin.
+if os.environ.get("SC_GRID_PLATFORM"):
+    jax.config.update("jax_platforms", os.environ["SC_GRID_PLATFORM"])
+
 scale = sys.argv[1] if len(sys.argv) > 1 else "large"
 OUT = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                    "EXPERIMENTS_r5.jsonl")
