@@ -143,7 +143,9 @@ class Cluster:
         local_member = cls._create_local_member(config, transport.address)
         transport = SenderAwareTransport(transport, local_member.address)
         rng = random.Random(seed)
-        cid = CorrelationIdGenerator(local_member.id)
+        # Epoch from the seed-driven rng: unique per run when unseeded (OS
+        # entropy), reproducible correlation ids when a seed is given.
+        cid = CorrelationIdGenerator(local_member.id, epoch=rng.getrandbits(48))
         fd = FailureDetector(
             transport,
             local_member,

@@ -70,7 +70,7 @@ class FailureDetector:
         self._local = local_member
         self._config = config
         self._cid = cid_generator
-        self._rng = rng or random.Random()
+        self._rng = rng or random.Random()  # tpulint: disable=R3 -- host-backend reference-parity default; Cluster.start injects a seed-derived rng
         self._events: Multicast[FailureDetectorEvent] = Multicast()
         # Shuffled round-robin probe list (FailureDetectorImpl.java:55, 323-349).
         self._ping_members: list[Member] = []

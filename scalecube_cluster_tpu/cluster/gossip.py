@@ -62,7 +62,7 @@ class GossipProtocol:
         self._transport = transport
         self._local = local_member
         self._config = config
-        self._rng = rng or random.Random()
+        self._rng = rng or random.Random()  # tpulint: disable=R3 -- host-backend reference-parity default; Cluster.start injects a seed-derived rng
         self._period = 0
         self._sequence = itertools.count()
         self._gossips: dict[str, GossipState] = {}

@@ -122,7 +122,7 @@ class MembershipProtocol:
         self._gossip = gossip
         self._metadata = metadata_store
         self._cid = cid_generator
-        self._rng = rng or random.Random()
+        self._rng = rng or random.Random()  # tpulint: disable=R3 -- host-backend reference-parity default; Cluster.start injects a seed-derived rng
 
         self._table: dict[str, MembershipRecord] = {}
         self._members: dict[str, Member] = {}

@@ -1118,17 +1118,22 @@ def run_sparse_chunked(
     The big-n driver: build ``params`` with ``in_scan_writeback=False`` so
     the scan holds a single view_T buffer, then frees amortize to once per
     ``chunk`` ticks. Returns ``(state, last_chunk_traces)``.
+
+    The loop only ever passes ``chunk`` at the static tick-count position;
+    a ragged remainder runs as one fixed-size tail call after the loop, so
+    a call compiles at most two scan variants (chunk and tail) instead of
+    re-specializing on a shrinking ``n_ticks - done``.
     """
     if params.in_scan_writeback:
         raise ValueError("use in_scan_writeback=False with the chunked runner")
-    done = 0
+    whole, tail = divmod(n_ticks, chunk)
     traces = {}
-    while done < n_ticks:
-        state, traces = run_sparse_ticks(
-            params, state, plan, min(chunk, n_ticks - done), collect=collect
-        )
+    for _ in range(whole):
+        state, traces = run_sparse_ticks(params, state, plan, chunk, collect=collect)
         state = writeback_free(params, state)
-        done += chunk
+    if tail:
+        state, traces = run_sparse_ticks(params, state, plan, tail, collect=collect)
+        state = writeback_free(params, state)
     return state, traces
 
 

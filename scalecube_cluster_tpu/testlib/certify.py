@@ -149,7 +149,7 @@ def sparse_full_cadence_certify(
                 for sh, m in zip(twins, meshes)
             ]
         _note(f"segment {seg}: running reference, {ticks} ticks")
-        ref, tr_ref = run_sparse_ticks(params, ref, plan, ticks)
+        ref, tr_ref = run_sparse_ticks(params, ref, plan, ticks)  # tpulint: disable=R4 -- per-segment trace lengths are the certification design; one compile per SEGMENTS entry, cached across meshes
         # Serialize: JAX dispatch is async, and on an oversubscribed host
         # (CI / 1-core boxes with 8 virtual devices) the unsharded ref
         # execution would otherwise run CONCURRENTLY with the first sharded
@@ -160,7 +160,7 @@ def sparse_full_cadence_certify(
         # must run everywhere the driver does.
         jax.block_until_ready((ref, tr_ref))
         for i, m in enumerate(meshes):
-            sh, tr_sh = run_sparse_ticks(params, twins[i], plans_sh[i], ticks)
+            sh, tr_sh = run_sparse_ticks(params, twins[i], plans_sh[i], ticks)  # tpulint: disable=R4 -- per-segment trace lengths are the certification design; one compile per SEGMENTS entry, cached across meshes
             jax.block_until_ready(sh)
             twins[i] = sh
             dims = dict(zip(m.axis_names, m.devices.shape))

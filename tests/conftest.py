@@ -6,13 +6,31 @@ before the first `import jax` anywhere in the test process, which is why they
 live at the top of the root conftest.
 """
 
-# Env vars (JAX_PLATFORMS/XLA_FLAGS) do not stick on this box — an installed
-# TPU PJRT plugin (the axon tunnel) overrides platform selection. The config
-# calls are authoritative and must run before any other jax operation.
-import jax
+# Env vars (JAX_PLATFORMS) do not stick on this box for *platform selection*
+# — an installed TPU PJRT plugin (the axon tunnel) overrides it, so the
+# jax_platforms config call below stays authoritative and must run before any
+# other jax operation. XLA_FLAGS, by contrast, is read by XLA at host-backend
+# init and is the portable way to get 8 virtual CPU devices on jax versions
+# that predate the jax_num_cpu_devices config option (0.4.x raises
+# AttributeError on it). Append — don't clobber — so caller-supplied flags
+# survive, and do it before the first `import jax` / device query.
+import os
+
+_FORCE_DEVS_FLAG = "--xla_force_host_platform_device_count"
+if _FORCE_DEVS_FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + f" {_FORCE_DEVS_FLAG}=8"
+    ).strip()
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jax < 0.5 has no jax_num_cpu_devices; the XLA_FLAGS fallback above
+    # (set before the first jax import) provides the 8 virtual devices.
+    pass
 
 # Persistent compile cache (host-fingerprinted CPU subdir — see
 # utils/jaxcache.py): the suite's wall time is compile-dominated on a

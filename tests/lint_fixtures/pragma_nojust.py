@@ -1,0 +1,8 @@
+"""Pragma fixture: suppression WITHOUT a justification must not count."""
+
+import jax
+
+
+@jax.jit
+def pull(x):
+    return float(x)  # tpulint: disable=R2

@@ -1,0 +1,8 @@
+"""Pragma fixture: a justified suppression silences exactly its rule."""
+
+import jax
+
+
+@jax.jit
+def pull(x):
+    return float(x)  # tpulint: disable=R2 -- fixture: demonstrating a justified suppression

@@ -1,0 +1,21 @@
+"""R1 negative: the legal near-misses.
+
+Branching on static trace-time metadata (`.shape`), `is None` tests, and
+iterating a Python container *of* tracers are all fine — only host control
+flow on a traced array itself is the hazard.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, bias=None):
+    n, m = x.shape
+    if n > m:
+        x = x.T
+    if bias is not None:
+        x = x + bias
+    legs = [(x, x + 1), (x * 2, x)]
+    total = sum(jnp.minimum(a, b) for a, b in legs)
+    return total

@@ -1,0 +1,10 @@
+"""R1 positive: Python branch on a traced value inside @jax.jit."""
+
+import jax
+
+
+@jax.jit
+def step(x):
+    if x > 0:
+        return x + 1
+    return x - 1

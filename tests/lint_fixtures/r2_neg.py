@@ -1,0 +1,13 @@
+"""R2 negative: host conversion at the host boundary (not hot) is fine."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def compute(x):
+    return jnp.sum(x * x)
+
+
+def report(x):
+    return float(compute(x))

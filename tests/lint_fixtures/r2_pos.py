@@ -1,0 +1,8 @@
+"""R2 positive: device->host sync inside a traced hot path."""
+
+import jax
+
+
+@jax.jit
+def pull(x):
+    return float(x + 1)

@@ -1,0 +1,8 @@
+"""R3 negative: seed injected by the caller; no hidden global state."""
+
+import random
+
+
+def make_schedule(n, seed, start=0.0):
+    rng = random.Random(seed)
+    return [start + rng.random() for _ in range(n)]

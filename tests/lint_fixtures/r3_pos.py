@@ -1,0 +1,10 @@
+"""R3 positive: wall clock and seedless RNG in library code."""
+
+import random
+import time
+
+
+def make_schedule(n):
+    rng = random.Random()
+    start = time.time()
+    return [start + rng.random() for _ in range(n)]

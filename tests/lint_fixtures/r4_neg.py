@@ -1,0 +1,25 @@
+"""R4 negative: loop-invariant static args; donated name rebound by the call."""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def run(x, n):
+    return x * n
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def consume(x):
+    return x + 1
+
+
+def driver(x, total, chunk):
+    whole, tail = divmod(total, chunk)
+    for _ in range(whole):
+        x = run(x, chunk)
+    if tail:
+        x = run(x, tail)
+    x = consume(x)
+    return x

@@ -1,0 +1,29 @@
+"""R4 positive: recompilation + donation hazards.
+
+The driver passes a loop-varying Python scalar at a static jit position
+(a fresh trace/compile every iteration), and reads a donated buffer after
+the call that consumed it.
+"""
+
+import functools
+
+import jax
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def run(x, n):
+    return x * n
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def consume(x):
+    return x + 1
+
+
+def driver(x, total, chunk):
+    done = 0
+    while done < total:
+        x = run(x, min(chunk, total - done))
+        done += chunk
+    y = consume(x)
+    return y + x

@@ -1,0 +1,25 @@
+"""R5 negative: every rebuild honours the canonical constructor dtypes."""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_dataclass
+
+
+@register_dataclass
+@dataclass
+class Box:
+    ticks: jax.Array
+    flags: jax.Array
+
+
+def blank(n):
+    return Box(
+        ticks=jnp.zeros((n,), dtype=jnp.int32),
+        flags=jnp.ones((n,), dtype=jnp.bool_),
+    )
+
+
+def tweak(box, n):
+    return box.replace(ticks=jnp.zeros((n,), dtype=jnp.int32))

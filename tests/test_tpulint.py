@@ -1,0 +1,130 @@
+"""tpulint gate tests (tier-1, marker-free — pure ast, no device work).
+
+Three contracts:
+  1. the shipped library package lints clean (the gate itself),
+  2. every rule R1-R5 is demonstrated by a fixture that stops firing when
+     exactly that detector is disabled (each detector carries its weight),
+  3. pragma suppression requires a justification, and the CLI exit codes
+     hold (0 clean / 1 findings / 2 internal error).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from tools.lint import run_lint
+from tools.lint.__main__ import main as lint_main
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "lint_fixtures"
+
+#: rule -> (positive fixture, negative fixture)
+RULE_FIXTURES = {
+    "R1": ("r1_pos.py", "r1_neg.py"),
+    "R2": ("r2_pos.py", "r2_neg.py"),
+    "R3": ("r3_pos.py", "r3_neg.py"),
+    "R4": ("r4_pos.py", "r4_neg.py"),
+    "R5": ("r5_pos.py", "r5_neg.py"),
+}
+
+
+def lint(path, **kw):
+    kw.setdefault("root", REPO)
+    kw.setdefault("baseline", None)
+    return run_lint([path], **kw)
+
+
+# ------------------------------------------------------------------ the gate
+
+
+def test_repo_lints_clean():
+    """The shipped library package carries zero gated findings."""
+    result = lint(REPO / "scalecube_cluster_tpu")
+    assert result.files_checked > 50
+    assert result.gated == [], "\n".join(f.render() for f in result.gated)
+
+
+# ------------------------------------------------------- per-rule detectors
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_positive_fires(rule):
+    pos, _ = RULE_FIXTURES[rule]
+    result = lint(FIXTURES / pos)
+    assert any(f.rule == rule for f in result.findings), (
+        f"{pos} should trigger {rule}; got "
+        f"{[(f.rule, f.line) for f in result.findings]}"
+    )
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_rule_negative_clean(rule):
+    _, neg = RULE_FIXTURES[rule]
+    result = lint(FIXTURES / neg)
+    assert result.findings == [], "\n".join(f.render() for f in result.findings)
+
+
+@pytest.mark.parametrize("rule", sorted(RULE_FIXTURES))
+def test_disabling_rule_silences_its_fixture(rule):
+    """The finding comes from THIS detector, not a sibling rule."""
+    pos, _ = RULE_FIXTURES[rule]
+    result = lint(FIXTURES / pos, disable=(rule,))
+    assert not any(f.rule == rule for f in result.findings)
+
+
+def test_r1_container_of_tracers_is_legal():
+    """Iterating a Python list of traced pairs must NOT flag (3-level taint):
+    this is the sim/faults.py round_trip_in_time idiom."""
+    result = lint(FIXTURES / "r1_neg.py")
+    assert result.findings == []
+
+
+# ------------------------------------------------------------------ pragmas
+
+
+def test_pragma_with_justification_suppresses():
+    result = lint(FIXTURES / "pragma_ok.py")
+    assert result.findings == []
+
+
+def test_pragma_without_justification_rejected():
+    result = lint(FIXTURES / "pragma_nojust.py")
+    rules = {f.rule for f in result.findings}
+    assert "R0" in rules, "malformed pragma must be reported"
+    assert "R2" in rules, "an unjustified pragma must not suppress"
+
+
+# ---------------------------------------------------------------- CLI / CI
+
+
+def test_cli_exit_codes(tmp_path):
+    clean = str(FIXTURES / "r1_neg.py")
+    dirty = str(FIXTURES / "r1_pos.py")
+    json_out = str(tmp_path / "report.json")
+    assert lint_main([clean, "--no-json", "--baseline", "none"]) == 0
+    assert lint_main([dirty, "--json", json_out, "--baseline", "none"]) == 1
+    assert Path(json_out).exists()
+
+
+def test_cli_internal_error_exit_2(monkeypatch, capsys):
+    import tools.lint.__main__ as cli
+
+    def boom(*a, **kw):
+        raise RuntimeError("synthetic linter crash")
+
+    monkeypatch.setattr(cli, "run_lint", boom)
+    assert cli.main(["--no-json", "--baseline", "none"]) == 2
+    assert "internal error" in capsys.readouterr().err
+
+
+def test_advisory_scope_never_gates(tmp_path):
+    """Findings under tools/ or experiments/ are reported but do not fail."""
+    adv = tmp_path / "tools" / "probe.py"
+    adv.parent.mkdir(parents=True)
+    adv.write_text("import time\n\n\ndef stamp():\n    return time.time()\n")
+    result = run_lint([adv], root=tmp_path, baseline=None)
+    assert [f.rule for f in result.findings] == ["R3"]
+    assert result.findings[0].advisory
+    assert result.gated == []

@@ -1,0 +1,143 @@
+"""tpulint — repo-native JAX/TPU static analysis.
+
+Gates the library package on the defect classes that cost real TPU hours
+(PERF.md round-5 postmortem): tracer-unsafe Python control flow (R1), silent
+host round-trips in hot paths (R2), nondeterminism (R3), recompilation and
+donation hazards (R4), and pytree dtype-contract drift (R5).
+
+CLI::
+
+    python -m tools.lint [paths ...]          # default: scalecube_cluster_tpu/
+
+Library::
+
+    from tools.lint import run_lint
+    result = run_lint(["scalecube_cluster_tpu"])
+    assert not result.gated
+
+Suppression (justification REQUIRED, see tools/lint/pragmas.py)::
+
+    x = float(y)  # tpulint: disable=R2 -- host boundary, between chunks
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from pathlib import Path
+
+from tools.lint import rules as _rules
+from tools.lint.callgraph import Engine, SourceFile
+from tools.lint.model import RULES, Finding, LintResult, is_advisory_path
+from tools.lint.pragmas import parse_pragmas, suppressed_lines
+from tools.lint.report import apply_baseline
+
+__all__ = ["run_lint", "LintResult", "Finding", "RULES", "DEFAULT_BASELINE"]
+
+#: Shipped advisory-scope baseline (tools/, experiments/ inventory).
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+
+def _modkey(relpath: str) -> str:
+    parts = relpath.replace("\\", "/").removesuffix(".py").split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(p for p in parts if p) or "<root>"
+
+
+def _discover(paths: list[str | Path], root: Path) -> list[Path]:
+    found: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            found.extend(
+                sorted(
+                    q
+                    for q in p.rglob("*.py")
+                    if "__pycache__" not in q.parts
+                )
+            )
+        elif p.suffix == ".py":
+            found.append(p)
+    return found
+
+
+def run_lint(
+    paths: list[str | Path],
+    *,
+    root: str | Path | None = None,
+    disable: tuple[str, ...] = (),
+    select: tuple[str, ...] | None = None,
+    baseline: str | Path | None = None,
+) -> LintResult:
+    """Lint ``paths`` (files or directories). Pure: no I/O besides reading.
+
+    Args:
+      root: repo root used for relative paths and advisory-scope matching
+        (default: cwd).
+      disable: rule ids to turn off (the fixture tests use this to prove
+        each detector carries its weight).
+      select: when given, ONLY these rules run.
+      baseline: advisory baseline JSON (``DEFAULT_BASELINE`` for the shipped
+        one); ``None`` disables baselining.
+    """
+    root = Path(root or os.getcwd()).resolve()
+    disable = tuple(r.upper() for r in disable)
+    select = tuple(r.upper() for r in select) if select is not None else None
+
+    files: list[SourceFile] = []
+    result = LintResult()
+    pragma_maps: dict[str, dict[int, frozenset[str]]] = {}
+    for path in _discover(paths, root):
+        try:
+            rel = path.resolve().relative_to(root).as_posix()
+        except ValueError:
+            rel = path.as_posix()
+        source = path.read_text()
+        try:
+            tree = ast.parse(source, filename=str(path))
+        except SyntaxError as e:
+            result.findings.append(
+                Finding(
+                    rule="R0",
+                    path=rel,
+                    line=e.lineno or 1,
+                    message=f"file does not parse: {e.msg}",
+                    hint="tpulint analyzes source; fix the syntax error first",
+                )
+            )
+            continue
+        pragmas, bad = parse_pragmas(source, rel)
+        result.findings.extend(bad)
+        pragma_maps[rel] = suppressed_lines(pragmas, source)
+        files.append(
+            SourceFile(
+                path=path, relpath=rel, source=source, tree=tree, modkey=_modkey(rel)
+            )
+        )
+    result.files_checked = len(files)
+
+    engine = Engine(files)
+    events = engine.run()
+    result.findings.extend(_rules.findings_from_events(events))
+    result.findings.extend(_rules.rule_r3(files, engine))
+    result.findings.extend(_rules.rule_r4(files, engine))
+    result.findings.extend(_rules.rule_r5(files, engine))
+
+    kept: list[Finding] = []
+    for f in result.findings:
+        if f.rule in disable:
+            continue
+        if select is not None and f.rule not in select:
+            continue
+        supp = pragma_maps.get(f.path, {}).get(f.line, frozenset())
+        if f.rule != "R0" and f.rule in supp:
+            continue
+        f.advisory = is_advisory_path(f.path)
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    result.findings = kept
+
+    if baseline is not None:
+        apply_baseline(result, Path(baseline))
+    return result

@@ -1,0 +1,87 @@
+"""CLI entry: ``python -m tools.lint [paths ...]``.
+
+Exit codes (the CI contract):
+  0 — clean (advisory findings allowed; they never fail the gate)
+  1 — gated findings present
+  2 — internal error in the linter itself
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import traceback
+from pathlib import Path
+
+from tools.lint import DEFAULT_BASELINE, RULES, run_lint
+from tools.lint.report import render_text, write_baseline, write_json
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.lint",
+        description="tpulint: JAX/TPU tracer-safety, host-sync, determinism, "
+        "recompilation and dtype-contract checks.",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["scalecube_cluster_tpu"],
+        help="files/directories to lint (default: scalecube_cluster_tpu/)",
+    )
+    ap.add_argument(
+        "--json",
+        default="artifacts/tpulint.json",
+        metavar="PATH",
+        help="machine-readable report path (default: artifacts/tpulint.json)",
+    )
+    ap.add_argument("--no-json", action="store_true", help="skip the JSON report")
+    ap.add_argument(
+        "--disable", default="", metavar="R1,R2", help="comma-separated rules to skip"
+    )
+    ap.add_argument(
+        "--select", default="", metavar="R1,R2", help="run ONLY these rules"
+    )
+    ap.add_argument(
+        "--baseline",
+        default=str(DEFAULT_BASELINE),
+        metavar="PATH",
+        help="advisory-scope baseline (default: tools/lint/baseline.json); "
+        "'none' disables",
+    )
+    ap.add_argument(
+        "--write-baseline",
+        action="store_true",
+        help="rewrite the baseline from this run's advisory findings",
+    )
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("-q", "--quiet", action="store_true", help="hide baselined findings")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid, desc in RULES.items():
+            print(f"{rid}: {desc}")
+        return 0
+
+    try:
+        baseline = None if args.baseline == "none" else Path(args.baseline)
+        result = run_lint(
+            args.paths,
+            disable=tuple(r for r in args.disable.split(",") if r),
+            select=tuple(r for r in args.select.split(",") if r) or None,
+            baseline=baseline,
+        )
+        if args.write_baseline and baseline is not None:
+            write_baseline(result, baseline)
+        if not args.no_json:
+            write_json(result, Path(args.json))
+        print(render_text(result, quiet=args.quiet))
+        return 1 if result.gated else 0
+    except Exception:
+        traceback.print_exc()
+        print("tpulint: internal error (exit 2)", file=sys.stderr)
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,847 @@
+"""Module index, call graph and traced-value taint engine for tpulint.
+
+The engine answers two questions the rules need:
+
+1. **Which functions are hot?** A function is hot when tracing reaches it:
+   it is jit-decorated (``@jax.jit`` / ``@partial(jax.jit, ...)`` /
+   ``f = jax.jit(g)``), it is passed as a body callable to a tracing
+   transform (``lax.scan``/``cond``/``while_loop``/``fori_loop``/``switch``/
+   ``vmap``/``grad``/...), or it is called (transitively) from a hot
+   function. Pallas kernel bodies are deliberately NOT seeded: they operate
+   on ``Ref``s under a different programming model and would drown the
+   tracer rules in false positives.
+
+2. **Which values are traced?** A forward may-taint dataflow over each hot
+   function: non-static parameters of the jit boundary are tainted, taint
+   flows through arithmetic/indexing/``jnp.*`` calls, and dies at static
+   metadata (``.shape``/``.dtype``/``.ndim``/``.size``), ``is None`` tests
+   and host conversions. Call sites propagate taint interprocedurally to a
+   fixpoint; nested functions read their enclosing function's environment
+   (closure capture).
+
+Taint is three-valued, because JAX code routinely builds *Python containers
+of tracers* (a list of ``(src, dst)`` index-array pairs, a tuple carry) and
+iterating those is perfectly legal — only iterating/branching on a traced
+**array** unrolls or fails at trace time:
+
+  * ``TAINT_NONE``  (0) — host value, anything goes
+  * ``TAINT_BOX``   (1) — Python container holding traced values; iteration
+    and ``len()`` are fine, and each element comes out ``TAINT_TRACED``
+  * ``TAINT_TRACED`` (2) — a traced array; the R1/R2 flags fire only here
+
+Everything is a *may* analysis tuned to this repo's idioms: unknown names
+resolve untainted so that rule findings stay high-precision (the gate must
+hold ``exit 0`` on a clean tree without pragma noise).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+#: Attribute reads that return static (trace-time) metadata of an array.
+STATIC_ATTRS = {
+    "shape",
+    "dtype",
+    "ndim",
+    "size",
+    "weak_type",
+    "itemsize",
+    "sharding",
+    "at",  # x.at alone is an updater handle; taint re-enters via __getitem__
+}
+
+#: Canonical dotted names whose call takes function-valued operands that get
+#: traced: maps name -> indices of the callable arguments.
+TRANSFORM_BODY_ARGS = {
+    "jax.lax.scan": (0,),
+    "jax.lax.map": (0,),
+    "jax.lax.associative_scan": (0,),
+    "jax.lax.cond": (1, 2),
+    "jax.lax.while_loop": (0, 1),
+    "jax.lax.fori_loop": (2,),
+    "jax.lax.switch": (1,),
+    "jax.vmap": (0,),
+    "jax.pmap": (0,),
+    "jax.grad": (0,),
+    "jax.value_and_grad": (0,),
+    "jax.checkpoint": (0,),
+    "jax.remat": (0,),
+}
+
+#: Module prefixes expanded by the per-file alias maps ("jnp" -> "jax.numpy").
+_IMPLICIT_PREFIXES = {"jax": "jax", "numpy": "numpy", "functools": "functools"}
+
+#: Three-valued taint lattice (see module docstring).
+TAINT_NONE = 0
+TAINT_BOX = 1  # Python container of traced values — iteration is legal
+TAINT_TRACED = 2
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = dotted_name(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+@dataclass
+class JitSpec:
+    static_argnums: tuple[int, ...] = ()
+    static_argnames: tuple[str, ...] = ()
+    donate_argnums: tuple[int, ...] = ()
+
+
+@dataclass
+class SourceFile:
+    path: Path
+    relpath: str
+    source: str
+    tree: ast.Module
+    modkey: str
+    alias_to_canon: dict[str, str] = field(default_factory=dict)
+    internal_modules: dict[str, str] = field(default_factory=dict)
+    imported_syms: dict[str, tuple[str, str]] = field(default_factory=dict)
+
+
+@dataclass
+class FuncInfo:
+    qname: str
+    name: str
+    file: SourceFile
+    node: ast.FunctionDef | ast.AsyncFunctionDef | ast.Lambda
+    parent: "FuncInfo | None"
+    params: list[str]
+    jit: JitSpec | None = None
+    hot: bool = False
+    param_taint: dict[str, int] = field(default_factory=dict)
+    env: dict[str, int] = field(default_factory=dict)
+    local_funcs: dict[str, "FuncInfo"] = field(default_factory=dict)
+
+    def taint_params_from_jit(self) -> None:
+        assert self.jit is not None
+        for i, p in enumerate(self.params):
+            static = i in self.jit.static_argnums or p in self.jit.static_argnames
+            level = TAINT_NONE if static else TAINT_TRACED
+            self.param_taint[p] = max(self.param_taint.get(p, TAINT_NONE), level)
+
+    def taint_all_params(self) -> None:
+        for p in self.params:
+            self.param_taint[p] = TAINT_TRACED
+
+
+@dataclass
+class TaintEvent:
+    kind: str  # "R1" | "R2"
+    node: ast.AST
+    fn: FuncInfo
+    message: str
+    hint: str
+
+
+def _literal(node: ast.AST, default=None):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError, TypeError):
+        return default
+
+
+def _as_tuple(value) -> tuple:
+    if value is None:
+        return ()
+    if isinstance(value, (int, str)):
+        return (value,)
+    if isinstance(value, (tuple, list)):
+        return tuple(value)
+    return ()
+
+
+class Engine:
+    def __init__(self, files: list[SourceFile]):
+        self.files = files
+        self.funcs: dict[str, FuncInfo] = {}  # qname -> info
+        self.by_module: dict[str, dict[str, FuncInfo]] = {}  # modkey -> name -> info
+        self.jitted: list[FuncInfo] = []
+        for f in files:
+            self._collect_imports(f)
+        for f in files:
+            self._index_functions(f)
+        for f in files:
+            self._apply_jit_assignments(f)
+
+    # ---------------------------------------------------------------- index
+
+    def _collect_imports(self, f: SourceFile) -> None:
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    local = alias.asname or alias.name.split(".")[0]
+                    target = alias.name if alias.asname else alias.name.split(".")[0]
+                    f.alias_to_canon[local] = target
+                    if self._is_internal(alias.name):
+                        f.internal_modules[local] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:  # relative imports unused in this repo
+                    continue
+                mod = node.module or ""
+                for alias in node.names:
+                    local = alias.asname or alias.name
+                    full = f"{mod}.{alias.name}"
+                    f.alias_to_canon[local] = full
+                    if self._is_internal(full):
+                        f.internal_modules[local] = full
+                    if self._is_internal(mod):
+                        f.imported_syms[local] = (mod, alias.name)
+
+    def _is_internal(self, dotted: str) -> bool:
+        roots = {fl.modkey.split(".")[0] for fl in self.files}
+        return dotted.split(".")[0] in roots
+
+    def canon(self, node: ast.AST, f: SourceFile) -> str | None:
+        """Expand a Name/Attribute chain through the file's import aliases."""
+        d = dotted_name(node)
+        if not d:
+            return None
+        head, _, rest = d.partition(".")
+        base = f.alias_to_canon.get(head)
+        if base is None and head in _IMPLICIT_PREFIXES:
+            base = head
+        if base is None:
+            return d
+        return f"{base}.{rest}" if rest else base
+
+    def _index_functions(self, f: SourceFile) -> None:
+        mod_funcs: dict[str, FuncInfo] = {}
+        self.by_module[f.modkey] = mod_funcs
+
+        def visit(node: ast.AST, parent: FuncInfo | None, prefix: str) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qname = f"{f.modkey}:{prefix}{child.name}"
+                    args = child.args
+                    params = [
+                        a.arg
+                        for a in (args.posonlyargs + args.args + args.kwonlyargs)
+                    ]
+                    info = FuncInfo(
+                        qname=qname,
+                        name=child.name,
+                        file=f,
+                        node=child,
+                        parent=parent,
+                        params=params,
+                        jit=self._jit_from_decorators(child, f),
+                    )
+                    self.funcs[qname] = info
+                    if parent is None:
+                        mod_funcs.setdefault(child.name, info)
+                    else:
+                        parent.local_funcs[child.name] = info
+                    if info.jit is not None:
+                        self.jitted.append(info)
+                    visit(child, info, f"{prefix}{child.name}.")
+                elif isinstance(child, ast.ClassDef):
+                    # Methods index under the class; parent scope stays None
+                    # (methods do not close over module functions' locals).
+                    visit(child, parent, f"{prefix}{child.name}.")
+                else:
+                    visit(child, parent, prefix)
+
+        visit(f.tree, None, "")
+
+    def _jit_from_decorators(self, node, f: SourceFile) -> JitSpec | None:
+        for deco in node.decorator_list:
+            spec = self._jit_spec(deco, f)
+            if spec is not None:
+                return spec
+        return None
+
+    def _jit_spec(self, expr: ast.AST, f: SourceFile) -> JitSpec | None:
+        """Recognise jax.jit in decorator/assignment position."""
+        if self.canon(expr, f) == "jax.jit":
+            return JitSpec()
+        if not isinstance(expr, ast.Call):
+            return None
+        fc = self.canon(expr.func, f)
+        kwargs = expr.keywords
+        if fc == "functools.partial" and expr.args:
+            if self.canon(expr.args[0], f) != "jax.jit":
+                return None
+        elif fc != "jax.jit":
+            return None
+        spec = JitSpec()
+        for kw in kwargs:
+            if kw.arg == "static_argnums":
+                spec.static_argnums = _as_tuple(_literal(kw.value))
+            elif kw.arg == "static_argnames":
+                spec.static_argnames = tuple(
+                    s for s in _as_tuple(_literal(kw.value)) if isinstance(s, str)
+                )
+            elif kw.arg == "donate_argnums":
+                spec.donate_argnums = _as_tuple(_literal(kw.value))
+        return spec
+
+    def _apply_jit_assignments(self, f: SourceFile) -> None:
+        """``name = jax.jit(fn, static_argnums=...)`` marks fn jitted and
+        aliases name to it at module level."""
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call
+            ):
+                continue
+            call = node.value
+            fc = self.canon(call.func, f)
+            if fc != "jax.jit" or not call.args:
+                continue
+            target_fn = self.resolve_callable(call.args[0], None, f)
+            if target_fn is None:
+                continue
+            spec = self._jit_spec(
+                ast.Call(func=call.func, args=[], keywords=call.keywords), f
+            ) or JitSpec()
+            target_fn.jit = spec
+            self.jitted.append(target_fn)
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self.by_module[f.modkey].setdefault(tgt.id, target_fn)
+
+    # ------------------------------------------------------------- resolve
+
+    def resolve_callable(
+        self, node: ast.AST, scope: FuncInfo | None, f: SourceFile
+    ) -> FuncInfo | None:
+        if isinstance(node, ast.Name):
+            s = scope
+            while s is not None:
+                if node.id in s.local_funcs:
+                    return s.local_funcs[node.id]
+                s = s.parent
+            mod = self.by_module.get(f.modkey, {})
+            if node.id in mod:
+                return mod[node.id]
+            if node.id in f.imported_syms:
+                modkey, sym = f.imported_syms[node.id]
+                return self.by_module.get(modkey, {}).get(sym)
+            return None
+        if isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name):
+            base = node.value.id
+            if base in f.internal_modules:
+                modkey = f.internal_modules[base]
+                return self.by_module.get(modkey, {}).get(node.attr)
+        return None
+
+    # ------------------------------------------------------------ hot seed
+
+    def seed_hot(self) -> list[FuncInfo]:
+        work: list[FuncInfo] = []
+        for info in self.jitted:
+            info.taint_params_from_jit()
+            if not info.hot:
+                info.hot = True
+                work.append(info)
+        # Transform bodies anywhere (scan/cond trace even outside jit).
+        for f in self.files:
+            for scope_fn, call in self._iter_calls(f):
+                fc = self.canon(call.func, f)
+                body_idx = TRANSFORM_BODY_ARGS.get(fc or "")
+                if not body_idx:
+                    continue
+                for i in body_idx:
+                    if i >= len(call.args):
+                        continue
+                    cand = call.args[i]
+                    if isinstance(cand, ast.Lambda):
+                        continue  # traced inline during the caller's analysis
+                    target = self.resolve_callable(cand, scope_fn, f)
+                    if target is not None:
+                        target.taint_all_params()
+                        if not target.hot:
+                            target.hot = True
+                            work.append(target)
+        return work
+
+    def _iter_calls(self, f: SourceFile):
+        """Yield (enclosing FuncInfo | None, Call node) pairs for a file."""
+
+        def visit(node: ast.AST, scope: FuncInfo | None):
+            for child in ast.iter_child_nodes(node):
+                inner = scope
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    inner = self._info_for_node(child, scope, f) or scope
+                elif isinstance(child, ast.Call):
+                    yield scope, child
+                yield from visit(child, inner)
+
+        yield from visit(f.tree, None)
+
+    def _info_for_node(self, node, scope, f: SourceFile) -> FuncInfo | None:
+        if scope is not None and node.name in scope.local_funcs:
+            return scope.local_funcs[node.name]
+        for info in self.funcs.values():
+            if info.node is node:
+                return info
+        return None
+
+    # ------------------------------------------------------------ fixpoint
+
+    def run(self) -> list[TaintEvent]:
+        work = self.seed_hot()
+        seen_rounds = 0
+        while work and seen_rounds < 40:
+            seen_rounds += 1
+            next_work: list[FuncInfo] = []
+            for fn in work:
+                analysis = FnAnalysis(fn, self, record=False)
+                analysis.run()
+                for callee, bindings in analysis.callsites:
+                    changed = not callee.hot
+                    callee.hot = True
+                    for pname, taint in bindings.items():
+                        if taint > callee.param_taint.get(pname, TAINT_NONE):
+                            callee.param_taint[pname] = taint
+                            changed = True
+                        else:
+                            callee.param_taint.setdefault(pname, TAINT_NONE)
+                    if changed:
+                        next_work.append(callee)
+            work = next_work
+        events: list[TaintEvent] = []
+        # Parents first so closures read a finished environment.
+        hot = [fn for fn in self.funcs.values() if fn.hot]
+        hot.sort(key=lambda fn: fn.qname.count("."))
+        for fn in hot:
+            analysis = FnAnalysis(fn, self, record=True)
+            analysis.run()
+            events.extend(analysis.events)
+        return events
+
+
+class FnAnalysis:
+    """One forward may-taint pass over a hot function's body."""
+
+    def __init__(self, fn: FuncInfo, engine: Engine, record: bool):
+        self.fn = fn
+        self.engine = engine
+        self.record = record
+        self.events: list[TaintEvent] = []
+        self.callsites: list[tuple[FuncInfo, dict[str, int]]] = []
+        self.env: dict[str, int] = dict(fn.param_taint)
+        for p in fn.params:
+            self.env.setdefault(p, TAINT_NONE)
+
+    # -- environment -------------------------------------------------------
+
+    def lookup(self, name: str) -> int:
+        if name in self.env:
+            return self.env[name]
+        s = self.fn.parent
+        while s is not None:
+            if name in s.env:
+                return s.env[name]
+            s = s.parent
+        return TAINT_NONE
+
+    def assign(self, target: ast.AST, taint: int) -> None:
+        if isinstance(target, ast.Name):
+            self.env[target.id] = taint
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            # Unpacking a BOX (or a traced carry tuple) hands out its
+            # elements: each binds at TRACED when anything was tainted.
+            inner = TAINT_TRACED if taint else TAINT_NONE
+            for elt in target.elts:
+                self.assign(elt, inner)
+        elif isinstance(target, ast.Starred):
+            self.assign(target.value, taint)
+        # Attribute/Subscript stores mutate objects; taint stays with the base.
+
+    # -- events ------------------------------------------------------------
+
+    def emit(self, kind: str, node: ast.AST, message: str, hint: str) -> None:
+        if self.record:
+            self.events.append(TaintEvent(kind, node, self.fn, message, hint))
+
+    # -- expression taint --------------------------------------------------
+
+    def tx(self, node: ast.AST | None, bool_ok: ast.AST | None = None) -> int:
+        """Taint level of an expression; flags implicit bool coercions unless
+        the node is ``bool_ok`` (already reported by the statement check)."""
+        if node is None or isinstance(node, ast.Constant):
+            return TAINT_NONE
+        if isinstance(node, ast.Name):
+            return self.lookup(node.id)
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                self.tx(node.value)
+                return TAINT_NONE
+            return self.tx(node.value)
+        if isinstance(node, ast.Subscript):
+            self.tx(node.slice)
+            # Indexing a BOX yields one of its traced elements; indexing a
+            # traced array yields a traced array.
+            return TAINT_TRACED if self.tx(node.value) else TAINT_NONE
+        if isinstance(node, ast.BinOp):
+            return max(self.tx(node.left), self.tx(node.right))
+        if isinstance(node, ast.UnaryOp):
+            t = self.tx(node.operand)
+            if (
+                t == TAINT_TRACED
+                and isinstance(node.op, ast.Not)
+                and node is not bool_ok
+            ):
+                self.emit(
+                    "R1",
+                    node,
+                    "`not` on a traced value forces a host bool()",
+                    "use jnp.logical_not / `~` on boolean arrays",
+                )
+            return t
+        if isinstance(node, ast.BoolOp):
+            taints = [self.tx(v) for v in node.values]
+            if node is not bool_ok:
+                for v, t in zip(node.values[:-1], taints[:-1]):
+                    if t == TAINT_TRACED:
+                        self.emit(
+                            "R1",
+                            v,
+                            "and/or on a traced value forces a host bool()",
+                            "use `&`/`|` (jnp.logical_and/or) on arrays",
+                        )
+            return max(taints)
+        if isinstance(node, ast.Compare):
+            ops_are_identity = all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            )
+            t = max(self.tx(node.left), *(self.tx(c) for c in node.comparators))
+            if ops_are_identity or t != TAINT_TRACED:
+                return TAINT_NONE  # `is None` / list == list: host bools
+            return TAINT_TRACED  # array comparison is itself an array
+        if isinstance(node, ast.IfExp):
+            tt = self.tx(node.test, bool_ok=bool_ok)
+            if tt == TAINT_TRACED and node.test is not bool_ok:
+                self.emit(
+                    "R1",
+                    node.test,
+                    "conditional expression tests a traced value",
+                    "use jnp.where(cond, a, b) or lax.select",
+                )
+            return max(self.tx(node.body), self.tx(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            # A literal container of tainted things is a BOX, never TRACED:
+            # iterating it is legal Python, its elements carry the taint.
+            return TAINT_BOX if any(self.tx(e) for e in node.elts) else TAINT_NONE
+        if isinstance(node, ast.Dict):
+            tainted = any(self.tx(k) for k in node.keys if k is not None) | any(
+                self.tx(v) for v in node.values
+            )
+            return TAINT_BOX if tainted else TAINT_NONE
+        if isinstance(node, ast.Starred):
+            return self.tx(node.value)
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                if isinstance(v, ast.FormattedValue):
+                    self.tx(v.value)
+            return TAINT_NONE
+        if isinstance(node, ast.NamedExpr):
+            t = self.tx(node.value)
+            self.assign(node.target, t)
+            return t
+        if isinstance(node, ast.Lambda):
+            return TAINT_NONE
+        if isinstance(
+            node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)
+        ):
+            return self._comp(node)
+        if isinstance(node, ast.Call):
+            return self._call(node)
+        if isinstance(node, ast.Await):
+            return self.tx(node.value)
+        return TAINT_NONE
+
+    def _comp(self, node) -> int:
+        for gen in node.generators:
+            it = self.tx(gen.iter)
+            if it == TAINT_TRACED:
+                self.emit(
+                    "R1",
+                    gen.iter,
+                    "comprehension iterates a traced value",
+                    "iterate static ranges; batch array work with vmap/scan",
+                )
+            self.assign(gen.target, TAINT_TRACED if it else TAINT_NONE)
+            for cond in gen.ifs:
+                ct = self.tx(cond, bool_ok=cond)
+                if ct == TAINT_TRACED:
+                    self.emit(
+                        "R1",
+                        cond,
+                        "comprehension filter tests a traced value",
+                        "use jnp.where masks instead of Python filtering",
+                    )
+        elt = (
+            max(self.tx(node.key), self.tx(node.value))
+            if isinstance(node, ast.DictComp)
+            else self.tx(node.elt)
+        )
+        return TAINT_BOX if elt else TAINT_NONE
+
+    def _call(self, node: ast.Call) -> int:
+        f = self.fn.file
+        eng = self.engine
+        fc = eng.canon(node.func, f)
+        arg_taints = [self.tx(a) for a in node.args]
+        kw_taints = {kw.arg: self.tx(kw.value) for kw in node.keywords}
+        top = max([TAINT_NONE, *arg_taints, *kw_taints.values()])
+        # An opaque call that saw any taint may return a traced array.
+        result = TAINT_TRACED if top else TAINT_NONE
+
+        # Builtin conversions -------------------------------------------------
+        if isinstance(node.func, ast.Name):
+            name = node.func.id
+            if name == "bool":
+                if top == TAINT_TRACED:
+                    self.emit(
+                        "R1",
+                        node,
+                        "bool() on a traced value",
+                        "keep it an array; use jnp.where / lax.cond on the "
+                        "value",
+                    )
+                return TAINT_NONE  # bool(BOX) is a host len-check: fine
+            if name in ("int", "float", "complex"):
+                if top == TAINT_TRACED:
+                    self.emit(
+                        "R2",
+                        node,
+                        f"{name}() on a traced value is a device->host sync",
+                        "keep the value on device (jnp ops) or move this code "
+                        "out of the jitted path",
+                    )
+                return TAINT_NONE
+            if name == "len":
+                return TAINT_NONE
+            if name in ("list", "tuple", "set", "frozenset", "dict"):
+                # Re-boxing a container (or materializing a BOX iterator)
+                # keeps it an iterable-of-traced, not a traced array.
+                return TAINT_BOX if top else TAINT_NONE
+            if name in ("range", "enumerate", "zip", "reversed", "sorted"):
+                return top
+
+        # Method calls --------------------------------------------------------
+        if isinstance(node.func, ast.Attribute):
+            attr = node.func.attr
+            recv_taint = self.tx(node.func.value)
+            result = max(result, TAINT_TRACED if recv_taint else TAINT_NONE)
+            if attr == "item" and not node.args and recv_taint == TAINT_TRACED:
+                self.emit(
+                    "R2",
+                    node,
+                    ".item() in a traced hot path is a device->host sync",
+                    "return the array and convert outside jit",
+                )
+                return TAINT_NONE
+            if attr == "block_until_ready":
+                self.emit(
+                    "R2",
+                    node,
+                    "block_until_ready() inside a traced hot path",
+                    "synchronise at the host boundary, after the jitted call",
+                )
+                return recv_taint
+            if attr == "astype":
+                return recv_taint
+            if attr == "tolist" and recv_taint == TAINT_TRACED:
+                self.emit(
+                    "R2",
+                    node,
+                    ".tolist() on a traced value is a device->host sync",
+                    "keep the value on device or move out of the hot path",
+                )
+                return TAINT_NONE
+
+        if fc is not None:
+            if fc == "jax.device_get" or fc.startswith("jax.device_get"):
+                self.emit(
+                    "R2",
+                    node,
+                    "jax.device_get() in a traced hot path",
+                    "fetch results after the jitted call returns",
+                )
+                return TAINT_NONE
+            if fc == "jax.block_until_ready":
+                self.emit(
+                    "R2",
+                    node,
+                    "jax.block_until_ready() in a traced hot path",
+                    "synchronise at the host boundary, after the jitted call",
+                )
+                return result
+            if fc.startswith("numpy."):
+                # np.asarray(list_of_tracers) syncs just as hard as
+                # np.asarray(tracer): flag any taint level.
+                if top:
+                    self.emit(
+                        "R2",
+                        node,
+                        f"{fc}() on a traced value pulls it to the host",
+                        "use the jax.numpy equivalent inside traced code",
+                    )
+                return TAINT_NONE
+            body_idx = TRANSFORM_BODY_ARGS.get(fc)
+            if body_idx:
+                self._transform_call(node, body_idx)
+                return TAINT_TRACED
+            if fc.startswith(("jax.", "jax.numpy.", "jax.lax.", "jax.random.")):
+                return result
+
+        # Internal calls ------------------------------------------------------
+        target = eng.resolve_callable(node.func, self.fn, f)
+        if target is not None:
+            bindings: dict[str, int] = {}
+            params = target.params
+            pos = 0
+            for t in arg_taints:
+                if pos < len(params):
+                    bindings[params[pos]] = max(
+                        bindings.get(params[pos], TAINT_NONE), t
+                    )
+                pos += 1
+            for kw, t in kw_taints.items():
+                if kw in params:
+                    bindings[kw] = max(bindings.get(kw, TAINT_NONE), t)
+            self.callsites.append((target, bindings))
+            return result
+        return result
+
+    def _transform_call(self, node: ast.Call, body_idx: tuple[int, ...]) -> None:
+        """Register transform body callables; inline lambdas analyze here."""
+        for i in body_idx:
+            if i >= len(node.args):
+                continue
+            cand = node.args[i]
+            if isinstance(cand, ast.Lambda):
+                lam = FuncInfo(
+                    qname=f"{self.fn.qname}.<lambda@{cand.lineno}>",
+                    name="<lambda>",
+                    file=self.fn.file,
+                    node=cand,
+                    parent=self.fn,
+                    params=[a.arg for a in cand.args.args],
+                    hot=True,
+                )
+                lam.taint_all_params()
+                sub = FnAnalysis(lam, self.engine, record=self.record)
+                sub.env.update(lam.param_taint)
+                sub.fn.parent = self.fn
+                t = sub.tx(cand.body)
+                _ = t
+                self.events.extend(sub.events)
+                self.callsites.extend(sub.callsites)
+                continue
+            target = self.engine.resolve_callable(cand, self.fn, self.fn.file)
+            if target is not None:
+                bindings = {p: True for p in target.params}
+                self.callsites.append((target, bindings))
+
+    # -- statements --------------------------------------------------------
+
+    def run(self) -> None:
+        node = self.fn.node
+        if isinstance(node, ast.Lambda):
+            self.tx(node.body)
+        else:
+            self.block(node.body)
+        self.fn.env = dict(self.env)
+
+    def block(self, stmts: list[ast.stmt]) -> None:
+        for st in stmts:
+            self.stmt(st)
+
+    def _merge(self, *envs: dict[str, int]) -> None:
+        merged = dict(self.env)
+        for e in envs:
+            for k, v in e.items():
+                merged[k] = max(merged.get(k, TAINT_NONE), v)
+        self.env = merged
+
+    def _check_test(self, test: ast.AST, what: str) -> None:
+        if self.tx(test, bool_ok=test) == TAINT_TRACED:
+            self.emit(
+                "R1",
+                test,
+                f"{what} tests a traced value inside a traced hot path",
+                "branch with lax.cond/jnp.where, or hoist the value to a "
+                "static argument (static_argnums/static_argnames)",
+            )
+
+    def stmt(self, st: ast.stmt) -> None:
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # analyzed separately if it becomes hot
+        if isinstance(st, ast.Assign):
+            t = self.tx(st.value)
+            for tgt in st.targets:
+                self.assign(tgt, t)
+        elif isinstance(st, ast.AnnAssign):
+            if st.value is not None:
+                self.assign(st.target, self.tx(st.value))
+        elif isinstance(st, ast.AugAssign):
+            t = self.tx(st.value)
+            if isinstance(st.target, ast.Name):
+                self.env[st.target.id] = max(self.lookup(st.target.id), t)
+        elif isinstance(st, ast.Return):
+            self.tx(st.value)
+        elif isinstance(st, ast.Expr):
+            self.tx(st.value)
+        elif isinstance(st, ast.If):
+            self._check_test(st.test, "if")
+            before = dict(self.env)
+            self.block(st.body)
+            after_body = self.env
+            self.env = before
+            self.block(st.orelse)
+            self._merge(after_body)
+        elif isinstance(st, ast.While):
+            self._check_test(st.test, "while")
+            for _ in range(2):
+                before = dict(self.env)
+                self.block(st.body)
+                self._merge(before)
+            self.block(st.orelse)
+        elif isinstance(st, ast.For):
+            it = self.tx(st.iter)
+            if it == TAINT_TRACED:
+                self.emit(
+                    "R1",
+                    st.iter,
+                    "for-loop iterates a traced value",
+                    "use lax.scan/fori_loop, or iterate a static range",
+                )
+            elt = TAINT_TRACED if it else TAINT_NONE
+            for _ in range(2):
+                before = dict(self.env)
+                self.assign(st.target, elt)
+                self.block(st.body)
+                self._merge(before)
+            self.block(st.orelse)
+        elif isinstance(st, ast.Assert):
+            self._check_test(st.test, "assert")
+            if st.msg is not None:
+                self.tx(st.msg)
+        elif isinstance(st, ast.Raise):
+            if st.exc is not None:
+                self.tx(st.exc)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                t = self.tx(item.context_expr)
+                if item.optional_vars is not None:
+                    self.assign(item.optional_vars, t)
+            self.block(st.body)
+        elif isinstance(st, ast.Try):
+            self.block(st.body)
+            for h in st.handlers:
+                self.block(h.body)
+            self.block(st.orelse)
+            self.block(st.finalbody)
+        # Import/Pass/Break/Continue/Global/Nonlocal/Delete: no taint flow.

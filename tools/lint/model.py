@@ -1,0 +1,94 @@
+"""Core data model for tpulint.
+
+A :class:`Finding` is one diagnostic: rule id, location, message, fix hint.
+Findings are stable across runs — the :attr:`Finding.fingerprint` hashes the
+(relpath, rule, stripped source line) triple, NOT the line number, so a
+baseline entry survives unrelated edits above it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+#: Rule ids and one-line descriptions (the CLI's ``--list-rules`` output).
+RULES = {
+    "R0": "malformed tpulint pragma (disable= needs a rule list and a "
+    "'-- justification')",
+    "R1": "Python control flow (if/while/assert/bool()/and/or/not) on a "
+    "traced value inside a jitted function or scan/cond body",
+    "R2": "host synchronisation (float()/int()/.item()/np.asarray/"
+    "jax.device_get/block_until_ready) reachable from a jitted hot path",
+    "R3": "nondeterminism in library code (wall-clock time.time seeds, "
+    "unseeded RNGs, set-order iteration feeding traced ops)",
+    "R4": "recompilation/donation hazard (loop-varying value at a static "
+    "jit position; donated buffer read after donation)",
+    "R5": "dtype contract drift: a pytree-dataclass field rebuilt with a "
+    "dtype that disagrees with its canonical constructor",
+}
+
+#: Path segments that put a file in advisory scope: findings are reported
+#: but never fail the gate (tools/ and experiments/ are measurement code,
+#: allowed to sync and recompile at will — ISSUE scope).
+ADVISORY_SEGMENTS = ("experiments", "tools")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+    hint: str = ""
+    advisory: bool = False
+    baselined: bool = False
+    source_line: str = ""
+
+    @property
+    def fingerprint(self) -> str:
+        basis = f"{self.path}:{self.rule}:{self.source_line.strip()}"
+        return hashlib.sha1(basis.encode()).hexdigest()[:12]
+
+    def to_json(self) -> dict:
+        return {
+            "rule": self.rule,
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+            "hint": self.hint,
+            "advisory": self.advisory,
+            "baselined": self.baselined,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        tags = []
+        if self.advisory:
+            tags.append("advisory")
+        if self.baselined:
+            tags.append("baselined")
+        tag = f" [{', '.join(tags)}]" if tags else ""
+        out = f"{self.path}:{self.line}: {self.rule}{tag}: {self.message}"
+        if self.hint:
+            out += f"\n    hint: {self.hint}"
+        return out
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+    files_checked: int = 0
+
+    @property
+    def gated(self) -> list[Finding]:
+        """Findings that fail the gate (non-advisory; baselined ones pass)."""
+        return [f for f in self.findings if not f.advisory and not f.baselined]
+
+    @property
+    def advisory(self) -> list[Finding]:
+        return [f for f in self.findings if f.advisory]
+
+
+def is_advisory_path(relpath: str) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    return any(p in ADVISORY_SEGMENTS for p in parts)

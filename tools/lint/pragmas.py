@@ -1,0 +1,112 @@
+"""Inline suppression pragmas.
+
+Syntax (a real comment, found via ``tokenize`` so docstrings never match)::
+
+    x = float(y)  # tpulint: disable=R2 -- host boundary, runs between chunks
+    # tpulint: disable=R1,R3 -- trace-time constant fold, see PERF.md
+
+A pragma suppresses the listed rules on its own line and, when it is the
+only thing on its line, on the next non-blank line (the conventional
+"pragma above the statement" placement). The justification after ``--`` is
+REQUIRED and must be non-empty: an unexplained suppression is itself a
+gated finding (R0), so the suppression record stays reviewable.
+"""
+
+from __future__ import annotations
+
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+
+from tools.lint.model import RULES, Finding
+
+_PRAGMA_RE = re.compile(r"#\s*tpulint\s*:\s*(.*)$")
+_DISABLE_RE = re.compile(
+    r"^disable\s*=\s*(?P<rules>[A-Za-z0-9,\s]+?)\s*(?:--\s*(?P<why>.*))?$"
+)
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: frozenset[str]
+    justification: str
+    own_line: bool  # comment-only line: also applies to the next code line
+
+
+def parse_pragmas(source: str, relpath: str) -> tuple[list[Pragma], list[Finding]]:
+    """Extract pragmas + R0 findings for malformed ones."""
+    pragmas: list[Pragma] = []
+    findings: list[Finding] = []
+    lines = source.splitlines()
+    try:
+        tokens = list(tokenize.generate_tokens(io.StringIO(source).readline))
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return pragmas, findings
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PRAGMA_RE.search(tok.string)
+        if not m:
+            continue
+        lineno = tok.start[0]
+        src = lines[lineno - 1] if lineno <= len(lines) else ""
+        body = m.group(1).strip()
+        dm = _DISABLE_RE.match(body)
+        bad = None
+        if not dm:
+            bad = (
+                f"unrecognised tpulint pragma {body!r} (want "
+                f"'disable=R<n>[,R<m>] -- justification')"
+            )
+        else:
+            rules = frozenset(
+                r.strip().upper() for r in dm.group("rules").split(",") if r.strip()
+            )
+            unknown = sorted(rules - set(RULES))
+            why = (dm.group("why") or "").strip()
+            if not rules:
+                bad = "pragma disables no rules"
+            elif unknown:
+                bad = f"pragma names unknown rule(s): {', '.join(unknown)}"
+            elif not why:
+                bad = (
+                    "pragma suppression requires a justification: "
+                    "'# tpulint: disable=Rn -- why this is safe'"
+                )
+        if bad is not None:
+            findings.append(
+                Finding(
+                    rule="R0",
+                    path=relpath,
+                    line=lineno,
+                    message=bad,
+                    hint="every suppression must say why; fix or remove it",
+                    source_line=src,
+                )
+            )
+            continue
+        own_line = src.lstrip().startswith("#")
+        pragmas.append(Pragma(lineno, rules, why, own_line))
+    return pragmas, findings
+
+
+def suppressed_lines(pragmas: list[Pragma], source: str) -> dict[int, frozenset[str]]:
+    """Map line number -> rules suppressed there (same line + next code line
+    for comment-only pragmas)."""
+    lines = source.splitlines()
+    out: dict[int, frozenset[str]] = {}
+
+    def add(line: int, rules: frozenset[str]) -> None:
+        out[line] = out.get(line, frozenset()) | rules
+
+    for p in pragmas:
+        add(p.line, p.rules)
+        if p.own_line:
+            nxt = p.line + 1
+            while nxt <= len(lines) and not lines[nxt - 1].strip():
+                nxt += 1
+            if nxt <= len(lines):
+                add(nxt, p.rules)
+    return out

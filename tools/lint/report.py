@@ -1,0 +1,83 @@
+"""Rendering and baseline support.
+
+The JSON report under ``artifacts/`` is the machine-readable twin of the
+console output (CI archives it next to the bench/crossval artifacts). The
+baseline file (``tools/lint/baseline.json``) pins the *advisory-scope*
+findings (experiments/, tools/) that existed when the gate shipped, so the
+report can say "known" vs "new since baseline" without ever failing the
+gate on measurement code. Gated scope (the library package) has no baseline:
+violations there are fixed or pragma-justified, never inventoried.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+from tools.lint.model import Finding, LintResult
+
+
+def apply_baseline(result: LintResult, baseline_path: Path | None) -> None:
+    if baseline_path is None or not Path(baseline_path).exists():
+        return
+    try:
+        data = json.loads(Path(baseline_path).read_text())
+    except (json.JSONDecodeError, OSError):
+        return
+    known = {e.get("fingerprint") for e in data.get("advisory", [])}
+    for f in result.findings:
+        if f.advisory and f.fingerprint in known:
+            f.baselined = True
+
+
+def write_baseline(result: LintResult, baseline_path: Path) -> None:
+    entries = [
+        {
+            "fingerprint": f.fingerprint,
+            "rule": f.rule,
+            "path": f.path,
+            "line": f.line,
+            "summary": f.message,
+        }
+        for f in result.findings
+        if f.advisory
+    ]
+    baseline_path.parent.mkdir(parents=True, exist_ok=True)
+    baseline_path.write_text(
+        json.dumps({"version": 1, "advisory": entries}, indent=2) + "\n"
+    )
+
+
+def write_json(result: LintResult, path: Path) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    payload = {
+        "files_checked": result.files_checked,
+        "gated_count": len(result.gated),
+        "advisory_count": len(result.advisory),
+        "findings": [f.to_json() for f in result.findings],
+    }
+    path.write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def render_text(result: LintResult, quiet: bool = False) -> str:
+    lines: list[str] = []
+    gated = result.gated
+    advisory = result.advisory
+    new_advisory = [f for f in advisory if not f.baselined]
+    for f in result.findings:
+        if quiet and f.baselined:
+            continue
+        lines.append(f.render())
+    if lines:
+        lines.append("")
+    lines.append(
+        f"tpulint: {result.files_checked} files, "
+        f"{len(gated)} gated finding(s), "
+        f"{len(advisory)} advisory ({len(new_advisory)} new since baseline)"
+    )
+    if gated:
+        lines.append("gate: FAIL (fix the finding or suppress with "
+                     "'# tpulint: disable=R<n> -- justification')")
+    else:
+        lines.append("gate: OK")
+    return "\n".join(lines)

@@ -1,0 +1,492 @@
+"""Rule implementations R1-R5.
+
+R1/R2 are projections of the taint engine's events (tools/lint/callgraph.py)
+onto findings; R3-R5 are direct AST passes with the engine's import/alias
+resolution. Every finding carries a one-line fix hint.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.lint.callgraph import Engine, SourceFile, TaintEvent, dotted_name
+from tools.lint.model import Finding
+
+# --------------------------------------------------------------- R1 / R2
+
+
+def findings_from_events(events: list[TaintEvent]) -> list[Finding]:
+    out = []
+    for ev in events:
+        line = getattr(ev.node, "lineno", 1)
+        src_lines = ev.fn.file.source.splitlines()
+        src = src_lines[line - 1] if 0 < line <= len(src_lines) else ""
+        out.append(
+            Finding(
+                rule=ev.kind,
+                path=ev.fn.file.relpath,
+                line=line,
+                message=f"{ev.message} (in `{ev.fn.name}`, traced hot path)",
+                hint=ev.hint,
+                source_line=src,
+            )
+        )
+    return out
+
+
+# --------------------------------------------------------------------- R3
+
+_WALLCLOCK_CALLS = {
+    "time.time",
+    "time.time_ns",
+    "datetime.datetime.now",
+    "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
+
+#: numpy.random module-level functions = the hidden global RNG.
+_NP_GLOBAL_OK = {"default_rng", "Generator", "SeedSequence", "RandomState"}
+
+_PY_GLOBAL_RANDOM = {
+    "random.random",
+    "random.randint",
+    "random.randrange",
+    "random.choice",
+    "random.choices",
+    "random.shuffle",
+    "random.sample",
+    "random.uniform",
+    "random.gauss",
+    "random.betavariate",
+    "random.expovariate",
+}
+
+
+def rule_r3(files: list[SourceFile], engine: Engine) -> list[Finding]:
+    out: list[Finding] = []
+
+    def add(f: SourceFile, node: ast.AST, msg: str, hint: str) -> None:
+        line = getattr(node, "lineno", 1)
+        src = f.source.splitlines()[line - 1] if line <= len(f.source.splitlines()) else ""
+        out.append(
+            Finding(
+                rule="R3", path=f.relpath, line=line, message=msg, hint=hint,
+                source_line=src,
+            )
+        )
+
+    for f in files:
+        hot_spans = _hot_line_spans(engine, f)
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Call):
+                fc = engine.canon(node.func, f)
+                if fc in _WALLCLOCK_CALLS:
+                    add(
+                        f,
+                        node,
+                        f"{fc}() injects wall-clock state into library code",
+                        "accept an injectable seed/epoch (wall clock only as "
+                        "an explicit default) so runs are reproducible",
+                    )
+                elif fc == "random.Random" and not node.args and not node.keywords:
+                    add(
+                        f,
+                        node,
+                        "seedless random.Random() is nondeterministic across runs",
+                        "thread a seeded rng (or seed argument) from the caller",
+                    )
+                elif (
+                    fc == "numpy.random.default_rng"
+                    and not node.args
+                    and not node.keywords
+                ):
+                    add(
+                        f,
+                        node,
+                        "np.random.default_rng() without a seed is "
+                        "nondeterministic across runs",
+                        "pass an explicit seed (or accept one from the caller)",
+                    )
+                elif (
+                    fc
+                    and fc.startswith("numpy.random.")
+                    and fc.rsplit(".", 1)[1] not in _NP_GLOBAL_OK
+                ):
+                    add(
+                        f,
+                        node,
+                        f"{fc}() draws from numpy's hidden global RNG",
+                        "use np.random.default_rng(seed) / jax.random with an "
+                        "explicit key",
+                    )
+                elif fc in _PY_GLOBAL_RANDOM:
+                    add(
+                        f,
+                        node,
+                        f"{fc}() draws from the process-global RNG",
+                        "use a seeded random.Random instance threaded from "
+                        "the caller",
+                    )
+            iter_node = None
+            if isinstance(node, ast.For):
+                iter_node = node.iter
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp, ast.DictComp)):
+                iter_node = node.generators[0].iter
+            if iter_node is not None:
+                if isinstance(iter_node, ast.Set) or (
+                    isinstance(iter_node, ast.Call)
+                    and isinstance(iter_node.func, ast.Name)
+                    and iter_node.func.id in ("set", "frozenset")
+                ):
+                    add(
+                        f,
+                        iter_node,
+                        "iteration over a set: order is hash-randomized "
+                        "across processes",
+                        "iterate sorted(<set>) or keep a list/tuple",
+                    )
+                elif (
+                    isinstance(iter_node, ast.Call)
+                    and isinstance(iter_node.func, ast.Attribute)
+                    and iter_node.func.attr in ("items", "values", "keys")
+                    and _in_spans(getattr(node, "lineno", 0), hot_spans)
+                ):
+                    add(
+                        f,
+                        iter_node,
+                        "dict-order iteration inside a traced hot path: "
+                        "insertion order becomes part of the compiled program",
+                        "iterate sorted(d.items()) or a fixed field tuple so "
+                        "the traced program is order-independent",
+                    )
+    return out
+
+
+def _hot_line_spans(engine: Engine, f: SourceFile) -> list[tuple[int, int]]:
+    spans = []
+    for info in engine.funcs.values():
+        if info.hot and info.file is f and hasattr(info.node, "body"):
+            end = getattr(info.node, "end_lineno", info.node.lineno)
+            spans.append((info.node.lineno, end))
+    return spans
+
+
+def _in_spans(line: int, spans: list[tuple[int, int]]) -> bool:
+    return any(a <= line <= b for a, b in spans)
+
+
+# --------------------------------------------------------------------- R4
+
+
+def rule_r4(files: list[SourceFile], engine: Engine) -> list[Finding]:
+    out: list[Finding] = []
+    for f in files:
+        for scope_fn, call in engine._iter_calls(f):
+            target = engine.resolve_callable(call.func, scope_fn, f)
+            if target is None or target.jit is None:
+                continue
+            loops = _enclosing_loops(scope_fn, call) if scope_fn else []
+            loop_names: set[str] = set()
+            for lp in loops:
+                loop_names |= _assigned_names(lp)
+            spec = target.jit
+            if loop_names:
+                for idx, arg in enumerate(call.args):
+                    pname = target.params[idx] if idx < len(target.params) else None
+                    is_static = idx in spec.static_argnums or (
+                        pname is not None and pname in spec.static_argnames
+                    )
+                    if is_static and _names_in(arg) & loop_names:
+                        out.append(
+                            _mk(
+                                f,
+                                arg,
+                                "R4",
+                                f"loop-varying value at static position {idx} "
+                                f"of jitted `{target.name}` recompiles every "
+                                "iteration",
+                                "keep static args loop-invariant (fixed chunk "
+                                "sizes), or make the argument a traced array",
+                            )
+                        )
+                for kw in call.keywords:
+                    if kw.arg in spec.static_argnames and _names_in(kw.value) & loop_names:
+                        out.append(
+                            _mk(
+                                f,
+                                kw.value,
+                                "R4",
+                                f"loop-varying value for static argname "
+                                f"'{kw.arg}' of jitted `{target.name}` "
+                                "recompiles every iteration",
+                                "keep static args loop-invariant, or make the "
+                                "argument a traced array",
+                            )
+                        )
+            for didx in spec.donate_argnums:
+                if didx >= len(call.args):
+                    continue
+                arg = call.args[didx]
+                if not isinstance(arg, ast.Name):
+                    continue
+                misuse = _donated_read_after(scope_fn, call, arg.id) if scope_fn else None
+                if misuse is not None:
+                    out.append(
+                        _mk(
+                            f,
+                            misuse,
+                            "R4",
+                            f"`{arg.id}` was donated to jitted "
+                            f"`{target.name}` (donate_argnums={didx}) and is "
+                            "read afterwards: its buffer may already be "
+                            "reused",
+                            "rebind the result over the donated name "
+                            "(`x, aux = fn(.., x, ..)`) and never touch the "
+                            "old reference",
+                        )
+                    )
+    return out
+
+
+def _mk(f: SourceFile, node: ast.AST, rule: str, msg: str, hint: str) -> Finding:
+    line = getattr(node, "lineno", 1)
+    lines = f.source.splitlines()
+    src = lines[line - 1] if 0 < line <= len(lines) else ""
+    return Finding(
+        rule=rule, path=f.relpath, line=line, message=msg, hint=hint,
+        source_line=src,
+    )
+
+
+def _enclosing_loops(scope_fn, call: ast.Call) -> list[ast.stmt]:
+    """Loop statements of scope_fn that (syntactically) contain the call."""
+    loops: list[ast.stmt] = []
+
+    def visit(node: ast.AST, stack: list[ast.stmt]) -> bool:
+        if node is call:
+            loops.extend(stack)
+            return True
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue  # different frame: loop variance doesn't apply
+            nstack = stack + [child] if isinstance(child, (ast.For, ast.While)) else stack
+            if visit(child, nstack):
+                return True
+        return False
+
+    if hasattr(scope_fn.node, "body"):
+        for st in scope_fn.node.body:
+            if visit(st, [st] if isinstance(st, (ast.For, ast.While)) else []):
+                break
+    return loops
+
+
+def _assigned_names(node: ast.AST) -> set[str]:
+    names: set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            names.add(n.id)
+    return names
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {
+        n.id
+        for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _donated_read_after(scope_fn, call: ast.Call, name: str) -> ast.AST | None:
+    """First Load of ``name`` after the statement containing ``call`` in the
+    same block, unless that statement itself rebinds ``name``."""
+    if not hasattr(scope_fn.node, "body"):
+        return None
+
+    def blocks(node: ast.AST):
+        for field in ("body", "orelse", "finalbody"):
+            b = getattr(node, field, None)
+            if isinstance(b, list) and b and isinstance(b[0], ast.stmt):
+                yield b
+        for child in ast.iter_child_nodes(node):
+            if not isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                yield from blocks(child)
+
+    for block in blocks(scope_fn.node):
+        for i, st in enumerate(block):
+            if not any(n is call for n in ast.walk(st)):
+                continue
+            if name in _assigned_names(st):
+                return None  # result rebinds the donated name: the safe idiom
+            for later in block[i + 1:]:
+                for n in ast.walk(later):
+                    if (
+                        isinstance(n, ast.Name)
+                        and n.id == name
+                        and isinstance(n.ctx, ast.Load)
+                    ):
+                        return n
+                if name in _assigned_names(later):
+                    break  # rebound before any read
+            return None
+    return None
+
+
+# --------------------------------------------------------------------- R5
+
+_DTYPE_NAMES = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32", "uint64",
+    "float16", "float32", "float64", "bfloat16", "bool_", "bool", "complex64",
+}
+
+_CTOR_FUNCS = {
+    "jax.numpy.zeros", "jax.numpy.ones", "jax.numpy.full", "jax.numpy.empty",
+    "jax.numpy.asarray", "jax.numpy.array", "jax.numpy.arange",
+}
+
+
+def _norm_dtype(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and node.attr in _DTYPE_NAMES:
+        return "bool" if node.attr == "bool_" else node.attr
+    if isinstance(node, ast.Name) and node.id in _DTYPE_NAMES:
+        return "bool" if node.id == "bool_" else node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _DTYPE_NAMES else None
+    return None
+
+
+def infer_dtype(expr: ast.AST, engine: Engine, f: SourceFile) -> str | None:
+    """Shallow dtype of an expression: explicit constructors and .astype only."""
+    if isinstance(expr, ast.Call):
+        if isinstance(expr.func, ast.Attribute) and expr.func.attr == "astype":
+            for a in expr.args:
+                d = _norm_dtype(a)
+                if d:
+                    return d
+            return None
+        fc = engine.canon(expr.func, f)
+        if fc in _CTOR_FUNCS:
+            for kw in expr.keywords:
+                if kw.arg == "dtype":
+                    return _norm_dtype(kw.value)
+            cands = [d for d in (_norm_dtype(a) for a in expr.args) if d]
+            if len(cands) == 1:
+                return cands[0]
+    return None
+
+
+def rule_r5(files: list[SourceFile], engine: Engine) -> list[Finding]:
+    out: list[Finding] = []
+    # 1. pytree dataclasses: class -> ordered field names.
+    classes: dict[str, tuple[SourceFile, ast.ClassDef, list[str]]] = {}
+    for f in files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            decos = {engine.canon(d, f) for d in node.decorator_list}
+            if "jax.tree_util.register_dataclass" not in decos:
+                continue
+            fields = [
+                st.target.id
+                for st in node.body
+                if isinstance(st, ast.AnnAssign) and isinstance(st.target, ast.Name)
+            ]
+            classes[node.name] = (f, node, fields)
+    if not classes:
+        return out
+
+    # 2. contract: canonical dtype per field, from constructor calls in the
+    #    class's own module (first inferable declaration wins; a same-module
+    #    conflict is itself drift).
+    contract: dict[str, dict[str, str]] = {name: {} for name in classes}
+    for cname, (cf, _, fields) in classes.items():
+        for node in ast.walk(cf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == cname
+            ):
+                for kw in node.keywords:
+                    if kw.arg not in fields:
+                        continue
+                    d = infer_dtype(kw.value, engine, cf)
+                    if d is None:
+                        continue
+                    prev = contract[cname].get(kw.arg)
+                    if prev is None:
+                        contract[cname][kw.arg] = d
+                    elif prev != d:
+                        out.append(
+                            _mk(
+                                cf,
+                                kw.value,
+                                "R5",
+                                f"{cname}.{kw.arg} built as {d} here but "
+                                f"{prev} in its canonical constructor",
+                                f"keep {cname}.{kw.arg} {prev} everywhere, or "
+                                "change the canonical constructor and every "
+                                "kernel that assumes it",
+                            )
+                        )
+
+    # 3. check all construction + .replace sites against the contract.
+    field_owner: dict[str, set[str]] = {}
+    for cname, (_, _, fields) in classes.items():
+        for fld in fields:
+            field_owner.setdefault(fld, set()).add(cname)
+
+    for f in files:
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call) or not node.keywords:
+                continue
+            kwnames = [kw.arg for kw in node.keywords if kw.arg]
+            if not kwnames:
+                continue
+            cands: set[str] = set()
+            if isinstance(node.func, ast.Name) and node.func.id in classes:
+                cands = {node.func.id}
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "replace"
+            ):
+                cands = {
+                    cname
+                    for cname, (_, _, fields) in classes.items()
+                    if all(k in fields for k in kwnames)
+                }
+            if not cands:
+                continue
+            for kw in node.keywords:
+                if not kw.arg:
+                    continue
+                d = infer_dtype(kw.value, engine, f)
+                if d is None:
+                    continue
+                expected = {
+                    contract[c][kw.arg]
+                    for c in cands
+                    if kw.arg in contract.get(c, {})
+                }
+                if not expected or d in expected:
+                    continue
+                # Skip the declaration sites already handled in pass 2.
+                cf = classes[next(iter(cands))][0]
+                if (
+                    len(cands) == 1
+                    and f is cf
+                    and isinstance(node.func, ast.Name)
+                ):
+                    continue
+                want = "/".join(sorted(expected))
+                out.append(
+                    _mk(
+                        f,
+                        kw.value,
+                        "R5",
+                        f"field '{kw.arg}' rebuilt as {d}, but its pytree "
+                        f"contract ({'/'.join(sorted(cands))}) declares {want}",
+                        f"cast to {want} (`.astype`) or update the dataclass "
+                        "contract and the kernels that assume it",
+                    )
+                )
+    return out
