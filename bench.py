@@ -31,8 +31,10 @@ one JSON line on stdout, no matter what the TPU tunnel does.
   prematurely over this box's tunneled-TPU transport (each output buffer's
   ready event completes independently).
 
-Usage: ``python bench.py`` (driver mode — one JSON line) or
-``python bench.py --child <engine> <n>`` (internal single-config worker).
+Usage: ``python bench.py`` (driver mode — one JSON line),
+``python bench.py --child <engine> <n>`` (internal single-config worker), or
+``python bench.py --telemetry [out.jsonl] [n]`` (flight-recorder run: counter
+totals + detection-latency histograms as schema-versioned JSONL + Prometheus).
 """
 
 from __future__ import annotations
@@ -132,6 +134,8 @@ def _measure_sparse(
         run_sparse_chunked,
     )
 
+    from scalecube_cluster_tpu.obs.profiling import trace_scope
+
     kw = {"slot_budget": slot_budget} if slot_budget else {}
     params = SparseParams.for_n(
         n_members, in_scan_writeback=False, pallas_core=pallas, **kw
@@ -145,11 +149,14 @@ def _measure_sparse(
     int(state.view_T[0, 0])
 
     t0 = time.perf_counter()
-    for _ in range(reps):
-        state, _ = run_sparse_chunked(
-            params, state, plan, chunk, chunk, collect=False
-        )
-        int(state.view_T[0, 0])
+    for rep in range(reps):
+        # Named scope so a jax.profiler capture attributes each chunk
+        # dispatch (no-op cost when no trace is being collected).
+        with trace_scope(f"bench/sparse_chunk_rep{rep}"):
+            state, _ = run_sparse_chunked(
+                params, state, plan, chunk, chunk, collect=False
+            )
+            int(state.view_T[0, 0])
     dt = time.perf_counter() - t0
     return n_members * (reps * chunk / dt)
 
@@ -175,6 +182,63 @@ def _measure(engine: str, n_members: int, slot_budget: int | None = None) -> dic
     if slot_budget:
         out["slot_budget"] = slot_budget
     return out
+
+
+def _telemetry(n_members: int = 4096, out: str = "telemetry.jsonl") -> None:
+    """Flight-recorder run: one collected sparse run exporting the full
+    counter timeline totals plus detection-latency histograms as
+    schema-versioned JSONL (obs/export.py), and a Prometheus snapshot
+    alongside (``<out>.prom``). This is the ``--telemetry`` mode — the
+    headline bench path keeps ``collect=False`` and pays nothing.
+    """
+    from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS, SIM_ONLY_COUNTERS
+    from scalecube_cluster_tpu.obs.export import (
+        append_jsonl,
+        make_row,
+        run_metadata,
+        write_prometheus,
+    )
+    from scalecube_cluster_tpu.obs.latency import (
+        detection_latencies,
+        latency_histogram,
+    )
+    from scalecube_cluster_tpu.obs.profiling import trace_scope
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+        kill_sparse,
+        run_sparse_chunked,
+    )
+
+    params = SparseParams.for_n(n_members, in_scan_writeback=False)
+    state = kill_sparse(
+        init_sparse_full_view(n_members, params.slot_budget, record_latency=True), 7
+    )
+    plan = FaultPlan.uniform(loss_percent=5.0)
+    ticks = 240
+    with trace_scope("bench/telemetry_run"):
+        state, traces = run_sparse_chunked(
+            params, state, plan, ticks, chunk=48, collect=True
+        )
+    meta = run_metadata(n=n_members, slot_budget=params.slot_budget, seed=0)
+    totals = {
+        k: int(traces[k].sum())
+        for k in SHARED_COUNTERS + SIM_ONLY_COUNTERS
+        if k in traces
+    }
+    rows = [make_row("counters", {**totals, "n_ticks": ticks}, meta)]
+    lat = detection_latencies(state, {7: 0})
+    for event, arr in (
+        ("first_suspect", lat["suspect_latency"]),
+        ("first_dead", lat["dead_latency"]),
+    ):
+        rows.append(
+            make_row("latency_histogram", {"event": event, **latency_histogram(arr)}, meta)
+        )
+    append_jsonl(out, rows)
+    write_prometheus(out + ".prom", rows)
+    print(json.dumps({"telemetry": out, "rows": len(rows), "ticks": ticks, "n": n_members}))
 
 
 def _probe_once() -> str | None:
@@ -311,7 +375,12 @@ def main() -> None:
         }
     else:
         result.update(_self_evidence())
-    print(json.dumps(result), flush=True)
+    # Schema-stamped export row (obs/export.py) — same single-JSON-line
+    # contract, now versioned and deterministic-ordered. The driver process
+    # never imports jax, so run_metadata's platform detection stays passive.
+    from scalecube_cluster_tpu.obs.export import jsonl_line, make_row, run_metadata
+
+    print(jsonl_line(make_row("bench", result, run_metadata())), flush=True)
 
 
 if __name__ == "__main__":
@@ -328,6 +397,11 @@ if __name__ == "__main__":
             pass
         s_arg = int(sys.argv[4]) if len(sys.argv) == 5 else 0
         print(json.dumps(_measure(sys.argv[2], int(sys.argv[3]), s_arg or None)))
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--telemetry":
+        _telemetry(
+            n_members=int(sys.argv[3]) if len(sys.argv) > 3 else 4096,
+            out=sys.argv[2] if len(sys.argv) > 2 else "telemetry.jsonl",
+        )
     else:
         os.environ.setdefault("JAX_TRACEBACK_FILTERING", "off")
         main()

@@ -33,6 +33,7 @@ from scalecube_cluster_tpu.cluster.payloads import SYSTEM_GOSSIPS, SYSTEM_MESSAG
 from scalecube_cluster_tpu.cluster_api.config import ClusterConfig
 from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
 from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.obs.counters import ProtocolCounters
 from scalecube_cluster_tpu.transport.api import MessageStream, Transport
 from scalecube_cluster_tpu.transport.message import Message
 from scalecube_cluster_tpu.transport.tcp import TcpTransport
@@ -68,15 +69,23 @@ class SenderAwareTransport(Transport):
     """Stamps the local address on every outgoing message
     (ClusterImpl.java:471-514)."""
 
-    def __init__(self, inner: Transport, sender: Address):
+    def __init__(
+        self,
+        inner: Transport,
+        sender: Address,
+        counters: ProtocolCounters | None = None,
+    ):
         self._inner = inner
         self._sender = sender
+        self._counters = counters
 
     @property
     def address(self) -> Address:
         return self._inner.address
 
     async def send(self, to: Address, message: Message) -> None:
+        if self._counters is not None:
+            self._counters.sent(message.qualifier or "")
         await self._inner.send(to, message.with_sender(self._sender))
 
     def listen(self) -> MessageStream:
@@ -97,6 +106,10 @@ class ClusterMonitor:
     suspected_members: tuple[Member, ...]
     removed_members: tuple[Member, ...]
     metadata: Any
+    # Protocol counter snapshot (obs/counters.py::SHARED_COUNTERS schema);
+    # None only for monitors built before the node's counters existed.
+    counters: dict[str, int] | None = None
+    sent_by_qualifier: dict[str, int] | None = None
 
 
 class Cluster:
@@ -123,6 +136,9 @@ class Cluster:
         self._gossip = gossip
         self._metadata = metadata_store
         self._membership = membership
+        self._counters: ProtocolCounters = getattr(
+            transport, "_counters", None
+        ) or ProtocolCounters()
         self._handler_tasks: list[asyncio.Task] = []
         self._shutdown_event = asyncio.Event()
         self._stopped = False
@@ -141,7 +157,11 @@ class Cluster:
         factory = transport_factory or _default_transport_factory
         transport = await factory(config)
         local_member = cls._create_local_member(config, transport.address)
-        transport = SenderAwareTransport(transport, local_member.address)
+        # One counter block per node, shared by the transport wrapper and
+        # every protocol — the JMX-MBean equivalent (ClusterImpl.java:434-469)
+        # on the obs/counters.py schema.
+        counters = ProtocolCounters()
+        transport = SenderAwareTransport(transport, local_member.address, counters)
         rng = random.Random(seed)
         # Epoch from the seed-driven rng: unique per run when unseeded (OS
         # entropy), reproducible correlation ids when a seed is given.
@@ -152,12 +172,14 @@ class Cluster:
             config.failure_detector_config,
             cid,
             rng=random.Random(rng.random()),
+            counters=counters,
         )
         gossip = GossipProtocol(
             transport,
             local_member,
             config.gossip_config,
             rng=random.Random(rng.random()),
+            counters=counters,
         )
         metadata = MetadataStore(
             transport, local_member, config.metadata, config.metadata_timeout, cid
@@ -171,6 +193,7 @@ class Cluster:
             metadata,
             cid,
             rng=random.Random(rng.random()),
+            counters=counters,
         )
         self = cls(config, transport, local_member, fd, gossip, metadata, membership)
         # Start order mirrors ClusterImpl.java:219-224: FD, gossip, metadata,
@@ -274,6 +297,11 @@ class Cluster:
 
     # -- introspection --------------------------------------------------------
 
+    @property
+    def counters(self) -> ProtocolCounters:
+        """This node's live protocol counter block (obs/counters.py)."""
+        return self._counters
+
     def monitor(self) -> ClusterMonitor:
         return ClusterMonitor(
             member=self._member,
@@ -282,6 +310,8 @@ class Cluster:
             suspected_members=tuple(self._membership.aliveness(MemberStatus.SUSPECT)),
             removed_members=tuple(self._membership.removed_history()),
             metadata=self._metadata.metadata(),
+            counters=self._counters.snapshot(),
+            sent_by_qualifier=self._counters.sent_by_qualifier(),
         )
 
     # -- shutdown (ClusterImpl.java:372-422) ----------------------------------

@@ -39,6 +39,7 @@ from scalecube_cluster_tpu.cluster.payloads import (
 from scalecube_cluster_tpu.cluster_api.config import FailureDetectorConfig
 from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
 from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.obs.counters import ProtocolCounters
 from scalecube_cluster_tpu.transport.api import Transport
 from scalecube_cluster_tpu.transport.message import Message
 from scalecube_cluster_tpu.utils.ids import CorrelationIdGenerator
@@ -65,11 +66,15 @@ class FailureDetector:
         config: FailureDetectorConfig,
         cid_generator: CorrelationIdGenerator,
         rng: random.Random | None = None,
+        counters: ProtocolCounters | None = None,
     ):
         self._transport = transport
         self._local = local_member
         self._config = config
         self._cid = cid_generator
+        # Shared per-node counter block (obs/counters.py); a private one when
+        # the protocol runs standalone (tests).
+        self._counters = counters or ProtocolCounters()
         self._rng = rng or random.Random()  # tpulint: disable=R3 -- host-backend reference-parity default; Cluster.start injects a seed-derived rng
         self._events: Multicast[FailureDetectorEvent] = Multicast()
         # Shuffled round-robin probe list (FailureDetectorImpl.java:55, 323-349).
@@ -139,10 +144,13 @@ class FailureDetector:
             data=PingData(issuer=self._local, target=target),
         )
         logger.debug("%s: ping[%d] -> %s", self._local, self._period, target)
+        self._counters.inc("pings")
+        self._counters.inc("msgs_fd")
         try:
             ack = await self._transport.request_response(
                 target.address, ping, timeout=self._config.ping_timeout / 1000.0
             )
+            self._counters.inc("acks")
             self._publish(target, _status_of_ack(ack))
         except (asyncio.TimeoutError, ConnectionError, OSError):
             await self._do_ping_req(target, cid)
@@ -159,6 +167,8 @@ class FailureDetector:
             correlation_id=cid,
             data=PingData(issuer=self._local, target=target),
         )
+        self._counters.inc("ping_reqs", len(relays))
+        self._counters.inc("msgs_fd", len(relays))
         stream = self._transport.listen()
         try:
             for relay in relays:
@@ -175,6 +185,7 @@ class FailureDetector:
                 raise asyncio.TimeoutError
 
             ack = await asyncio.wait_for(first_ack(), budget)
+            self._counters.inc("acks")
             self._publish(target, _status_of_ack(ack))
         except (asyncio.TimeoutError, ConnectionError, OSError):
             self._publish(target, MemberStatus.SUSPECT)

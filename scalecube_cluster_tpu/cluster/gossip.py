@@ -29,6 +29,7 @@ from dataclasses import dataclass, field
 from scalecube_cluster_tpu import cluster_math
 from scalecube_cluster_tpu.cluster.payloads import GOSSIP_REQ, Gossip, GossipRequest
 from scalecube_cluster_tpu.cluster_api.config import GossipConfig
+from scalecube_cluster_tpu.obs.counters import ProtocolCounters
 from scalecube_cluster_tpu.cluster_api.member import Member
 from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
 from scalecube_cluster_tpu.transport.api import Transport
@@ -58,10 +59,12 @@ class GossipProtocol:
         local_member: Member,
         config: GossipConfig,
         rng: random.Random | None = None,
+        counters: ProtocolCounters | None = None,
     ):
         self._transport = transport
         self._local = local_member
         self._config = config
+        self._counters = counters or ProtocolCounters()
         self._rng = rng or random.Random()  # tpulint: disable=R3 -- host-backend reference-parity default; Cluster.start injects a seed-derived rng
         self._period = 0
         self._sequence = itertools.count()
@@ -145,6 +148,9 @@ class GossipProtocol:
             for i in range(0, len(batch), limit):
                 request = GossipRequest(tuple(batch[i : i + limit]), self._local.id)
                 msg = Message.create(qualifier=GOSSIP_REQ, data=request)
+                # Counted at enqueue, like the sim's sender-side msgs_gossip
+                # (loss doesn't unsend).
+                self._counters.inc("msgs_gossip")
                 sends.append(self._send_one(peer.address, msg))
         # Concurrent fire-and-forget, like the reference's per-peer
         # transport.send subscriptions (GossipProtocolImpl.java:139-157): one
@@ -227,5 +233,6 @@ class GossipProtocol:
                 )
                 self._gossips[gossip.gossip_id] = state
                 # First sighting: deliver to listeners exactly once.
+                self._counters.inc("gossip_infections")
                 self._messages.publish(gossip.message)
             state.infected.add(request.from_member_id)

@@ -48,6 +48,7 @@ from scalecube_cluster_tpu.cluster.payloads import (
 from scalecube_cluster_tpu.cluster_api.config import ClusterConfig
 from scalecube_cluster_tpu.cluster_api.member import Member, MemberStatus
 from scalecube_cluster_tpu.cluster_api.membership_event import MembershipEvent
+from scalecube_cluster_tpu.obs.counters import ProtocolCounters
 from scalecube_cluster_tpu.cluster_api.membership_record import (
     MembershipRecord,
     is_overrides,
@@ -113,10 +114,12 @@ class MembershipProtocol:
         metadata_store: MetadataStore,
         cid_generator: CorrelationIdGenerator,
         rng: random.Random | None = None,
+        counters: ProtocolCounters | None = None,
     ):
         self._transport = transport
         self._local = local_member
         self._config = config
+        self._counters = counters or ProtocolCounters()
         self._membership_config = config.membership_config
         self._fd = failure_detector
         self._gossip = gossip
@@ -285,6 +288,7 @@ class MembershipProtocol:
         SYNC_ACK without a correlation id (:304-320). ValueError covers a
         table grown past max_frame_length — it must not kill the sync loop."""
         msg = Message.create(qualifier=SYNC, data=self._sync_data())
+        self._counters.inc("msgs_sync")
         try:
             await self._transport.send(address, msg)
         except (ConnectionError, OSError):
@@ -332,6 +336,7 @@ class MembershipProtocol:
             correlation_id=msg.correlation_id,
             data=self._sync_data(),
         )
+        self._counters.inc("msgs_sync")
         with contextlib.suppress(ConnectionError, OSError):
             await self._transport.send(msg.sender, ack)
 
@@ -433,6 +438,7 @@ class MembershipProtocol:
         self, r1: MembershipRecord, reason: UpdateReason
     ) -> None:
         """Remove a dead member and emit REMOVED (:571-587)."""
+        self._counters.inc("verdicts_dead")
         self._cancel_suspicion(r1.member.id)
         # ADVICE r3 item 4: a strictly-higher-incarnation refutation fetch
         # (ALIVE@N+1) in flight survives a lower-incarnation DEAD — when it
@@ -461,6 +467,8 @@ class MembershipProtocol:
         if reason not in _NO_REGOSSIP:
             self._spread_membership_gossip(r1)
         if r1.member.id not in self._suspicion_tasks:
+            # Newly suspected (repeat SUSPECT records re-arm nothing).
+            self._counters.inc("suspicions_raised")
             timeout_ms = cluster_math.suspicion_timeout(
                 self._membership_config.suspicion_mult,
                 max(len(self._members), 1),
@@ -558,8 +566,14 @@ class MembershipProtocol:
         # Suspicion is deliberately NOT cancelled before this point: an
         # unreachable member's refutation must not clear suspicion, so the
         # cancel is gated on the fetch proving reachability (:534-541).
-        if not is_overrides(r1, self._table.get(member.id)):
+        prev = self._table.get(member.id)
+        if not is_overrides(r1, prev):
             return
+        if prev is not None and not prev.is_alive:
+            # A known SUSPECT/DEAD record flipping back to ALIVE — the
+            # host-backend twin of the sim engines' verdicts_alive
+            # transition counter (incarnation refutation / recovery).
+            self._counters.inc("verdicts_alive")
         self._cancel_suspicion(member.id)
         self._table[member.id] = r1
         if reason not in _NO_REGOSSIP:

@@ -1,11 +1,13 @@
 """``python -m scalecube_cluster_tpu.experiments [small|large] [--out FILE]``.
 
 Runs the BASELINE scenario grid (scenarios.py) and prints one JSON line per
-scenario; ``--out`` additionally appends the lines to FILE so a TPU run's
-results can be committed verbatim (VERDICT round-1 item 10).
+scenario; ``--out`` additionally appends the rows to FILE as schema-versioned
+JSONL (obs/export.py: stamped with commit/platform and deterministically
+ordered) so a TPU run's results can be committed verbatim (VERDICT round-1
+item 10). ``--prom FILE`` also writes the rows as a Prometheus text-format
+snapshot for scrape-style consumption.
 """
 
-import json
 import sys
 
 args = [a for a in sys.argv[1:]]
@@ -18,17 +20,33 @@ if "--cpu" in args:
     jax.config.update("jax_platforms", "cpu")
 
 from scalecube_cluster_tpu.experiments.scenarios import run_all
+from scalecube_cluster_tpu.obs.export import (
+    append_jsonl,
+    make_row,
+    run_metadata,
+    write_prometheus,
+)
 
-out = None
-if "--out" in args:
-    i = args.index("--out")
+
+def _path_opt(flag: str) -> str | None:
+    if flag not in args:
+        return None
+    i = args.index(flag)
     if i + 1 >= len(args):
-        sys.exit("usage: ... [small|large] [--out FILE]  (--out needs a path)")
-    out = args[i + 1]
+        sys.exit(f"usage: ... [small|large] [--out FILE] [--prom FILE]  ({flag} needs a path)")
+    path = args[i + 1]
     del args[i : i + 2]
+    return path
+
+
+out = _path_opt("--out")
+prom = _path_opt("--prom")
 
 results = run_all(args[0] if args else "small")
-if out:
-    with open(out, "a") as fh:
-        for r in results:
-            fh.write(json.dumps(r) + "\n")
+if out or prom:
+    meta = run_metadata()
+    rows = [make_row("experiment", r, meta) for r in results]
+    if out:
+        append_jsonl(out, rows)
+    if prom:
+        write_prometheus(prom, rows)

@@ -1,0 +1,47 @@
+"""Observability layer: protocol counters, verdict-latency tracking, and a
+unified metrics export pipeline.
+
+The reference exposes per-node JMX MBeans (ClusterImpl.java:434-469) and
+per-period protocol statistics; here the same numbers come out of three
+coordinated surfaces:
+
+- the sim engines' in-scan metric traces (``sim_tick`` / ``sparse_tick`` with
+  ``collect=True``) — the on-device flight recorder,
+- the host backend's :class:`~scalecube_cluster_tpu.obs.counters.ProtocolCounters`
+  (shared by failure detector, gossip, membership and the transport), and
+- :mod:`scalecube_cluster_tpu.obs.export` — one schema-versioned writer for
+  JSONL rows and Prometheus text format, adopted by bench.py, experiments and
+  the churn tools.
+
+Because both backends register the *same* counter names
+(:data:`~scalecube_cluster_tpu.obs.counters.SHARED_COUNTERS`), the metrics
+double as a cross-backend correctness oracle (testlib/crossval.py).
+"""
+
+from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS, ProtocolCounters
+from scalecube_cluster_tpu.obs.export import (
+    SCHEMA_VERSION,
+    append_jsonl,
+    jsonl_line,
+    make_row,
+    prometheus_text,
+    run_metadata,
+    write_prometheus,
+)
+from scalecube_cluster_tpu.obs.latency import detection_latencies, latency_histogram
+from scalecube_cluster_tpu.obs.profiling import trace_scope
+
+__all__ = [
+    "SCHEMA_VERSION",
+    "SHARED_COUNTERS",
+    "ProtocolCounters",
+    "append_jsonl",
+    "detection_latencies",
+    "jsonl_line",
+    "latency_histogram",
+    "make_row",
+    "prometheus_text",
+    "run_metadata",
+    "trace_scope",
+    "write_prometheus",
+]

@@ -1,0 +1,93 @@
+"""Protocol counter registry shared by the host backend and the sim engines.
+
+``SHARED_COUNTERS`` is the schema: every name here is emitted by the sparse
+engine's in-scan metrics dict (sim/sparse.py, ``collect=True``), by the dense
+engine where the event exists there, and by the asyncio host backend via
+:class:`ProtocolCounters`. testlib/crossval.py cross-validates the two
+backends on this key set, so adding a counter means adding it to *both*
+backends (or documenting the asymmetry in ``SIM_ONLY_COUNTERS``).
+"""
+
+from __future__ import annotations
+
+# Counters every backend reports. Semantics (host backend <-> sim engines):
+#   pings              direct PING issued (FailureDetectorImpl PING round)
+#   ping_reqs          indirect PING_REQ relays issued
+#   acks               ack responses received by the prober
+#   suspicions_raised  member table cells transitioning into SUSPECT
+#   verdicts_dead      cells transitioning into DEAD (suspicion expiry)
+#   verdicts_alive     previously-known cells transitioning back to ALIVE
+#                      (incarnation refutation / recovery)
+#   gossip_infections  first sighting of a gossip rumor at a node
+#   msgs_fd            FD wire messages sent (pings + relayed ping-reqs)
+#   msgs_sync          SYNC / SYNC_ACK messages sent
+#   msgs_gossip        gossip protocol messages sent
+SHARED_COUNTERS: tuple[str, ...] = (
+    "pings",
+    "ping_reqs",
+    "acks",
+    "suspicions_raised",
+    "verdicts_dead",
+    "verdicts_alive",
+    "gossip_infections",
+    "msgs_fd",
+    "msgs_sync",
+    "msgs_gossip",
+)
+
+# Emitted by the sparse engine only — they measure the compact working-set
+# machinery, which has no host-backend analog (a dict has no slots).
+SIM_ONLY_COUNTERS: tuple[str, ...] = (
+    "slot_activations",
+    "slot_frees",
+    "slot_overflow",
+    "sync_window_accepts",
+)
+
+
+class ProtocolCounters:
+    """Mutable counter block for one host-backend node.
+
+    One instance is created per :class:`~scalecube_cluster_tpu.cluster.cluster.Cluster`
+    and shared by its failure detector, gossip and membership protocols plus
+    the transport wrapper — the moral equivalent of the reference's per-node
+    MBean. Plain ints on the asyncio loop; no locking needed.
+    """
+
+    __slots__ = ("_counts", "_sent_by_qualifier")
+
+    def __init__(self) -> None:
+        self._counts: dict[str, int] = {k: 0 for k in SHARED_COUNTERS}
+        self._sent_by_qualifier: dict[str, int] = {}
+
+    def inc(self, name: str, delta: int = 1) -> None:
+        if name not in self._counts:
+            # Strict: a typo'd name would silently widen the snapshot key
+            # set and break the crossval schema check (testlib/crossval.py).
+            raise KeyError(f"unknown counter {name!r}; add it to SHARED_COUNTERS")
+        self._counts[name] += delta
+
+    def sent(self, qualifier: str) -> None:
+        """Record one outbound transport message by qualifier."""
+        self._sent_by_qualifier[qualifier] = self._sent_by_qualifier.get(qualifier, 0) + 1
+
+    def snapshot(self) -> dict[str, int]:
+        """Copy of the shared counters (stable key set)."""
+        return dict(self._counts)
+
+    def sent_by_qualifier(self) -> dict[str, int]:
+        return dict(self._sent_by_qualifier)
+
+
+def sum_counters(snapshots: list[dict[str, int]]) -> dict[str, int]:
+    """Aggregate per-node snapshots into cluster totals."""
+    total: dict[str, int] = {k: 0 for k in SHARED_COUNTERS}
+    for snap in snapshots:
+        for k, v in snap.items():
+            total[k] = total.get(k, 0) + v
+    return total
+
+
+def diff_counters(after: dict[str, int], before: dict[str, int]) -> dict[str, int]:
+    """Per-key ``after - before`` (keys from ``after``)."""
+    return {k: v - before.get(k, 0) for k, v in after.items()}

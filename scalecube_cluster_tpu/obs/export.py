@@ -1,0 +1,141 @@
+"""Schema-versioned metrics export: JSONL rows + Prometheus text format.
+
+Every artifact row the repo emits (bench.py results, experiment scenario
+rows, churn-tool measurements) goes through :func:`make_row`, which stamps a
+``schema`` version and a ``kind`` tag and merges run metadata (commit, n, S,
+seed, platform). Serialization is deterministic (``sort_keys=True``) so the
+golden-file test in tests/test_obs.py pins the wire format — bump
+``SCHEMA_VERSION`` when a breaking change to row shape is intended.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+SCHEMA_VERSION = 1
+
+# Row keys reserved by the exporter itself; payloads may not override them.
+_RESERVED = ("schema", "kind")
+
+
+def run_metadata(
+    n: int | None = None,
+    slot_budget: int | None = None,
+    seed: int | None = None,
+    platform: str | None = None,
+    commit: str | None = None,
+) -> dict:
+    """Identifying metadata stamped onto every exported row.
+
+    ``platform`` is only auto-detected when jax is *already imported* — the
+    bench driver process must never initialize a backend (its children own
+    the accelerator), so detection here is passive.
+    """
+    if commit is None:
+        try:
+            commit = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                capture_output=True,
+                text=True,
+                timeout=10,
+            ).stdout.strip() or "unknown"
+        except Exception:
+            commit = "unknown"
+    if platform is None:
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                platform = jax_mod.default_backend()
+            except Exception:
+                platform = "unknown"
+        else:
+            platform = "unknown"
+    meta: dict = {"commit": commit, "platform": platform}
+    if n is not None:
+        meta["n"] = int(n)
+    if slot_budget is not None:
+        meta["slot_budget"] = int(slot_budget)
+    if seed is not None:
+        meta["seed"] = int(seed)
+    return meta
+
+
+def make_row(kind: str, payload: dict, meta: dict | None = None) -> dict:
+    """One export row: ``{"schema": 1, "kind": kind, **meta, **payload}``.
+
+    Payload keys win over metadata keys (a scenario that measured its own
+    ``n`` keeps it), but neither may shadow the reserved schema keys.
+    """
+    for k in _RESERVED:
+        if k in payload or (meta and k in meta):
+            raise ValueError(f"payload/meta may not set reserved key {k!r}")
+    row: dict = {"schema": SCHEMA_VERSION, "kind": kind}
+    if meta:
+        row.update(meta)
+    row.update(payload)
+    return row
+
+
+def jsonl_line(row: dict) -> str:
+    """Deterministic single-line serialization (golden-file stable)."""
+    return json.dumps(row, sort_keys=True, separators=(", ", ": "))
+
+
+def append_jsonl(path: str, rows: list[dict]) -> None:
+    with open(path, "a") as fh:
+        for row in rows:
+            fh.write(jsonl_line(row) + "\n")
+
+
+def _prom_escape(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _metric_name(prefix: str, kind: str, field: str) -> str:
+    name = f"{prefix}_{kind}_{field}"
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    if out and out[0].isdigit():
+        out.insert(0, "_")
+    return "".join(out)
+
+
+def prometheus_text(rows: list[dict], prefix: str = "scalecube") -> str:
+    """Render rows in the Prometheus text exposition format.
+
+    String-valued fields become labels; numeric scalars become gauge samples
+    named ``<prefix>_<kind>_<field>``. Non-scalar fields (lists, dicts) are
+    JSONL-only and skipped here. Output is sorted for determinism.
+    """
+    lines: list[str] = []
+    seen_help: set[str] = set()
+    for row in rows:
+        kind = str(row.get("kind", "row"))
+        labels = {
+            k: str(v)
+            for k, v in row.items()
+            if isinstance(v, str) and k != "kind"
+        }
+        label_str = ",".join(
+            f'{k}="{_prom_escape(v)}"' for k, v in sorted(labels.items())
+        )
+        for field, value in sorted(row.items()):
+            if field in _RESERVED or isinstance(value, (str, bool)):
+                continue
+            if not isinstance(value, (int, float)) or value != value:  # skip NaN
+                continue
+            name = _metric_name(prefix, kind, field)
+            if name not in seen_help:
+                lines.append(f"# TYPE {name} gauge")
+                seen_help.add(name)
+            sample = f"{name}{{{label_str}}}" if label_str else name
+            lines.append(f"{sample} {value}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def write_prometheus(path: str, rows: list[dict], prefix: str = "scalecube") -> None:
+    with open(path, "w") as fh:
+        fh.write(prometheus_text(rows, prefix=prefix))

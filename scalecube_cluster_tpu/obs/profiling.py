@@ -1,0 +1,24 @@
+"""Optional jax.profiler named-scope annotations.
+
+``trace_scope`` wraps host-side dispatch loops (bench.py chunk dispatch) in a
+``jax.profiler.TraceAnnotation`` so Perfetto traces attribute wall time to
+protocol phases. Degrades to a no-op when the profiler is unavailable and
+never imports jax in a process that hasn't already (the bench driver must
+not initialize a backend).
+"""
+
+from __future__ import annotations
+
+import sys
+from contextlib import nullcontext
+
+
+def trace_scope(name: str):
+    """Context manager: profiler named scope when jax is live, else no-op."""
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is None:
+        return nullcontext()
+    try:
+        return jax_mod.profiler.TraceAnnotation(name)
+    except Exception:
+        return nullcontext()

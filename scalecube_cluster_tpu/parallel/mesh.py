@@ -101,8 +101,13 @@ def shard_plan(plan: FaultPlan, mesh: Mesh) -> FaultPlan:
     return jax.device_put(plan, FaultPlan(block=row, loss=row, mean_delay=row))
 
 
-def sparse_state_shardings(mesh: Mesh):
+def sparse_state_shardings(mesh: Mesh, like=None):
     """A SparseState-shaped pytree of NamedShardings (sim/sparse.py).
+
+    ``like`` (a SparseState) selects the pytree STRUCTURE: when it carries
+    the verdict-latency recorder arrays (init_sparse_full_view
+    ``record_latency=True``), the shardings carry matching member-vector
+    entries — a structure mismatch would fail device_put.
 
     The viewer axis shards across ``"members"``: ``view_T`` is subject-major
     ``[N_subj, N_view]`` so each device holds all subjects × its viewers —
@@ -143,9 +148,15 @@ def sparse_state_shardings(mesh: Mesh):
         uptr=slabrow,
         tick=rep,
         rng=rep,
+        lat_first_suspect=(
+            vec if like is not None and like.lat_first_suspect is not None else None
+        ),
+        lat_first_dead=(
+            vec if like is not None and like.lat_first_dead is not None else None
+        ),
     )
 
 
 def shard_sparse_state(state, mesh: Mesh):
     """Place a host-built SparseState onto the mesh."""
-    return jax.device_put(state, sparse_state_shardings(mesh))
+    return jax.device_put(state, sparse_state_shardings(mesh, like=state))

@@ -103,6 +103,9 @@ def save_sparse_checkpoint(path: str | Path, state, params) -> None:
     arrays = {
         f.name: np.asarray(jax.device_get(getattr(state, f.name)))
         for f in dataclasses.fields(SparseState)
+        # Optional fields (verdict-latency recorder) may be None — absent
+        # from the archive; load_sparse_checkpoint's defaults restore None.
+        if getattr(state, f.name) is not None
     }
     arrays[_SPARSE_MAGIC] = np.frombuffer(
         json.dumps(dataclasses.asdict(params)).encode(), dtype=np.uint8

@@ -102,7 +102,9 @@ def sparse_summary(state) -> dict:
         "max_incarnation": state.inc_self.max(),
         "max_epoch": state.epoch.max(),
     }
-    out = {k: int(jax.device_get(v)) for k, v in summary.items()}
+    # One batched transfer for the whole dict — per-metric device_get would
+    # issue a blocking round-trip per key.
+    out = {k: int(v) for k, v in jax.device_get(summary).items()}
     out["n"] = int(state.alive.size)
     out["slot_budget"] = int(state.slot_subj.size)
     return out

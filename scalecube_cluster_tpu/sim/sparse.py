@@ -278,6 +278,12 @@ class SparseState:
     uptr: jax.Array  # [N, G] int32 — ring write cursor
     tick: jax.Array  # [] int32
     rng: jax.Array
+    # Verdict-latency recorder (obs/latency.py): first tick any LIVE viewer's
+    # working set held a SUSPECT / DEAD record for each subject, -1 = never.
+    # None (the default) is an empty pytree node, so presence is static by
+    # pytree structure — the bench path compiles the exact same hot loop.
+    lat_first_suspect: jax.Array | None = None  # [N] int32
+    lat_first_dead: jax.Array | None = None  # [N] int32
 
     def replace(self, **changes) -> "SparseState":
         return dataclasses.replace(self, **changes)
@@ -289,12 +295,17 @@ def init_sparse_full_view(
     seed: int = 0,
     user_gossip_slots: int = 4,
     infected_k: int = 16,
+    record_latency: bool = False,
 ) -> SparseState:
     """Post-join steady state, nothing active: the common 100k starting point.
 
     ``infected_k`` sizes the user-gossip last-k-senders suppression ring
     (sim/usergossip.py::user_gossip_step_tracked); 0 selects the untracked
     lifecycle (the tick gates on this static shape).
+
+    ``record_latency=True`` attaches the per-member first-suspect/first-dead
+    tick arrays (detection-latency histograms from one run, obs/latency.py);
+    off by default so the bench state carries nothing extra.
     """
     return SparseState(
         view_T=jnp.full((n, n), encode_key(0, 0), jnp.int32),
@@ -312,6 +323,12 @@ def init_sparse_full_view(
         uptr=jnp.zeros((n, user_gossip_slots), jnp.int32),
         tick=jnp.zeros((), jnp.int32),
         rng=jax.random.PRNGKey(seed),
+        lat_first_suspect=(
+            jnp.full((n,), -1, jnp.int32) if record_latency else None
+        ),
+        lat_first_dead=(
+            jnp.full((n,), -1, jnp.int32) if record_latency else None
+        ),
     )
 
 
@@ -447,6 +464,13 @@ def restart_many_sparse(state: SparseState, idxs) -> SparseState:
         ).at[ii].set(-1),
         uptr=state.uptr.at[ii].set(0),
     )
+    if state.lat_first_suspect is not None:
+        # Fresh identity, fresh detection clock: the recorder entries from
+        # the previous life would otherwise masquerade as instant detection.
+        state = state.replace(
+            lat_first_suspect=state.lat_first_suspect.at[ii].set(-1),
+            lat_first_dead=state.lat_first_dead.at[ii].set(-1),
+        )
 
     # 2. Slot allocation (host bookkeeping on the tiny tables), split into
     # already-active subjects vs fresh activations.
@@ -606,20 +630,30 @@ def sparse_tick(
             decode_epoch(vkey),
         )
         fire = ((probing & ~reached) | gone) & overrides_same_epoch(fd_key, vkey)
-        msgs = jnp.sum(probing) + jnp.sum((probing & ~direct)[:, None] & rvalid)
-        return tgt, fd_key, fire, msgs
+        n_pings = jnp.sum(probing)
+        n_ping_reqs = jnp.sum((probing & ~direct)[:, None] & rvalid)
+        msgs = n_pings + n_ping_reqs
+        out = (tgt, fd_key, fire, msgs)
+        if collect:
+            # Flight-recorder extras ride the same cond; gated at trace time
+            # on the STATIC collect flag so the bench graph is unchanged.
+            out = out + (n_pings, n_ping_reqs, jnp.sum(reached))
+        return out
 
     def fd_skip_phase(_):
-        return (
+        out = (
             jnp.zeros((n,), jnp.int32),
             jnp.zeros((n,), jnp.int32),
             jnp.zeros((n,), bool),
             jnp.asarray(0, jnp.int32),
         )
+        if collect:
+            zero = jnp.asarray(0, jnp.int32)
+            out = out + (zero, zero, zero)
+        return out
 
-    fd_tgt, fd_key, fd_fire, msgs_fd = lax.cond(
-        do_fd, fd_fire_phase, fd_skip_phase, None
-    )
+    fd_out = lax.cond(do_fd, fd_fire_phase, fd_skip_phase, None)
+    fd_tgt, fd_key, fd_fire, msgs_fd = fd_out[:4]
 
     # ------------------------------------- 2. own-record SYNC (cond-gated)
     # Partner uniform-random; exchange own records both directions
@@ -728,6 +762,7 @@ def sparse_tick(
         view_T = state.view_T
         slot_subj = state.slot_subj
         subj_slot = state.subj_slot
+        freeing = None  # frees happen in writeback_free, invisible per tick
 
     # Activation requests: FD-fired targets + SYNC-learned subjects.
     req = jnp.zeros((n,), bool)
@@ -1014,6 +1049,26 @@ def sparse_tick(
         )
         uinf_ids, uptr = state.uinf_ids, state.uptr
 
+    # ------------------------- 9. verdict-latency recorder (structure-gated)
+    # Presence of the lat arrays is part of the pytree STRUCTURE, so the
+    # default (None) state compiles the identical hot loop. Each subject's
+    # first-suspect / first-dead tick is captured while its slot is live —
+    # the pin rule guarantees residency through detection, so write-back
+    # can never lose an event.
+    lat_s, lat_d = state.lat_first_suspect, state.lat_first_dead
+    if lat_s is not None:
+        live_rows = alive[:, None]
+        seen_s = jnp.any(is_suspect_key(slab2) & live_rows, axis=0)
+        seen_d = jnp.any(
+            ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0) & live_rows, axis=0
+        )
+        subj_safe = jnp.clip(slot_subj, 0, n - 1)
+        first_s = seen_s & (slot_subj >= 0) & (lat_s[subj_safe] < 0)
+        first_d = seen_d & (slot_subj >= 0) & (lat_d[subj_safe] < 0)
+        # Active subjects are distinct across slots; non-events route OOB.
+        lat_s = lat_s.at[jnp.where(first_s, slot_subj, n)].set(t, mode="drop")
+        lat_d = lat_d.at[jnp.where(first_d, slot_subj, n)].set(t, mode="drop")
+
     new_state = state.replace(
         view_T=view_T,
         slot_subj=slot_subj,
@@ -1028,6 +1083,8 @@ def sparse_tick(
         uptr=uptr,
         tick=t,
         rng=rng_next,
+        lat_first_suspect=lat_s,
+        lat_first_dead=lat_d,
     )
     if not collect:
         return new_state, {"tick": t}
@@ -1036,6 +1093,15 @@ def sparse_tick(
     sender_active = jnp.any(
         (age_in < p.periods_to_spread) & active[None, :] & (slab >= 0), axis=1
     )
+    # Status-transition counters compare the post-load snapshot (slab0)
+    # against the final slab: transitions INTO a status only, so tombstone
+    # demotion timing (write-back here vs in-tick sweep in the dense
+    # engine) cannot skew cross-engine parity. Newly loaded slots baseline
+    # at their stale view_T record, matching the dense cell's history.
+    fd_pings, fd_ping_reqs, fd_acks = fd_out[4:]
+    viewer_live = alive[:, None] & active[None, :]
+    was_dead = ((slab0 & DEAD_BIT) != 0) & (slab0 >= 0)
+    now_dead = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
     metrics = {
         "tick": t,
         "n_active_slots": jnp.sum(slot_subj >= 0),
@@ -1054,6 +1120,26 @@ def sparse_tick(
         "msgs_user": msgs_user,
         "gossip_coverage": jnp.sum(new_seen & alive[:, None], axis=0)
         / jnp.maximum(jnp.sum(alive), 1),
+        # Flight recorder: full protocol counters (obs/counters.py schema).
+        "pings": fd_pings,
+        "ping_reqs": fd_ping_reqs,
+        "acks": fd_acks,
+        "suspicions_raised": jnp.sum(
+            is_susp2 & ~is_suspect_key(slab0) & viewer_live
+        ),
+        "verdicts_dead": jnp.sum(now_dead & ~was_dead & viewer_live),
+        "verdicts_alive": jnp.sum(
+            is_alive_key(slab2)
+            & ~is_alive_key(slab0)
+            & (slab0 >= 0)
+            & viewer_live
+        ),
+        "gossip_infections": jnp.sum(new_seen & ~state.useen),
+        "slot_activations": n_granted,
+        "slot_frees": (
+            jnp.sum(freeing) if freeing is not None else jnp.asarray(0, jnp.int32)
+        ),
+        "sync_window_accepts": jnp.sum(win_accept),
     }
     return new_state, metrics
 
@@ -1117,23 +1203,44 @@ def run_sparse_chunked(
 
     The big-n driver: build ``params`` with ``in_scan_writeback=False`` so
     the scan holds a single view_T buffer, then frees amortize to once per
-    ``chunk`` ticks. Returns ``(state, last_chunk_traces)``.
+    ``chunk`` ticks. Returns ``(state, traces)`` where traces accumulate
+    across ALL chunks as host (numpy) arrays with leading axis ``n_ticks``
+    — one collected run yields the full protocol-counter timeline. With
+    ``collect=False`` traces are ``{}`` (nothing leaves the device).
 
     The loop only ever passes ``chunk`` at the static tick-count position;
     a ragged remainder runs as one fixed-size tail call after the loop, so
     a call compiles at most two scan variants (chunk and tail) instead of
     re-specializing on a shrinking ``n_ticks - done``.
+
+    Host transfer happens only here, at chunk boundaries (the per-tick
+    reductions all run on device) — the tpulint-R2 contract.
     """
     if params.in_scan_writeback:
         raise ValueError("use in_scan_writeback=False with the chunked runner")
     whole, tail = divmod(n_ticks, chunk)
-    traces = {}
+    pieces = []
+
+    def grab(tr):
+        pieces.append(
+            jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a)), tr)
+        )
+
     for _ in range(whole):
-        state, traces = run_sparse_ticks(params, state, plan, chunk, collect=collect)
+        state, tr = run_sparse_ticks(params, state, plan, chunk, collect=collect)
         state = writeback_free(params, state)
+        if collect:
+            grab(tr)
     if tail:
-        state, traces = run_sparse_ticks(params, state, plan, tail, collect=collect)
+        state, tr = run_sparse_ticks(params, state, plan, tail, collect=collect)
         state = writeback_free(params, state)
+        if collect:
+            grab(tr)
+    if not pieces:
+        return state, {}
+    traces = jax.tree_util.tree_map(
+        lambda *xs: np.concatenate(xs, axis=0), *pieces
+    )
     return state, traces
 
 

@@ -652,6 +652,16 @@ def sim_tick(
         jnp.sum(sender_active[inv_perm[c]] & alive[inv_perm[c]] & nonself[c])
         for c in range(params.gossip_fanout)
     )
+    # Status-transition counters (flight-recorder schema, obs/counters.py):
+    # transitions INTO a status between the pre-tick table and the final
+    # one. Counting entries only (not DEAD->UNKNOWN demotion) keeps the
+    # numbers comparable with the sparse engine, whose tombstone demotion
+    # happens at write-back time instead of inside the sweep.
+    view0 = state.view
+    is_susp0 = ((view0 & 1) != 0) & ((view0 & DEAD_BIT) == 0) & (view0 >= 0)
+    was_dead = ((view0 & DEAD_BIT) != 0) & (view0 >= 0)
+    now_dead = ((view2 & DEAD_BIT) != 0) & (view2 >= 0)
+    viewer_live = alive[:, None]
     metrics = {
         "tick": t,
         "convergence": convergence,
@@ -663,5 +673,11 @@ def sim_tick(
         "msgs_sync": msgs_sync,
         "gossip_coverage": jnp.sum(new_seen & alive[:, None], axis=0)
         / jnp.maximum(n_alive, 1),
+        "suspicions_raised": jnp.sum(is_susp2 & ~is_susp0 & viewer_live),
+        "verdicts_dead": jnp.sum(now_dead & ~was_dead & viewer_live),
+        "verdicts_alive": jnp.sum(
+            is_alive_key(view2) & ~is_alive_key(view0) & (view0 >= 0) & viewer_live
+        ),
+        "gossip_infections": jnp.sum(new_seen & ~state.useen),
     }
     return new_state, metrics

@@ -395,3 +395,111 @@ async def compare_gossip_mesh(
         "sim_sends": sim_sends,
         "sends_ratio": sim_sends / host_sends_mean if host_sends_mean else np.inf,
     }
+
+
+# ---------------------------------------------------------------------------
+# Protocol-counter cross-validation (the flight-recorder oracle).
+#
+# Both backends register the same counter names (obs/counters.py::
+# SHARED_COUNTERS): the host backend on per-node ProtocolCounters blocks,
+# the sim engines in their collect=True metric traces. Running the same
+# steady-state scenario on both and comparing the counters turns the
+# metrics themselves into a correctness check — a counter that drifts
+# between backends is either a protocol divergence or a broken probe.
+# ---------------------------------------------------------------------------
+
+
+async def host_protocol_counters(
+    n: int, fd_rounds: int, emulator_seed: int = 23
+) -> dict:
+    """Steady-state counter deltas over ``fd_rounds`` FD periods of a healthy
+    ``n``-node loopback cluster: ``{"counters": totals, "fd_periods": k}``.
+
+    Join-phase traffic is excluded by snapshotting after full membership;
+    ``fd_periods`` is the actual number of probe rounds the cluster ran in
+    the window (wall-clock sleeps are jittery; counting periods makes the
+    per-round rates exact).
+    """
+    from scalecube_cluster_tpu.obs.counters import diff_counters, sum_counters
+
+    cfg = fast_test_config()
+    interval_s = cfg.failure_detector_config.ping_interval / 1000.0
+    seed = await start_node(cfg)
+    others = []
+    for i in range(n - 1):
+        others.append(
+            await start_node(
+                cfg, seeds=(seed.address,), emulator_seed=emulator_seed + i
+            )
+        )
+    nodes = [seed, *others]
+    try:
+        await await_until(
+            lambda: all(len(c.members()) == n for c in nodes), timeout=20.0
+        )
+        # Let in-flight join probes settle before the measurement window.
+        await asyncio.sleep(interval_s)
+        base = sum_counters([c.counters.snapshot() for c in nodes])
+        periods0 = sum(c._fd.period for c in nodes)
+        await asyncio.sleep(fd_rounds * interval_s)
+        after = sum_counters([c.counters.snapshot() for c in nodes])
+        periods1 = sum(c._fd.period for c in nodes)
+        return {
+            "counters": diff_counters(after, base),
+            "fd_periods": periods1 - periods0,
+        }
+    finally:
+        await shutdown_all(*nodes)
+
+
+def sim_protocol_counters(n: int, fd_rounds: int, seed: int = 0) -> dict:
+    """Sim twin of :func:`host_protocol_counters`: the sparse engine's
+    flight-recorder totals over ``fd_rounds`` FD periods of a healthy
+    cluster (clean plan). ``fd_periods`` is ``n * fd_rounds`` — every node
+    probes each round."""
+    from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS
+    from scalecube_cluster_tpu.sim import FaultPlan, SimParams
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+        run_sparse_chunked,
+    )
+
+    base = SimParams.from_cluster_config(n, fast_test_config())
+    params = SparseParams(
+        base=base, slot_budget=max(64, 2 * n), in_scan_writeback=False
+    )
+    state = init_sparse_full_view(n, params.slot_budget, seed=seed)
+    ticks = fd_rounds * base.fd_period_ticks
+    _, traces = run_sparse_chunked(
+        params, state, FaultPlan.uniform(), ticks, chunk=max(ticks, 1)
+    )
+    totals = {
+        k: int(np.sum(traces[k])) for k in SHARED_COUNTERS if k in traces
+    }
+    return {"counters": totals, "fd_periods": n * fd_rounds}
+
+
+async def compare_protocol_counters(n: int = 8, fd_rounds: int = 6) -> dict:
+    """Run the steady-state scenario on both backends; return the counter
+    totals plus per-FD-period rates for assertion."""
+    from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS
+
+    host = await host_protocol_counters(n, fd_rounds)
+    sim = sim_protocol_counters(n, fd_rounds)
+
+    def rate(block, key):
+        periods = max(block["fd_periods"], 1)
+        return block["counters"].get(key, 0) / periods
+
+    return {
+        "host": host,
+        "sim": sim,
+        "schema_keys": tuple(SHARED_COUNTERS),
+        "host_keys_ok": set(host["counters"]) == set(SHARED_COUNTERS),
+        "sim_keys_ok": set(sim["counters"]) == set(SHARED_COUNTERS),
+        "host_ping_rate": rate(host, "pings"),
+        "sim_ping_rate": rate(sim, "pings"),
+        "host_ack_rate": rate(host, "acks"),
+        "sim_ack_rate": rate(sim, "acks"),
+    }

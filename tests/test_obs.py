@@ -1,0 +1,205 @@
+"""Observability package tests (obs/): counters, exporter, latency helpers.
+
+The golden-file test pins the JSONL wire format byte-for-byte
+(tests/golden/obs_schema_golden.jsonl): any change to row shape, key order,
+or separator style fails here, forcing a deliberate SCHEMA_VERSION bump.
+Regenerate the golden file with::
+
+    python -m tests.test_obs --write-golden
+"""
+
+import json
+import os
+import types
+
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.obs.counters import (
+    SHARED_COUNTERS,
+    SIM_ONLY_COUNTERS,
+    ProtocolCounters,
+    diff_counters,
+    sum_counters,
+)
+from scalecube_cluster_tpu.obs.export import (
+    SCHEMA_VERSION,
+    append_jsonl,
+    jsonl_line,
+    make_row,
+    prometheus_text,
+    run_metadata,
+    write_prometheus,
+)
+from scalecube_cluster_tpu.obs.latency import detection_latencies, latency_histogram
+
+GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "obs_schema_golden.jsonl")
+
+#: Fixed metadata — the golden file must not depend on the checkout or host.
+GOLDEN_META = {"commit": "deadbee", "platform": "cpu", "n": 1024, "slot_budget": 256, "seed": 7}
+
+
+def golden_rows() -> list[dict]:
+    """The representative rows the exporter emits, with pinned metadata."""
+    bench = make_row(
+        "bench",
+        {
+            "metric": "member_gossip_rounds_per_sec",
+            "value": 123456.7,
+            "unit": "member*rounds/s",
+            "engine": "sparse",
+            "vs_baseline": 0.123,
+        },
+        GOLDEN_META,
+    )
+    counters = make_row(
+        "counters",
+        {k: i for i, k in enumerate(SHARED_COUNTERS + SIM_ONLY_COUNTERS)},
+        GOLDEN_META,
+    )
+    hist = make_row(
+        "latency_histogram",
+        {
+            "event": "first_dead",
+            "count": 3,
+            "mean": 32.5,
+            "p50": 32.0,
+            "p99": 33.0,
+            "max": 33,
+            "bin_edges": [32.0, 32.5, 33.0],
+            "bin_counts": [2, 1],
+        },
+        GOLDEN_META,
+    )
+    return [bench, counters, hist]
+
+
+def test_schema_golden_file():
+    """Byte-for-byte JSONL stability: the exporter's wire format is pinned."""
+    with open(GOLDEN_PATH) as fh:
+        golden = fh.read().splitlines()
+    lines = [jsonl_line(r) for r in golden_rows()]
+    assert lines == golden, (
+        "exporter wire format drifted from tests/golden/obs_schema_golden.jsonl; "
+        "if intended, bump SCHEMA_VERSION and regenerate with "
+        "`python -m tests.test_obs --write-golden`"
+    )
+    # Every golden line round-trips and carries the schema stamp.
+    for line in golden:
+        row = json.loads(line)
+        assert row["schema"] == SCHEMA_VERSION
+        assert "kind" in row
+
+
+def test_append_jsonl_matches_golden(tmp_path):
+    path = tmp_path / "out.jsonl"
+    append_jsonl(str(path), golden_rows())
+    append_jsonl(str(path), [])  # append of nothing is a no-op
+    with open(GOLDEN_PATH) as fh:
+        assert path.read_text() == fh.read()
+
+
+def test_make_row_reserved_keys_and_precedence():
+    with pytest.raises(ValueError):
+        make_row("x", {"schema": 2})
+    with pytest.raises(ValueError):
+        make_row("x", {}, {"kind": "y"})
+    # Payload wins over metadata for overlapping (non-reserved) keys.
+    row = make_row("x", {"n": 5}, {"n": 9, "commit": "abc"})
+    assert row["n"] == 5 and row["commit"] == "abc"
+    assert row["schema"] == SCHEMA_VERSION and row["kind"] == "x"
+
+
+def test_run_metadata_explicit_fields():
+    meta = run_metadata(n=32, slot_budget=64, seed=3, platform="cpu", commit="abc1234")
+    assert meta == {
+        "commit": "abc1234",
+        "platform": "cpu",
+        "n": 32,
+        "slot_budget": 64,
+        "seed": 3,
+    }
+    # Optional fields stay absent when not given.
+    assert set(run_metadata(platform="cpu", commit="x")) == {"commit", "platform"}
+
+
+def test_prometheus_text(tmp_path):
+    rows = golden_rows()
+    text = prometheus_text(rows, prefix="scalecube")
+    # Numeric scalars become gauges named <prefix>_<kind>_<field>.
+    assert "# TYPE scalecube_bench_value gauge" in text
+    assert "# TYPE scalecube_counters_pings gauge" in text
+    # String fields render as labels (sorted), including the metadata stamps.
+    bench_line = next(
+        l for l in text.splitlines() if l.startswith("scalecube_bench_value{")
+    )
+    assert 'commit="deadbee"' in bench_line
+    assert 'engine="sparse"' in bench_line
+    assert bench_line.endswith("} 123456.7")
+    # Lists/strings/bools never appear as samples.
+    assert "bin_edges" not in text and "unit}" not in text
+    # Deterministic output.
+    assert text == prometheus_text(rows, prefix="scalecube")
+    out = tmp_path / "metrics.prom"
+    write_prometheus(str(out), rows)
+    assert out.read_text() == prometheus_text(rows)
+
+
+def test_protocol_counters_block():
+    c = ProtocolCounters()
+    snap = c.snapshot()
+    assert set(snap) == set(SHARED_COUNTERS) and all(v == 0 for v in snap.values())
+    c.inc("pings")
+    c.inc("acks", 3)
+    c.sent("sc/fd/ping")
+    c.sent("sc/fd/ping")
+    assert c.snapshot()["pings"] == 1 and c.snapshot()["acks"] == 3
+    assert c.sent_by_qualifier() == {"sc/fd/ping": 2}
+    with pytest.raises(KeyError):
+        c.inc("not_a_counter")
+    total = sum_counters([c.snapshot(), c.snapshot()])
+    assert total["acks"] == 6
+    delta = diff_counters(total, c.snapshot())
+    assert delta["acks"] == 3 and delta["pings"] == 1
+
+
+def test_detection_latencies_and_histogram():
+    lat_s = np.array([-1, 4, 10, 2, -1], np.int32)
+    lat_d = np.array([-1, 34, 40, -1, -1], np.int32)
+    state = types.SimpleNamespace(lat_first_suspect=lat_s, lat_first_dead=lat_d)
+    # Member 1 killed at t=2, member 2 at t=5; member 3's suspect entry (t=2)
+    # predates its kill (t=8) -> stale, skipped. Member 4 never detected.
+    out = detection_latencies(state, {1: 2, 2: 5, 3: 8, 4: 9})
+    assert out["n_killed"] == 4
+    assert sorted(out["suspect_latency"].tolist()) == [2, 5]
+    assert sorted(out["dead_latency"].tolist()) == [32, 35]
+    assert out["n_suspected"] == 2 and out["n_dead_detected"] == 2
+    # Array form of kill_ticks agrees with the dict form.
+    kt = np.array([-1, 2, 5, 8, 9])
+    out2 = detection_latencies(state, kt)
+    assert np.array_equal(out2["dead_latency"], out["dead_latency"])
+
+    hist = latency_histogram(out["dead_latency"])
+    assert hist["count"] == 2 and hist["max"] == 35
+    assert sum(hist["bin_counts"]) == 2
+    json.dumps(hist)  # JSON-serializable by construction
+    assert latency_histogram(np.array([], np.int64)) == {
+        "count": 0,
+        "bin_edges": [],
+        "bin_counts": [],
+    }
+
+
+def _write_golden() -> None:
+    os.makedirs(os.path.dirname(GOLDEN_PATH), exist_ok=True)
+    with open(GOLDEN_PATH, "w") as fh:
+        for row in golden_rows():
+            fh.write(jsonl_line(row) + "\n")
+    print(f"wrote {GOLDEN_PATH}")
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--write-golden" in sys.argv:
+        _write_golden()

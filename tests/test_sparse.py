@@ -738,3 +738,131 @@ def test_restart_many_matches_sequential():
     cold = kill_sparse(init_sparse_full_view(n, p.slot_budget), 11)
     seq2 = restart_sparse(restart_sparse(cold, 11), 3)
     compare(seq2, restart_many_sparse(cold, [11, 3]))
+
+
+# -- flight recorder (ISSUE 2: on-device protocol telemetry) ------------------
+
+
+def test_chunked_traces_cover_every_tick_including_ragged_tail():
+    """run_sparse_chunked accumulates traces across chunks: one collected
+    run yields the full counter timeline, leading axis exactly n_ticks even
+    when n_ticks % chunk != 0 (130 = 2 full 48-chunks + a 34-tick tail)."""
+    n, n_ticks, chunk = 24, 130, 48
+    p = dataclasses.replace(sparse_params(n), in_scan_writeback=False)
+    st = kill_sparse(init_sparse_full_view(n, p.slot_budget, user_gossip_slots=2), 5)
+    st, tr = run_sparse_chunked(p, st, FaultPlan.clean(n), n_ticks, chunk=chunk)
+    assert tr, "collect=True must return traces"
+    for key, arr in tr.items():
+        assert arr.shape[0] == n_ticks, (key, arr.shape)
+    # The full protocol-counter schema is present in one run.
+    for key in (
+        "pings",
+        "ping_reqs",
+        "acks",
+        "suspicions_raised",
+        "verdicts_dead",
+        "verdicts_alive",
+        "gossip_infections",
+        "slot_activations",
+        "slot_frees",
+        "slot_overflow",
+        "sync_window_accepts",
+        "msgs_fd",
+        "msgs_sync",
+        "msgs_gossip",
+    ):
+        assert key in tr, key
+    # The kill is observed: suspicions were raised, verdicts landed.
+    assert int(tr["suspicions_raised"].sum()) > 0
+    assert int(tr["verdicts_dead"].sum()) > 0
+    assert int(tr["slot_overflow"].max()) == 0
+
+
+def test_chunked_collect_off_returns_no_traces():
+    """Bench path: collect=False must transfer nothing to the host."""
+    n = 24
+    p = dataclasses.replace(sparse_params(n), in_scan_writeback=False)
+    st = kill_sparse(init_sparse_full_view(n, p.slot_budget, user_gossip_slots=2), 5)
+    st, tr = run_sparse_chunked(p, st, FaultPlan.clean(n), 20, chunk=8, collect=False)
+    assert tr == {}
+    # And the default state carries no recorder arrays at all.
+    assert st.lat_first_suspect is None and st.lat_first_dead is None
+
+
+def test_verdict_latency_recorder():
+    """record_latency=True pins each member's first-suspect / first-dead
+    tick; the gap between them is exactly the suspicion timeout for a hard
+    kill on a clean network, and restart resets the recorder."""
+    import numpy as np
+
+    n = 24
+    p = dataclasses.replace(sparse_params(n), in_scan_writeback=False)
+    st = init_sparse_full_view(
+        n, p.slot_budget, user_gossip_slots=2, record_latency=True
+    )
+    assert st.lat_first_suspect is not None  # structure-gated state fields
+    st = kill_sparse(st, 5)
+    st, _ = run_sparse_chunked(p, st, FaultPlan.clean(n), 130, chunk=48)
+
+    ls = np.asarray(st.lat_first_suspect)
+    ld = np.asarray(st.lat_first_dead)
+    assert ls[5] >= 0 and ld[5] > ls[5]
+    assert ld[5] - ls[5] == p.base.suspicion_ticks
+    # Nobody else was ever suspected or declared dead.
+    assert bool((np.delete(ls, 5) == -1).all())
+    assert bool((np.delete(ld, 5) == -1).all())
+
+    # obs/latency.py turns the raw ticks into latencies + a histogram.
+    from scalecube_cluster_tpu.obs.latency import (
+        detection_latencies,
+        latency_histogram,
+    )
+
+    lat = detection_latencies(st, {5: 0})
+    assert lat["n_killed"] == 1 and lat["n_dead_detected"] == 1
+    assert lat["dead_latency"].tolist() == [int(ld[5])]
+    hist = latency_histogram(lat["dead_latency"])
+    assert hist["count"] == 1 and hist["max"] == int(ld[5])
+
+    # Restart wipes the member's recorder entries (next life re-records).
+    st2 = restart_sparse(st, 5)
+    assert int(st2.lat_first_suspect[5]) == -1
+    assert int(st2.lat_first_dead[5]) == -1
+
+
+def test_dense_sparse_counter_parity():
+    """The two engines report the SAME protocol-event timeline, tick for
+    tick, on the shared-counter subset both emit: the flight recorder is
+    engine-independent. Deterministic scenario (seeded PRNG both sides), so
+    exact equality — any drift means one engine's counter semantics moved."""
+    import numpy as np
+
+    from scalecube_cluster_tpu.sim import init_full_view, run_ticks
+    from scalecube_cluster_tpu.sim.state import kill, seeds_mask
+
+    n, ticks = 24, 80
+    p = small_params(n)
+    plan = FaultPlan.clean(n)
+
+    dst = kill(init_full_view(n, user_gossip_slots=2), 5)
+    dst, dtr = run_ticks(p, dst, plan, seeds_mask(n, [0]), ticks, collect=True)
+
+    sp = sparse_params(n)
+    sst = kill_sparse(
+        init_sparse_full_view(n, sp.slot_budget, user_gossip_slots=2), 5
+    )
+    sst, strr = run_sparse_ticks(sp, sst, plan, ticks, collect=True)
+
+    for key in (
+        "suspicions_raised",
+        "verdicts_dead",
+        "verdicts_alive",
+        "n_suspected",
+    ):
+        d, s = np.asarray(dtr[key]), np.asarray(strr[key])
+        assert np.array_equal(d, s), (key, d.sum(), s.sum())
+    # The scenario actually exercises the counters (23 live viewers each
+    # suspect then convict member 5), and the sparse side never overflowed.
+    assert int(np.asarray(dtr["suspicions_raised"]).sum()) == n - 1
+    assert int(np.asarray(dtr["verdicts_dead"]).sum()) == n - 1
+    assert int(np.asarray(strr["slot_overflow"]).max()) == 0

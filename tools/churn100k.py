@@ -11,7 +11,6 @@ actually completes; this one is kept for sub-40k rows.
 Usage: python tools/churn100k.py [n] [ticks]
 """
 
-import json
 import os
 import sys
 
@@ -37,7 +36,14 @@ row["note"] = (
     "(the [N, N] cold view exceeds one chip's HBM at this n; the TPU path "
     "is the 8-device mesh, __graft_entry__.dryrun_sparse)"
 )
-print(json.dumps(row), flush=True)
-with open(os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-                       "EXPERIMENTS_r3.jsonl"), "a") as fh:
-    fh.write(json.dumps(row) + "\n")
+from scalecube_cluster_tpu.obs.export import append_jsonl, jsonl_line, make_row, run_metadata
+
+row = make_row("experiment", row, run_metadata())
+print(jsonl_line(row), flush=True)
+append_jsonl(
+    os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "EXPERIMENTS_r3.jsonl",
+    ),
+    [row],
+)

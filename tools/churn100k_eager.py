@@ -182,12 +182,14 @@ row = {
         "number."
     ),
 }
-print(json.dumps(row), flush=True)
-with open(
+from scalecube_cluster_tpu.obs.export import append_jsonl, jsonl_line, make_row, run_metadata
+
+row = make_row("experiment", row, run_metadata())
+print(jsonl_line(row), flush=True)
+append_jsonl(
     os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "EXPERIMENTS_r4.jsonl",
     ),
-    "a",
-) as fh:
-    fh.write(json.dumps(row) + "\n")
+    [row],
+)

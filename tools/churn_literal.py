@@ -255,10 +255,12 @@ row = {
         "pins the property; bound formula identical)."
     ),
 }
+from scalecube_cluster_tpu.obs.export import append_jsonl, make_row, run_metadata
+
+row = make_row("experiment", row, run_metadata())
 exp = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "EXPERIMENTS_r5.jsonl",
 )
-with open(exp, "a") as fh:
-    fh.write(json.dumps(row) + "\n")
+append_jsonl(exp, [row])
 print(json.dumps(row, indent=2), flush=True)
