@@ -5,12 +5,40 @@ masking, structured-fan-out gossip delivery, membership merge (ops/merge.py
 lattice), suspicion sweep, rumor aging — reading each state array once:
 
   read  f×{slab,age} sender windows + local {slab, age, susp}
-  write {slab2, age2, susp2} + the [N] self-rumor column
+  write {slab2, age2, susp2} + the [N] self-rumor column + per-slot aggregates
 
 The XLA chain it replaces materializes rows/best_any/best_alive/merged and
 the suspicion intermediates separately (~2.5× the traffic, plus gather
 latency); bit-parity with that chain is asserted over whole trajectories by
-tests/test_sparse.py::test_pallas_core_matches_xla.
+tests/test_sparse.py::test_pallas_core_matches_xla and the fold-ladder
+parity matrix (test_fold_ladder_parity).
+
+Residual-fold ladder (round 6): the per-tick [N, S] passes that used to
+remain OUTSIDE the kernel are now foldable behind the same tile DMAs, one
+independently-bisectable piece each (``fold`` argument / ``pallas_fold`` in
+sim/sparse.py::SparseParams):
+
+  'countdown'  suspicion countdown + DEAD transition + aging/stale mask
+               (the sweep — in-kernel since round 3; the ladder root).
+  'points'     the cond-gated FD/SYNC point-update where-passes. The fd/sy
+               slot rides the packed scalar-prefetch lane (pack_slots) and
+               the verdict payloads ride two more [N] int32 prefetch lanes;
+               the kernel applies them to the local block AND to the DMA'd
+               sender windows (pre-roll, sender-indexed SMEM loads), so a
+               fresh verdict still gossips out the same tick — exactly the
+               XLA step-4 semantics.
+  'wb_mask'    the write-back pin rule (sim/sparse.py::_free_plan): each
+               block reduces holding&alive over its 32 viewers and
+               OR-accumulates bit 0 of the [8, S] aggregate output across
+               the sequential grid — no separate [N, S] sweep at free time.
+  'view_rows'  batched per-subject (view-row) flag maintenance: any-LIVE-
+               viewer-holds-SUSPECT / -DEAD per slot (bits 1/2 of the same
+               aggregate), feeding the verdict-latency recorder without
+               re-materializing [N, S] masks post-tick.
+
+'wb_mask'/'view_rows' aggregate the SWEPT arrays, so they require
+'countdown' (enforced by SparseParams). Pieces that stay off keep their
+bit-identical XLA fallback in sim/sparse.py — the fidelity oracle.
 
 Protocol anchors (via sim/sparse.py, whose formulas this kernel fuses):
 young-payload selection = selectGossipsToSend
@@ -22,9 +50,9 @@ task (MembershipProtocolImpl.java:620-647).
 Window structure: the sparse fan-out uses 32-row sender groups
 (fanout_permutations_structured(group=32)) so the int8 age windows are
 tile-aligned (int8 sublane = 32); receiver blocks are the same 32 rows.
-Per-receiver scalars ride two packed SMEM int32 vectors (edge-ok bits +
-alive bit; fd/sync point-update slots) to keep scalar-prefetch memory small
-at 32k members.
+Per-receiver scalars ride packed SMEM int32 vectors (edge-ok bits + alive
+bit; fd/sync point-update slots; fd/sync verdict keys) to keep
+scalar-prefetch memory small at 32k members.
 """
 
 from __future__ import annotations
@@ -44,6 +72,13 @@ ALIVE_BIT = 7
 #: Slot indices pack +1 into 12-bit fields of one int32 (0 = no update).
 SLOT_BITS = 12
 SLOT_MASK = (1 << SLOT_BITS) - 1
+
+#: The residual-fold ladder pieces (module docstring).
+FOLD_PIECES = ("countdown", "points", "wb_mask", "view_rows")
+#: Bits of the per-slot aggregate output (wb pin / recorder flags).
+AGGR_HOLD_BIT = 0
+AGGR_SUSPECT_BIT = 1
+AGGR_DEAD_BIT = 2
 
 
 def pack_flags(edge_ok, alive):
@@ -65,14 +100,20 @@ def pack_slots(fd_slot, sy_slot):
     return (fd_slot + 1) | ((sy_slot + 1) << SLOT_BITS)
 
 
-def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale):
+def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale, sweep, fold):
     b = SPARSE_GROUP
+    fp = "points" in fold
+    fc = "countdown" in fold
+    fw = "wb_mask" in fold
+    fr = "view_rows" in fold
 
     def kernel(
         ginv_ref,
         rot_ref,
         flags_ref,
         slots_ref,
+        fdk_ref,
+        syk_ref,
         slab_hbm_ref,
         age_hbm_ref,
         subj_ref,
@@ -83,6 +124,7 @@ def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale):
         age2_ref,
         susp2_ref,
         self_ref,
+        aggr_ref,
         wslab,
         wage,
         sems,
@@ -120,19 +162,43 @@ def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale):
         flags = jnp.stack([flags_ref[i * b + r] for r in range(b)]).reshape(b, 1)
         slots = jnp.stack([slots_ref[i * b + r] for r in range(b)]).reshape(b, 1)
 
+        def point_override(slab32, age32, base):
+            """Apply the senders'/receivers' fd/sy point updates to a [b, s]
+            block whose row r is member ``base + r`` (pre-roll for windows).
+            SYNC wins a same-cell collision, matching the XLA where-pass
+            nesting order (sim/sparse.py step 4)."""
+            psl = jnp.stack([slots_ref[base + r] for r in range(b)]).reshape(b, 1)
+            pfd = jnp.stack([fdk_ref[base + r] for r in range(b)]).reshape(b, 1)
+            psy = jnp.stack([syk_ref[base + r] for r in range(b)]).reshape(b, 1)
+            fd_lane = (psl & SLOT_MASK) - 1
+            sy_lane = ((psl >> SLOT_BITS) & SLOT_MASK) - 1
+            cell = (lane_ids == fd_lane) | (lane_ids == sy_lane)
+            slab32 = jnp.where(
+                lane_ids == sy_lane,
+                psy,
+                jnp.where(lane_ids == fd_lane, pfd, slab32),
+            )
+            return slab32, jnp.where(cell, 0, age32)
+
         best_any = jnp.full((b, s), -1, jnp.int32)
         best_alive = best_any
         for c in range(f):
             for copy in dma(i, slot, c):
                 copy.wait()
             rot = rot_ref[c, i]
-            w = pltpu.roll(wslab[slot, c], shift=b - rot, axis=0)
+            w32 = wslab[slot, c]
             # Mosaic's dynamic rotate only lowers for 32-bit lanes ("Rotate
             # with non-32-bit data" — hit on the real chip, round 3), so the
             # int8 age window widens BEFORE the roll, not after.
-            wa = pltpu.roll(
-                wage[slot, c].astype(jnp.int32), shift=b - rot, axis=0
-            )
+            wa32 = wage[slot, c].astype(jnp.int32)
+            if fp:
+                # The HBM slab is PRE-point under the points fold; senders'
+                # fresh verdicts must still ride this tick's payload
+                # (reference: the FD event's record update precedes the next
+                # doSpreadGossip, MembershipProtocolImpl.java:376-404).
+                w32, wa32 = point_override(w32, wa32, ginv_ref[c, i] * b)
+            w = pltpu.roll(w32, shift=b - rot, axis=0)
+            wa = pltpu.roll(wa32, shift=b - rot, axis=0)
             young_w = wa < spread
             payload = jnp.where(young_w & active_lane, w, -1)
             ok = ((flags >> c) & 1) != 0
@@ -142,6 +208,18 @@ def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale):
                 best_alive, jnp.where(is_alive_key(contrib), contrib, -1)
             )
 
+        # Local block: under the points fold the verdicts apply here too
+        # (receiver side of the XLA step-4 where-pass).
+        fd_s = (slots & SLOT_MASK) - 1
+        sy_s = ((slots >> SLOT_BITS) & SLOT_MASK) - 1
+        point_cell = (lane_ids == fd_s) | (lane_ids == sy_s)
+        local_in = slab_ref[...]
+        age0 = age_ref[...].astype(jnp.int32)
+        if fp:
+            local, age0 = point_override(local_in, age0, i * b)
+        else:
+            local = local_in
+
         # Self-rumor channel (receiver == slot's subject), then exclusion.
         row_ids = jax.lax.broadcasted_iota(jnp.int32, (b, s), 0) + i * b
         own = subj_lane == row_ids
@@ -150,47 +228,77 @@ def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale):
         best_any = jnp.where(own, -1, best_any)
         best_alive = jnp.where(own, -1, best_alive)
 
-        local = slab_ref[...]
         merged = _merge_rows(local, best_any, best_alive)
         merged = jnp.where(active_lane, merged, local)
         alive_row = ((flags >> ALIVE_BIT) & 1) != 0
         merged = jnp.where(alive_row, merged, local)
 
-        # Suspicion sweep + aging (sim/sparse.py step 6). ``rearm``/
-        # ``changed`` compare against the PRE-point-update slab; a point
-        # update always strictly raises the key, so `| point_cell` restores
-        # that comparison from the post-update local block.
-        fd_s = (slots & SLOT_MASK) - 1
-        sy_s = ((slots >> SLOT_BITS) & SLOT_MASK) - 1
-        point_cell = (lane_ids == fd_s) | (lane_ids == sy_s)
-        s_loc = susp_ref[...].astype(jnp.int32)
-        armed = s_loc > 0
-        rearm = (merged != local) | point_cell
-        left0 = jnp.maximum(s_loc - 1, 0)
-        expired = (
-            alive_row
-            & armed
-            & ~rearm
-            & (left0 == 0)
-            & ((merged & DEAD_BIT) == 0)
-            & ((merged & 1) != 0)
-            & (merged >= 0)
-        )
-        slab2 = jnp.where(expired, (merged | DEAD_BIT) & ~jnp.int32(1), merged)
-        changed = ((slab2 != local) | point_cell) & alive_row & active_lane
-        age0 = age_ref[...].astype(jnp.int32)
-        age2 = jnp.where(changed, 0, jnp.minimum(age0, age_stale - 1) + 1)
-        is_susp = ((slab2 & 1) != 0) & ((slab2 & DEAD_BIT) == 0) & (slab2 >= 0)
-        susp2 = jnp.where(
-            is_susp & active_lane,
-            jnp.where(rearm | ~armed, susp_ticks, left0),
-            0,
-        )
-        susp2 = jnp.where(alive_row, susp2, s_loc)
+        if fc:
+            # Suspicion sweep + aging (sim/sparse.py step 6). ``rearm``/
+            # ``changed`` compare against the PRE-point-update slab; a point
+            # update always strictly raises the key, so `| point_cell`
+            # restores that comparison from the post-update local block.
+            s_loc = susp_ref[...].astype(jnp.int32)
+            armed = s_loc > 0
+            rearm = (merged != local) | point_cell
+            left0 = jnp.maximum(s_loc - 1, 0)
+            expired = (
+                alive_row
+                & armed
+                & ~rearm
+                & (left0 == 0)
+                & ((merged & DEAD_BIT) == 0)
+                & ((merged & 1) != 0)
+                & (merged >= 0)
+            )
+            slab2 = jnp.where(expired, (merged | DEAD_BIT) & ~jnp.int32(1), merged)
+            changed = ((slab2 != local) | point_cell) & alive_row & active_lane
+            age2 = jnp.where(changed, 0, jnp.minimum(age0, age_stale - 1) + 1)
+            is_susp = ((slab2 & 1) != 0) & ((slab2 & DEAD_BIT) == 0) & (slab2 >= 0)
+            susp2 = jnp.where(
+                is_susp & active_lane,
+                jnp.where(rearm | ~armed, susp_ticks, left0),
+                0,
+            )
+            susp2 = jnp.where(alive_row, susp2, s_loc)
+        else:
+            # Ladder root off: the kernel stops at delivery+merge and the
+            # XLA sweep consumes ``merged`` (age/susp pass through unused).
+            slab2 = merged
+            age2 = age0
+            susp2 = susp_ref[...].astype(jnp.int32)
 
         slab2_ref[...] = slab2
         age2_ref[...] = age2.astype(jnp.int8)
         susp2_ref[...] = susp2.astype(jnp.int16)
+
+        # Per-slot aggregates, OR-accumulated across the sequential grid
+        # into one revisited [8, s] output block.
+        def anyrow(m):
+            return jnp.max(m.astype(jnp.int32), axis=0, keepdims=True)
+
+        red = jnp.zeros((1, s), jnp.int32)
+        if fw:
+            # EXACTLY sim/sparse.py::_free_plan's holding rule, evaluated on
+            # this tick's outputs (= next free decision's inputs).
+            dead2 = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
+            stale_done = age2 > sweep
+            holding = (age2 < spread) | (susp2 > 0) | (dead2 & ~stale_done & ~own)
+            red = red | (anyrow(holding & alive_row) << AGGR_HOLD_BIT)
+        if fr:
+            dead2 = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
+            is_s2 = ((slab2 & 1) != 0) & ~dead2 & (slab2 >= 0)
+            red = red | (anyrow(is_s2 & alive_row) << AGGR_SUSPECT_BIT)
+            red = red | (anyrow(dead2 & alive_row) << AGGR_DEAD_BIT)
+        blk = jnp.broadcast_to(red, (8, s))
+
+        @pl.when(i == 0)
+        def _():
+            aggr_ref[...] = blk
+
+        @pl.when(i > 0)
+        def _():
+            aggr_ref[...] = aggr_ref[...] | blk
 
     return kernel
 
@@ -206,24 +314,39 @@ def sparse_core_pallas(
     alive,
     fd_slot,
     sy_slot,
+    fd_key=None,
+    sy_key=None,
     *,
     spread,
     susp_ticks,
     age_stale,
+    sweep=0,
+    fold=frozenset({"countdown"}),
     interpret=None,
 ):
-    """Fused sparse tick core. Returns ``(slab2, age2, susp2, self_rumor)``.
+    """Fused sparse tick core with the residual-fold ladder.
+
+    Returns ``(slab2, age2, susp2, self_rumor, aggr)`` where ``aggr`` is
+    the per-slot [S] int32 aggregate (AGGR_*_BIT flags; zeros for pieces
+    not in ``fold``).
 
     Args:
-      slab/age/susp: post-point-update working set ``[N, S]``.
+      slab/age/susp: post-load working set ``[N, S]`` — PRE point update
+        when ``'points' in fold`` (the kernel applies them), post-point
+        otherwise (caller applied them, round-5 behavior).
       slot_subj: ``[S]`` int32 subject of each slot (-1 free).
       ginv, rots: structured fan-out with ``group=SPARSE_GROUP``,
         ``[f, N/32]``.
       edge_ok: ``[f, N]`` bool. alive: ``[N]`` bool.
       fd_slot/sy_slot: ``[N]`` int32 — this tick's point-update slot per
         viewer (-1 = none), for the rearm/changed correction.
-      spread/susp_ticks/age_stale: protocol constants (static; tombstone
-        sweep happens at write-back, not in the tick).
+      fd_key/sy_key: ``[N]`` int32 verdict payloads, consumed when
+        ``'points' in fold`` (zeros otherwise).
+      spread/susp_ticks/age_stale/sweep: protocol constants (static;
+        ``sweep`` = periods_to_sweep feeds the 'wb_mask' pin rule — the
+        tombstone sweep itself still happens at write-back, not here).
+      fold: subset of :data:`FOLD_PIECES`; 'wb_mask'/'view_rows' require
+        'countdown' (they aggregate the swept arrays).
     """
     n, s = slab.shape
     f = ginv.shape[0]
@@ -235,13 +358,23 @@ def sparse_core_pallas(
         # pack_slots stores slot+1 in a 12-bit field; a bigger slot budget
         # would silently corrupt the packed point updates.
         raise ValueError(f"S={s} must be < {1 << SLOT_BITS} (packed slots)")
+    fold = frozenset(fold)
+    unknown = fold - set(FOLD_PIECES)
+    if unknown:
+        raise ValueError(f"unknown fold pieces {sorted(unknown)}")
+    if ("wb_mask" in fold or "view_rows" in fold) and "countdown" not in fold:
+        raise ValueError("'wb_mask'/'view_rows' require 'countdown'")
+    if fd_key is None:
+        fd_key = jnp.zeros_like(fd_slot)
+    if sy_key is None:
+        sy_key = jnp.zeros_like(sy_slot)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     nb = n // SPARSE_GROUP
     b = SPARSE_GROUP
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=4,
+        num_scalar_prefetch=6,
         grid=(nb,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),  # slab windows
@@ -256,6 +389,7 @@ def sparse_core_pallas(
             pl.BlockSpec((b, s), lambda i, *_: (i, 0)),
             pl.BlockSpec((b, s), lambda i, *_: (i, 0)),
             pl.BlockSpec((b, 128), lambda i, *_: (i, 0)),
+            pl.BlockSpec((8, s), lambda i, *_: (0, 0)),  # revisited aggregate
         ],
         scratch_shapes=[
             pltpu.VMEM((2, f, b, s), jnp.int32),
@@ -263,14 +397,15 @@ def sparse_core_pallas(
             pltpu.SemaphoreType.DMA((2, f, 2)),
         ],
     )
-    slab2, age2, susp2, self_pad = pl.pallas_call(
-_kernel_factory(f, nb, s, spread, susp_ticks, age_stale),
+    slab2, age2, susp2, self_pad, aggr = pl.pallas_call(
+        _kernel_factory(f, nb, s, spread, susp_ticks, age_stale, sweep, fold),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((n, s), jnp.int32),
             jax.ShapeDtypeStruct((n, s), jnp.int8),
             jax.ShapeDtypeStruct((n, s), jnp.int16),
             jax.ShapeDtypeStruct((n, 128), jnp.int32),
+            jax.ShapeDtypeStruct((8, s), jnp.int32),
         ],
         interpret=interpret,
     )(
@@ -278,6 +413,8 @@ _kernel_factory(f, nb, s, spread, susp_ticks, age_stale),
         rots,
         pack_flags(edge_ok, alive),
         pack_slots(fd_slot, sy_slot),
+        fd_key,
+        sy_key,
         slab,
         age,
         jnp.broadcast_to(slot_subj[None, :], (8, s)),
@@ -285,4 +422,4 @@ _kernel_factory(f, nb, s, spread, susp_ticks, age_stale),
         age,
         susp,
     )
-    return slab2, age2, susp2, self_pad[:, 0]
+    return slab2, age2, susp2, self_pad[:, 0], aggr[0]

@@ -154,6 +154,16 @@ def sparse_state_shardings(mesh: Mesh, like=None):
         lat_first_dead=(
             vec if like is not None and like.lat_first_dead is not None else None
         ),
+        # Carried write-back pin mask (round-6 'wb_mask' fold): [S] per-slot
+        # any-over-viewers — every device needs the full mask for the free
+        # decision, like the slot tables (the cross-viewer OR becomes a
+        # collective XLA inserts).
+        wb_pinned=(
+            rep if like is not None and like.wb_pinned is not None else None
+        ),
+        wb_valid=(
+            rep if like is not None and like.wb_valid is not None else None
+        ),
     )
 
 

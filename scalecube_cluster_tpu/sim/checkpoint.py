@@ -58,8 +58,12 @@ def load_checkpoint(path: str | Path) -> tuple[SimState, SimParams]:
                 f"{path} is a sparse-engine checkpoint; use load_sparse_checkpoint"
             )
         params = SimParams(**json.loads(bytes(data["__params__"]).decode()))
+        # .copy() forces device-OWNED buffers: jnp.asarray may zero-copy the
+        # numpy memory, and the donating runners (run_ticks and friends)
+        # would then let XLA reuse memory the archive reader frees —
+        # observed as nondeterministic resume divergence on CPU.
         arrays = {
-            name: jax.numpy.asarray(data[name])
+            name: jax.numpy.asarray(data[name]).copy()
             for name in _FIELDS
             if name in data
         }
@@ -107,8 +111,13 @@ def save_sparse_checkpoint(path: str | Path, state, params) -> None:
         # from the archive; load_sparse_checkpoint's defaults restore None.
         if getattr(state, f.name) is not None
     }
+    blob = dataclasses.asdict(params)
+    # pallas_fold is a frozenset — JSON carries it as a sorted list;
+    # SparseParams.__post_init__ re-freezes it on load.
+    if "pallas_fold" in blob:
+        blob["pallas_fold"] = sorted(blob["pallas_fold"])
     arrays[_SPARSE_MAGIC] = np.frombuffer(
-        json.dumps(dataclasses.asdict(params)).encode(), dtype=np.uint8
+        json.dumps(blob).encode(), dtype=np.uint8
     )
     np.savez(path, **arrays)
 
@@ -122,8 +131,10 @@ def load_sparse_checkpoint(path: str | Path):
             raise ValueError(f"{path} is not a sparse-engine checkpoint")
         raw = json.loads(bytes(data[_SPARSE_MAGIC]).decode())
         params = SparseParams(base=SimParams(**raw.pop("base")), **raw)
+        # .copy(): device-owned buffers, for the same donation-safety reason
+        # as load_checkpoint (run_sparse_ticks/writeback_free donate).
         arrays = {
-            f.name: jax.numpy.asarray(data[f.name])
+            f.name: jax.numpy.asarray(data[f.name]).copy()
             for f in dataclasses.fields(SparseState)
             if f.name in data
         }

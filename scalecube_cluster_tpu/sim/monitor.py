@@ -102,6 +102,15 @@ def sparse_summary(state) -> dict:
         "max_incarnation": state.inc_self.max(),
         "max_epoch": state.epoch.max(),
     }
+    if getattr(state, "wb_pinned", None) is not None:
+        # Round-6 'wb_mask' fold health: how many active slots the kernel's
+        # carried pin mask holds back from write-back, and whether the mask
+        # is currently trusted (0 after host ops / XLA-core ticks — the
+        # next free decision recomputes).
+        summary["wb_pinned_slots"] = jnp.sum(
+            state.wb_pinned & (state.slot_subj >= 0)
+        )
+        summary["wb_mask_valid"] = state.wb_valid.astype(jnp.int32)
     # One batched transfer for the whole dict — per-metric device_get would
     # issue a blocking round-trip per key.
     out = {k: int(v) for k, v in jax.device_get(summary).items()}

@@ -193,6 +193,18 @@ class SparseParams:
     #: one fused Pallas kernel (ops/pallas_sparse.py). Bit-identical to the
     #: XLA chain; needs n % 32 == 0 and S % 128 == 0, else ignored.
     pallas_core: bool = False
+    #: Residual-fold ladder (round 6): which per-tick [N, S] passes fold
+    #: INTO the kernel when ``pallas_core`` is on (ops/pallas_sparse.py
+    #: module docstring). Pieces: 'countdown' (suspicion sweep + aging),
+    #: 'points' (FD/SYNC point-update where-passes), 'wb_mask' (the
+    #: write-back pin rule, carried tick-to-tick in
+    #: ``SparseState.wb_pinned``), 'view_rows' (per-subject suspect/dead
+    #: flags for the latency recorder). Each piece is independently
+    #: bisectable; pieces left out keep their bit-identical XLA form — the
+    #: fidelity oracle. 'wb_mask'/'view_rows' require 'countdown'.
+    pallas_fold: frozenset = frozenset(
+        {"countdown", "points", "wb_mask", "view_rows"}
+    )
     #: Bounded-window table SYNC: each sync period, partners additionally
     #: exchange their records for a globally-rotating window of this many
     #: subjects — the scalable form of the reference's FULL-table exchange
@@ -200,6 +212,23 @@ class SparseParams:
     #: Full table coverage every ceil(n / sync_window) sync periods; 0
     #: disables (round-2 own-record-only behavior).
     sync_window: int = 64
+
+    def __post_init__(self):
+        from scalecube_cluster_tpu.ops.pallas_sparse import FOLD_PIECES
+
+        fold = frozenset(self.pallas_fold)
+        unknown = fold - set(FOLD_PIECES)
+        if unknown:
+            raise ValueError(
+                f"unknown pallas_fold pieces {sorted(unknown)}; "
+                f"valid: {FOLD_PIECES}"
+            )
+        if ("wb_mask" in fold or "view_rows" in fold) and "countdown" not in fold:
+            raise ValueError(
+                "pallas_fold: 'wb_mask'/'view_rows' aggregate the swept "
+                "arrays, so they require 'countdown'"
+            )
+        object.__setattr__(self, "pallas_fold", fold)
 
     @classmethod
     def for_n(
@@ -210,6 +239,7 @@ class SparseParams:
         writeback_period: int = 1,
         in_scan_writeback: bool = True,
         pallas_core: bool = False,
+        pallas_fold=frozenset({"countdown", "points", "wb_mask", "view_rows"}),
         sync_window: int = 64,
         churn_rate: float = 0.0,
         burst: int = 0,
@@ -254,6 +284,7 @@ class SparseParams:
             writeback_period=writeback_period,
             in_scan_writeback=in_scan_writeback,
             pallas_core=pallas_core,
+            pallas_fold=frozenset(pallas_fold),
             sync_window=sync_window,
         )
 
@@ -284,6 +315,16 @@ class SparseState:
     # pytree structure — the bench path compiles the exact same hot loop.
     lat_first_suspect: jax.Array | None = None  # [N] int32
     lat_first_dead: jax.Array | None = None  # [N] int32
+    # Carried write-back pin mask (round-6 'wb_mask' fold): the kernel
+    # evaluates _free_plan's holding rule on its own outputs each tick and
+    # the NEXT free decision consumes it instead of re-sweeping [N, S].
+    # ``wb_valid`` is False whenever the mask may be stale (XLA-core ticks,
+    # host ops that touch slab/age/susp/alive, fresh init, legacy
+    # checkpoints) — consumers then recompute, bit-identically. None on
+    # states restored from pre-round-6 checkpoints (structure-gated, like
+    # the recorder arrays).
+    wb_pinned: jax.Array | None = None  # [S] bool
+    wb_valid: jax.Array | None = None  # [] bool
 
     def replace(self, **changes) -> "SparseState":
         return dataclasses.replace(self, **changes)
@@ -329,6 +370,8 @@ def init_sparse_full_view(
         lat_first_dead=(
             jnp.full((n,), -1, jnp.int32) if record_latency else None
         ),
+        wb_pinned=jnp.zeros((slot_budget,), bool),
+        wb_valid=jnp.zeros((), bool),
     )
 
 
@@ -342,12 +385,27 @@ def inject_gossip_sparse(state: SparseState, node_idx: int, slot: int) -> Sparse
     )
 
 
+def _invalidate_wb(state: SparseState) -> SparseState:
+    """Mark the carried write-back pin mask stale (round-6 'wb_mask' fold).
+
+    Every host op that touches ``slab``/``age``/``susp``/``alive`` or the
+    slot tables calls this: the next free decision then recomputes the pin
+    rule from scratch instead of trusting a mask the kernel derived from
+    pre-op state. Pure metadata ops (inject_gossip_sparse — user-gossip
+    arrays only) don't need it: the pin rule never reads those fields.
+    """
+    if state.wb_valid is None:
+        return state
+    return state.replace(wb_valid=jnp.zeros((), bool))
+
+
 def _activate_on_host(state: SparseState, subject: int) -> tuple[SparseState, int]:
     """Host-side slot allocation for control-plane ops (kill/leave/restart).
 
     Loads the subject's column into a free slot if not already active.
     Returns ``(state, slot)``.
     """
+    state = _invalidate_wb(state)
     cur = int(state.subj_slot[subject])
     if cur >= 0:
         return state, cur
@@ -369,7 +427,7 @@ def _activate_on_host(state: SparseState, subject: int) -> tuple[SparseState, in
 
 def kill_sparse(state: SparseState, idx: int) -> SparseState:
     """Hard-stop process ``idx`` (dense twin: sim/state.py::kill)."""
-    return state.replace(alive=state.alive.at[idx].set(False))
+    return _invalidate_wb(state).replace(alive=state.alive.at[idx].set(False))
 
 
 def leave_sparse(state: SparseState, idx: int) -> SparseState:
@@ -430,6 +488,7 @@ def restart_many_sparse(state: SparseState, idxs) -> SparseState:
     idx_list = [int(i) for i in np.asarray(idxs).ravel()]
     if not idx_list:
         return state
+    state = _invalidate_wb(state)
     if len(set(idx_list)) != len(idx_list):
         raise ValueError("duplicate indices in restart_many_sparse")
     epochs = jax.device_get(state.epoch[jnp.asarray(idx_list)])
@@ -524,24 +583,46 @@ def _free_plan(params: SparseParams, state: SparseState, gate=True):
     Returns ``(freeing [S] bool, wb_subj [S] int32 (n = dropped),
     make_writeback)`` where ``make_writeback()`` lazily builds the
     demotion-applied [N_view, S] slab to scatter.
+
+    Round-6 'wb_mask' fold: when the kernel carried a valid pin mask from
+    the previous tick (``state.wb_pinned``/``wb_valid`` — the in-kernel
+    evaluation of exactly this holding rule, plus the post-core window/
+    refutation corrections), the [N, S] pin sweep is skipped; the stale /
+    XLA-core / host-op-touched cases recompute, bit-identically.
     """
     p = params.base
     n = p.n
     col = jnp.arange(n, dtype=jnp.int32)
     active = state.slot_subj >= 0
     own_row = col[:, None] == state.slot_subj[None, :]  # viewer == subject
-    dead_rec = ((state.slab & DEAD_BIT) != 0) & (state.slab >= 0)
-    stale_done = state.age.astype(jnp.int32) > p.periods_to_sweep
-    holding = (
-        (state.age < p.periods_to_spread)
-        | (state.susp > 0)
-        | (dead_rec & ~stale_done & ~own_row)
+
+    def recompute_pinned():
+        dead_rec = ((state.slab & DEAD_BIT) != 0) & (state.slab >= 0)
+        stale_done = state.age.astype(jnp.int32) > p.periods_to_sweep
+        holding = (
+            (state.age < p.periods_to_spread)
+            | (state.susp > 0)
+            | (dead_rec & ~stale_done & ~own_row)
+        )
+        return jnp.any(holding & state.alive[:, None], axis=0)
+
+    use_carry = (
+        state.wb_pinned is not None
+        and params.pallas_core
+        and "wb_mask" in params.pallas_fold
     )
-    pinned = jnp.any(holding & state.alive[:, None], axis=0)
+    if use_carry:
+        pinned = lax.cond(
+            state.wb_valid, lambda: state.wb_pinned, recompute_pinned
+        )
+    else:
+        pinned = recompute_pinned()
     freeing = active & ~pinned & gate
     wb_subj = jnp.where(freeing, state.slot_subj, n)
 
     def make_writeback():
+        dead_rec = ((state.slab & DEAD_BIT) != 0) & (state.slab >= 0)
+        stale_done = state.age.astype(jnp.int32) > p.periods_to_sweep
         demote = dead_rec & stale_done & ~own_row
         return jnp.where(demote, UNKNOWN_KEY, state.slab)
 
@@ -830,31 +911,53 @@ def sparse_tick(
     # verdict / accepted SYNC learning always strictly changes the record
     # (both accept tests require a lattice override), so the age resets
     # unconditionally at the written cell.
+    # ---------------- core-path routing (round-6 residual-fold ladder)
+    # ``fold`` decides which residual [N, S] pieces the fused kernel
+    # absorbs this tick; pieces left out (and the no-kernel path) keep
+    # their bit-identical XLA form — the fidelity oracle. Computed before
+    # step 4 because the 'points' piece moves the point-update
+    # where-passes into the kernel.
+    from scalecube_cluster_tpu.ops.pallas_sparse import SPARSE_GROUP
+
+    group = SPARSE_GROUP if n % SPARSE_GROUP == 0 else GROUP
+    use_kernel = (
+        params.pallas_core
+        and group == SPARSE_GROUP
+        and S % 128 == 0
+        and S < 4096  # packed-slot field width (ops/pallas_sparse.py)
+    )
+    fold = params.pallas_fold if use_kernel else frozenset()
+    need_wb = "wb_mask" in fold
+    need_rows = "view_rows" in fold
+
     slab0 = slab
+    age_pre = age
     fd_slot = jnp.where(fd_fire & (subj_slot[fd_tgt] >= 0), subj_slot[fd_tgt], -1)
     sy_slot = jnp.where(
         sy_accept & (subj_slot[sy_subj] >= 0), subj_slot[sy_subj], -1
     )
-    cell_fd = srange[None, :] == fd_slot[:, None]
-    cell_sy = srange[None, :] == sy_slot[:, None]
-    # SYNC wins a same-cell collision (it was applied second before).
-    slab = jnp.where(
-        cell_sy, sy_key[:, None], jnp.where(cell_fd, fd_key[:, None], slab)
-    )
-    # NOT redundant with step 6's changed-driven reset: the young-mask of
-    # THIS tick's delivery (step 5) reads this age, so the fresh verdict
-    # must already be young to gossip out in the same period — exactly the
-    # reference, where the FD event's record update precedes the next
-    # doSpreadGossip (MembershipProtocolImpl.java:376-404).
-    age = jnp.where(cell_sy | cell_fd, jnp.asarray(0, jnp.int8), age)
+    if "points" not in fold:
+        cell_fd = srange[None, :] == fd_slot[:, None]
+        cell_sy = srange[None, :] == sy_slot[:, None]
+        # SYNC wins a same-cell collision (it was applied second before).
+        slab = jnp.where(
+            cell_sy, sy_key[:, None], jnp.where(cell_fd, fd_key[:, None], slab)
+        )
+        # NOT redundant with step 6's changed-driven reset: the young-mask
+        # of THIS tick's delivery (step 5) reads this age, so the fresh
+        # verdict must already be young to gossip out in the same period —
+        # exactly the reference, where the FD event's record update
+        # precedes the next doSpreadGossip
+        # (MembershipProtocolImpl.java:376-404).
+        age = jnp.where(cell_sy | cell_fd, jnp.asarray(0, jnp.int8), age)
+    # Under the fold the kernel applies the points to its local block and
+    # its sender windows (sender-indexed scalar-prefetch lanes), so slab/
+    # age stay PRE-point here and no [N, S] where-pass materializes.
 
     # ------------------------------------------------- 5. gossip delivery
     # 32-row sender groups when n allows: the fused kernel's int8 age
     # windows need sublane-32 alignment, and both paths must consume the
     # SAME sampled edges so the pallas_core switch is bit-invisible.
-    from scalecube_cluster_tpu.ops.pallas_sparse import SPARSE_GROUP
-
-    group = SPARSE_GROUP if n % SPARSE_GROUP == 0 else GROUP
     inv_perm, ginv, rots = fanout_permutations_structured(
         k_gsel, n, p.gossip_fanout, group=group
     )
@@ -868,16 +971,12 @@ def sparse_tick(
     susp_in = susp  # post-load countdowns: what dead viewers keep frozen
     age_in = age  # post-point ages: this tick's young mask (metrics below)
 
-    use_kernel = (
-        params.pallas_core
-        and group == SPARSE_GROUP
-        and S % 128 == 0
-        and S < 4096  # packed-slot field width (ops/pallas_sparse.py)
-    )
+    aggr = None
+    merged = None  # non-None ⇒ the XLA sweep below owns step 6
     if use_kernel:
         from scalecube_cluster_tpu.ops.pallas_sparse import sparse_core_pallas
 
-        slab2, age, susp, self_rumor = sparse_core_pallas(
+        core = sparse_core_pallas(
             slab,
             age,
             susp_in,
@@ -888,10 +987,20 @@ def sparse_tick(
             alive,
             fd_slot,
             sy_slot,
+            fd_key,
+            sy_key,
             spread=p.periods_to_spread,
             susp_ticks=p.suspicion_ticks,
             age_stale=AGE_STALE,
+            sweep=p.periods_to_sweep,
+            fold=fold,
         )
+        if "countdown" in fold:
+            slab2, age, susp, self_rumor, aggr = core
+        else:
+            # Ladder root off: kernel = delivery+merge only; its age/susp
+            # outputs are passthroughs and the XLA sweep runs below.
+            merged, _, _, self_rumor, aggr = core
     else:
         young = age < p.periods_to_spread
         rows = jnp.where(young & active[None, :], slab, UNKNOWN_KEY)
@@ -914,10 +1023,14 @@ def sparse_tick(
         merged = jnp.where(active[None, :], merged, slab)
         merged = jnp.where(alive[:, None], merged, slab)
 
+    if merged is not None:
         # --------------------- 6. suspicion sweep (cancel-on-update form)
-        armed = susp > 0
+        # ``rearm`` compares against the PRE-point slab0: a point update
+        # always strictly raises its cell, so fresh verdicts rearm whether
+        # the points were applied here (step 4) or in-kernel.
+        armed = susp_in > 0
         rearm = merged != slab0
-        left0 = jnp.maximum(susp.astype(jnp.int32) - 1, 0)
+        left0 = jnp.maximum(susp_in.astype(jnp.int32) - 1, 0)
         expired = (
             alive[:, None]
             & armed
@@ -930,6 +1043,10 @@ def sparse_tick(
         dead_keys = (merged | DEAD_BIT) & ~jnp.int32(1)
         slab2 = jnp.where(expired, dead_keys, merged)
         changed = (slab2 != slab0) & alive[:, None] & active[None, :]
+        # ``age`` is post-point on the XLA path, pre-point under a
+        # points-fold-without-countdown kernel — identical result either
+        # way: every point cell is in ``changed`` (strict raise), and the
+        # else-branch only reads untouched cells.
         age = jnp.where(
             changed,
             jnp.asarray(0, jnp.int8),
@@ -945,6 +1062,26 @@ def sparse_tick(
         # the kernel's restore of its susp input.
         susp = jnp.where(alive[:, None], susp, susp_in)
 
+    # Per-slot aggregates from the kernel (round-6 'wb_mask'/'view_rows').
+    if need_wb or need_rows:
+        from scalecube_cluster_tpu.ops.pallas_sparse import (
+            AGGR_DEAD_BIT,
+            AGGR_HOLD_BIT,
+            AGGR_SUSPECT_BIT,
+        )
+
+        pin_k = ((aggr >> AGGR_HOLD_BIT) & 1).astype(bool)
+        seen_s_k = ((aggr >> AGGR_SUSPECT_BIT) & 1).astype(bool)
+        seen_d_k = ((aggr >> AGGR_DEAD_BIT) & 1).astype(bool)
+    # Post-core corrections accumulate here: steps 6.5/7 only make cells
+    # YOUNG (never un-hold a slot, never remove a suspect/dead record — the
+    # own record is never suspect/dead-unless-left, and leavers refuse
+    # refutation), so OR-ing their touched slots in keeps the carried masks
+    # exactly equal to a from-scratch recompute.
+    pin_extra = jnp.zeros((S,), bool)
+    seen_s_extra = jnp.zeros((S,), bool)
+    seen_d_extra = jnp.zeros((S,), bool)
+
     # ------------------------- 6.5 window SYNC application (cond-gated)
     # Applied AFTER the core so the fused kernel and the XLA chain share
     # this code path (bit-parity preserved without kernel surgery). The
@@ -958,7 +1095,7 @@ def sparse_tick(
     if W > 0:
 
         def _apply_window(args):
-            slab_a, age_a, susp_a = args
+            slab_a, age_a, susp_a, pin_e, ss_e, sd_e = args
             wslot = subj_slot[wsubj]
             safe = jnp.where(wslot >= 0, wslot, 0)
             cur = slab_a[:, safe]
@@ -982,10 +1119,28 @@ def sparse_tick(
                 susp_a[:, safe].astype(jnp.int32),
             ).astype(jnp.int16)
             susp_a = susp_a.at[:, route].set(new_susp, mode="drop")
-            return slab_a, age_a, susp_a
+            if need_wb or need_rows:
+                # Applied cells become young (age 0) at a live viewer, so
+                # their slot holds; the learned key may also be the slot's
+                # first suspect/dead record at a live viewer.
+                pin_e = pin_e.at[route].max(jnp.any(app, axis=0), mode="drop")
+                ss_e = ss_e.at[route].max(
+                    jnp.any(app & is_suspect_key(win_key), axis=0), mode="drop"
+                )
+                sd_e = sd_e.at[route].max(
+                    jnp.any(
+                        app & ((win_key & DEAD_BIT) != 0) & (win_key >= 0),
+                        axis=0,
+                    ),
+                    mode="drop",
+                )
+            return slab_a, age_a, susp_a, pin_e, ss_e, sd_e
 
-        slab2, age, susp = lax.cond(
-            do_sync, _apply_window, lambda a: a, (slab2, age, susp)
+        slab2, age, susp, pin_extra, seen_s_extra, seen_d_extra = lax.cond(
+            do_sync,
+            _apply_window,
+            lambda a: a,
+            (slab2, age, susp, pin_extra, seen_s_extra, seen_d_extra),
         )
 
     # --------------------------------------------------- 7. self-refutation
@@ -1016,6 +1171,13 @@ def sparse_tick(
     age = age.at[col, own_safe].set(
         jnp.where(threat, 0, age[col, own_safe])
     )
+    if need_wb:
+        # The refuted own record is young at a live viewer (threat ⇒ alive
+        # & has_own), pinning its slot. Refutation writes ALIVE keys, so
+        # the recorder masks need no correction here.
+        pin_extra = pin_extra.at[jnp.where(threat, own_slot, S)].max(
+            threat, mode="drop"
+        )
 
     # ------------------------------------------------- 8. user gossip
     # spreadGossip dissemination at working-set scale: the [N, G] arrays
@@ -1057,17 +1219,37 @@ def sparse_tick(
     # can never lose an event.
     lat_s, lat_d = state.lat_first_suspect, state.lat_first_dead
     if lat_s is not None:
-        live_rows = alive[:, None]
-        seen_s = jnp.any(is_suspect_key(slab2) & live_rows, axis=0)
-        seen_d = jnp.any(
-            ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0) & live_rows, axis=0
-        )
+        if need_rows:
+            # Round-6 'view_rows' fold: per-slot suspect/dead flags come
+            # from the kernel's aggregate output (plus the window-apply
+            # corrections) instead of two fresh [N, S] reductions.
+            seen_s = seen_s_k | seen_s_extra
+            seen_d = seen_d_k | seen_d_extra
+        else:
+            live_rows = alive[:, None]
+            seen_s = jnp.any(is_suspect_key(slab2) & live_rows, axis=0)
+            seen_d = jnp.any(
+                ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0) & live_rows, axis=0
+            )
         subj_safe = jnp.clip(slot_subj, 0, n - 1)
         first_s = seen_s & (slot_subj >= 0) & (lat_s[subj_safe] < 0)
         first_d = seen_d & (slot_subj >= 0) & (lat_d[subj_safe] < 0)
         # Active subjects are distinct across slots; non-events route OOB.
         lat_s = lat_s.at[jnp.where(first_s, slot_subj, n)].set(t, mode="drop")
         lat_d = lat_d.at[jnp.where(first_d, slot_subj, n)].set(t, mode="drop")
+
+    # Carry the write-back pin mask ('wb_mask' fold): the kernel evaluated
+    # the pin rule on this tick's outputs; the corrections above account
+    # for everything that touched the slab after the kernel ran. Without
+    # the fold the mask stays as-is and is flagged stale, so the next free
+    # decision recomputes (structure of the scan carry is fixed either way).
+    wb_pinned, wb_valid = state.wb_pinned, state.wb_valid
+    if wb_pinned is not None:
+        if need_wb:
+            wb_pinned = pin_k | pin_extra
+            wb_valid = jnp.ones((), bool)
+        else:
+            wb_valid = jnp.zeros((), bool)
 
     new_state = state.replace(
         view_T=view_T,
@@ -1085,13 +1267,34 @@ def sparse_tick(
         rng=rng_next,
         lat_first_suspect=lat_s,
         lat_first_dead=lat_d,
+        wb_pinned=wb_pinned,
+        wb_valid=wb_valid,
     )
     if not collect:
         return new_state, {"tick": t}
     # Recomputed from the outputs so both core paths share the formulas.
+    # When the points fold removed the XLA where-passes, the post-point
+    # sender view is rebuilt HERE, under collect=True only — the counters
+    # source from kernel outputs plus cheap recomputation, never from
+    # intermediates the bench (collect=False) graph would have to keep.
+    if "points" in fold:
+        cell_fd_m = srange[None, :] == fd_slot[:, None]
+        cell_sy_m = srange[None, :] == sy_slot[:, None]
+        slab_send = jnp.where(
+            cell_sy_m,
+            sy_key[:, None],
+            jnp.where(cell_fd_m, fd_key[:, None], slab0),
+        )
+        age_send = jnp.where(
+            cell_sy_m | cell_fd_m, jnp.asarray(0, jnp.int8), age_pre
+        )
+    else:
+        slab_send = slab
+        age_send = age_in
     is_susp2 = is_suspect_key(slab2)
     sender_active = jnp.any(
-        (age_in < p.periods_to_spread) & active[None, :] & (slab >= 0), axis=1
+        (age_send < p.periods_to_spread) & active[None, :] & (slab_send >= 0),
+        axis=1,
     )
     # Status-transition counters compare the post-load snapshot (slab0)
     # against the final slab: transitions INTO a status only, so tombstone
@@ -1184,11 +1387,16 @@ def writeback_free(params: SparseParams, state: SparseState) -> SparseState:
     run on a single chip (see SparseParams.in_scan_writeback).
     """
     freeing, wb_subj, make_writeback = _free_plan(params, state)
-    return state.replace(
+    out = state.replace(
         view_T=state.view_T.at[wb_subj, :].set(make_writeback().T, mode="drop"),
         slot_subj=jnp.where(freeing, -1, state.slot_subj),
         subj_slot=state.subj_slot.at[wb_subj].set(-1, mode="drop"),
     )
+    if out.wb_valid is not None:
+        # The frees changed the slot table; the carried pin mask is stale
+        # until the next kernel tick rewrites it.
+        out = out.replace(wb_valid=jnp.zeros((), bool))
+    return out
 
 
 def run_sparse_chunked(

@@ -6,6 +6,7 @@ design's protocol equivalence (VERDICT round-1 item 3). Slot bookkeeping
 invariants are asserted alongside.
 """
 
+import jax
 import jax.numpy as jnp
 import pytest
 
@@ -254,6 +255,114 @@ def test_pallas_core_matches_xla():
     assert bool(jnp.all(a.view_T == b.view_T))
     assert bool(jnp.all(a.slot_subj == b.slot_subj))
     assert bool(jnp.all(a.inc_self == b.inc_self))
+
+
+# Round-6 fold ladder (ops/pallas_sparse.py::FOLD_PIECES): every valid rung,
+# each independently bisectable. 'wb_mask'/'view_rows' require 'countdown'.
+FOLD_SUBSETS = [
+    frozenset(),
+    frozenset({"countdown"}),
+    frozenset({"countdown", "points"}),
+    frozenset({"countdown", "wb_mask"}),
+    frozenset({"countdown", "view_rows"}),
+    frozenset({"countdown", "points", "wb_mask", "view_rows"}),
+]
+
+_FOLD_N, _FOLD_TICKS, _FOLD_CHUNK = 32, 36, 12
+
+
+def _fold_run(S, pallas_core, fold):
+    """Certification scenario for the fold ladder: a killed member driven
+    through FD-fire ticks (period 2), SYNC ticks (period 10), host
+    write-back boundaries (chunks of 12) and the DEAD transition
+    (suspicion_ticks=12 < 36), under 10% loss, with the verdict-latency
+    recorder armed. Deterministic (seeded PRNG), so parity is bit-exact."""
+    n = _FOLD_N
+    p = dataclasses.replace(
+        sparse_params(n, suspicion_ticks=12),
+        slot_budget=S,
+        in_scan_writeback=False,
+        pallas_core=pallas_core,
+        pallas_fold=frozenset(fold),
+    )
+    st = kill_sparse(
+        init_sparse_full_view(n, S, record_latency=True), 5
+    )
+    st, tr = run_sparse_chunked(
+        p, st, plan=FaultPlan.uniform(loss_percent=10.0),
+        n_ticks=_FOLD_TICKS, chunk=_FOLD_CHUNK, collect=True,
+    )
+    return st, tr
+
+
+_fold_oracle_cache = {}
+
+
+def _fold_oracle(S):
+    if S not in _fold_oracle_cache:
+        _fold_oracle_cache[S] = _fold_run(S, pallas_core=False, fold=FOLD_SUBSETS[-1])
+    return _fold_oracle_cache[S]
+
+
+def _assert_fold_parity(a, tra, b, trb):
+    import numpy as np
+
+    for f in ("slab", "age", "susp", "view_T", "slot_subj", "subj_slot",
+              "inc_self", "epoch", "alive", "lat_first_suspect",
+              "lat_first_dead"):
+        assert bool(jnp.all(getattr(a, f) == getattr(b, f))), f
+    assert set(tra) == set(trb)
+    for key in sorted(tra):
+        assert np.array_equal(np.asarray(tra[key]), np.asarray(trb[key])), key
+
+
+@pytest.mark.parametrize(
+    "fold", FOLD_SUBSETS, ids=lambda f: "+".join(sorted(f)) or "none"
+)
+def test_pallas_fold_ladder_parity(fold):
+    """Each rung of the round-6 fold ladder is bit-identical to the XLA
+    chain — state AND collect=True counter timeline — on the certification
+    scenario (kill, loss, FD/SYNC cadence, write-back boundaries)."""
+    S = 512
+    a, tra = _fold_oracle(S)
+    b, trb = _fold_run(S, pallas_core=True, fold=fold)
+    _assert_fold_parity(a, tra, b, trb)
+    # The scenario really spans the protocol: the kill was convicted.
+    col5 = statuses(a)[:, 5]
+    assert bool(jnp.all(jnp.where(a.alive, (col5 == DEAD) | (col5 == UNKNOWN), True)))
+
+
+def test_pallas_fold_parity_wide_slab():
+    """Full fold ladder vs XLA at the bench-rung slab width (S=2048):
+    scalar-prefetch slot packing (12-bit lanes) and the [8, S] aggregate
+    output stay exact when lane indices exceed one tile."""
+    S = 2048
+    a, tra = _fold_oracle(S)
+    b, trb = _fold_run(S, pallas_core=True, fold=FOLD_SUBSETS[-1])
+    _assert_fold_parity(a, tra, b, trb)
+
+
+def test_wb_carry_matches_recompute():
+    """The carried kernel pin mask (wb_valid=1) frees exactly the slots the
+    from-scratch XLA pin rule would free."""
+    from scalecube_cluster_tpu.sim.sparse import _invalidate_wb
+
+    n = 32
+    p = dataclasses.replace(
+        sparse_params(n, slot_budget=128), in_scan_writeback=False,
+        pallas_core=True,
+    )
+    st = kill_sparse(init_sparse_full_view(n, p.slot_budget), 5)
+    st, _ = run_sparse_ticks(p, st, FaultPlan.uniform(loss_percent=10.0), 25)
+    assert bool(st.wb_valid)
+    # writeback_free donates its input buffers: give each call its own copy.
+    st2 = jax.tree_util.tree_map(lambda x: x.copy(), st)
+    a = writeback_free(p, st)
+    b = writeback_free(p, _invalidate_wb(st2))
+    for f in ("slot_subj", "subj_slot", "view_T", "slab", "age", "susp"):
+        assert bool(jnp.all(getattr(a, f) == getattr(b, f))), f
+    # Consuming the mask invalidates it; the next free recomputes.
+    assert not bool(a.wb_valid)
 
 
 def test_host_boundary_writeback_matches_protocol():

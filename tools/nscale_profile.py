@@ -6,12 +6,18 @@ is linear in n, so the growth lives somewhere else. This times, per n, each
 candidate in isolation with the bench methodology (jitted chunk scans,
 feed-back dependency, large-buffer sync):
 
-  full    — the engine tick (run_sparse_chunked, pallas_core=True)
+  full    — the engine tick (run_sparse_chunked, pallas_core=True, all folds)
+  fold    — the engine tick at each rung of the round-6 fold ladder
+            (xla, kernel+no-fold, countdown, +points, +wb_mask, all)
   kernel  — sparse_core_pallas alone under a scan
   select  — fanout_permutations_structured + perm_from_structured + link draws
   ring    — user_gossip_step_tracked alone (sender-side form)
 
-Usage: python tools/nscale_profile.py [piece...] [-- n...]
+Every measurement is also appended as an obs/export schema row
+(kind="nscale_piece", commit/platform/n/S-stamped) so runs are comparable
+across commits; human-readable lines go to stderr.
+
+Usage: python tools/nscale_profile.py [piece...] [--out PATH] [-- n...]
 Default pieces: full kernel select ring; default n: 24576 32768 40960 49152
 """
 
@@ -28,6 +34,7 @@ from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
 
 enable_repo_jax_cache()
 
+from scalecube_cluster_tpu.obs.export import append_jsonl, make_row, run_metadata
 from scalecube_cluster_tpu.ops.delivery import (
     fanout_permutations_structured,
     perm_from_structured,
@@ -48,14 +55,52 @@ if "--" in args:
     i = args.index("--")
     ns = [int(a) for a in args[i + 1 :]]
     args = args[:i]
+out_path = None
+if "--out" in args:
+    i = args.index("--out")
+    out_path = args[i + 1]
+    args = args[:i] + args[i + 2 :]
 pieces = args or ["full", "kernel", "select", "ring"]
 S, CHUNK, REPS, F, G, K = 2048, 48, 3, 3, 4, 16
+# CPU fold-attribution runs shrink the working set (interpret-mode Pallas):
+S = int(os.environ.get("SC_NSCALE_S", S))
+CHUNK = int(os.environ.get("SC_NSCALE_CHUNK", CHUNK))
+
+# The round-6 fold ladder, coarsest to finest: each rung adds one piece of
+# the residual [N,S] tick chain to the kernel.  "xla" is the oracle path.
+FOLD_RUNGS = [
+    ("xla", None),
+    ("nofold", frozenset()),
+    ("countdown", frozenset({"countdown"})),
+    ("points", frozenset({"countdown", "points"})),
+    ("wb_mask", frozenset({"countdown", "points", "wb_mask"})),
+    ("all", frozenset({"countdown", "points", "wb_mask", "view_rows"})),
+]
 
 print("devices:", jax.devices(), file=sys.stderr)
 plan = FaultPlan.uniform(loss_percent=5.0)
+rows: list[dict] = []
 
 
-def timed_scan(step, carry0, label, n):
+def emit(label: str, n: int, ms: float, **extra):
+    """Print a human line (stderr) and queue one schema row."""
+    print(
+        f"n={n:6d} {label:16s}: {ms:7.3f} ms/tick  ({ms / n * 1e6:6.3f} ns/member)",
+        file=sys.stderr,
+        flush=True,
+    )
+    payload = {
+        "piece": label,
+        "ms_per_tick": round(ms, 6),
+        "ns_per_member": round(ms / n * 1e6, 6),
+        "chunk": CHUNK,
+        "reps": REPS,
+        **extra,
+    }
+    rows.append(make_row("nscale_piece", payload, run_metadata(n=n, slot_budget=S)))
+
+
+def timed_scan(step, carry0, label, n, **extra):
     """jit a CHUNK-long scan of ``step``, feed carry back, steady-state min."""
     fn = jax.jit(
         lambda carry: jax.lax.scan(
@@ -70,35 +115,51 @@ def timed_scan(step, carry0, label, n):
         carry = fn(carry)
         jax.block_until_ready(carry)
         times.append(time.perf_counter() - t0)
-    ms = min(times) / CHUNK * 1e3
-    print(f"n={n:6d} {label:7s}: {ms:7.3f} ms/tick  ({ms / n * 1e6:6.3f} ns/member)",
-          flush=True)
+    emit(label, n, min(times) / CHUNK * 1e3, **extra)
+
+
+def timed_full(params, label, n, **extra):
+    """Time the whole engine tick via run_sparse_chunked (collect=False)."""
+    state = kill_sparse(init_sparse_full_view(n, S, record_latency=True), 7)
+    state, _ = run_sparse_chunked(params, state, plan, CHUNK, CHUNK, collect=False)
+    int(state.view_T[0, 0])
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        state, _ = run_sparse_chunked(params, state, plan, CHUNK, CHUNK, collect=False)
+        int(state.view_T[0, 0])
+        times.append(time.perf_counter() - t0)
+    emit(label, n, min(times) / CHUNK * 1e3, **extra)
+    del state
 
 
 for n in ns:
-    params = SparseParams.for_n(n, slot_budget=S, in_scan_writeback=False,
-                                pallas_core=True)
-    p = params.base
-
     if "full" in pieces:
-        state = kill_sparse(init_sparse_full_view(n, S), 7)
-        state, _ = run_sparse_chunked(params, state, plan, CHUNK, CHUNK, collect=False)
-        int(state.view_T[0, 0])
-        times = []
-        for _ in range(REPS):
-            t0 = time.perf_counter()
-            state, _ = run_sparse_chunked(params, state, plan, CHUNK, CHUNK,
-                                          collect=False)
-            int(state.view_T[0, 0])
-            times.append(time.perf_counter() - t0)
-        ms = min(times) / CHUNK * 1e3
-        print(f"n={n:6d} full   : {ms:7.3f} ms/tick  ({ms / n * 1e6:6.3f} ns/member)",
-              flush=True)
-        del state
+        params = SparseParams.for_n(
+            n, slot_budget=S, in_scan_writeback=False, pallas_core=True
+        )
+        timed_full(params, "full", n, fold="all")
+
+    if "fold" in pieces:
+        for rung, fold in FOLD_RUNGS:
+            if fold is None:
+                params = SparseParams.for_n(
+                    n, slot_budget=S, in_scan_writeback=False, pallas_core=False
+                )
+            else:
+                params = SparseParams.for_n(
+                    n,
+                    slot_budget=S,
+                    in_scan_writeback=False,
+                    pallas_core=True,
+                    pallas_fold=fold,
+                )
+            timed_full(params, f"fold:{rung}", n, fold=rung)
 
     if "kernel" in pieces:
         from scalecube_cluster_tpu.ops.pallas_sparse import sparse_core_pallas
 
+        p = SparseParams.for_n(n, slot_budget=S).base
         ks = jax.random.split(jax.random.key(1), 4)
         slab0 = jax.random.randint(ks[0], (n, S), 0, 1 << 20, jnp.int32)
         age0 = jax.random.randint(ks[1], (n, S), 0, 30).astype(jnp.int8)
@@ -110,7 +171,7 @@ for n in ns:
             slab, age, susp = carry
             _, ginv, rots = fanout_permutations_structured(key, n, F, group=32)
             edge_ok = jax.random.bernoulli(key, 0.95, (F, n))
-            slab, age, susp, _ = sparse_core_pallas(
+            slab, age, susp, _, _ = sparse_core_pallas(
                 slab, age, susp, slot_subj, ginv, rots, edge_ok,
                 jnp.ones((n,), bool), none_slot, none_slot,
                 spread=p.periods_to_spread, susp_ticks=p.suspicion_ticks,
@@ -154,3 +215,12 @@ for n in ns:
             return (useen, uage, uinf, uptr), None
 
         timed_scan(rstep, (useen0, uage0, uinf0, uptr0), "ring", n)
+
+if out_path:
+    append_jsonl(out_path, rows)
+    print(f"wrote {len(rows)} rows -> {out_path}", file=sys.stderr)
+else:
+    from scalecube_cluster_tpu.obs.export import jsonl_line
+
+    for row in rows:
+        print(jsonl_line(row))

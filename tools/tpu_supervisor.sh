@@ -96,7 +96,17 @@ PYEOF
   # headline S; 2048 is the round-3 control.
   timeout 900 python tools/s_sensitivity.py 32768 512 1024 2048 >>"$LOG" 2>&1
   sleep 10
-  timeout 900 python tools/nscale_profile.py full kernel select ring -- 32768 49152 >>"$LOG" 2>&1
+  timeout 900 python tools/nscale_profile.py full kernel select ring \
+    --out /root/repo/artifacts/nscale_r6.jsonl -- 32768 49152 >>"$LOG" 2>&1
+  sleep 10
+
+  echo "--- [3c/6] round-6 residual-fold attribution (S=512 headline) ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  # Per-rung engine-tick timings for the fold ladder (xla -> all folds) at
+  # the value-optimal rung. bench.py above already re-measures the headline
+  # with the rule-sized S (512 at 32768) and the folds default-on; this row
+  # attributes the win per piece. SC_NSCALE_S=512 matches the headline S.
+  SC_NSCALE_S=512 timeout 1200 python tools/nscale_profile.py fold \
+    --out /root/repo/artifacts/nscale_r6.jsonl -- 32768 >>"$LOG" 2>&1
   sleep 10
   cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
 
