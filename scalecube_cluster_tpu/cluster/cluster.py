@@ -161,6 +161,12 @@ class Cluster:
         # every protocol — the JMX-MBean equivalent (ClusterImpl.java:434-469)
         # on the obs/counters.py schema.
         counters = ProtocolCounters()
+        # A fault-injecting transport (testlib/network_emulator.py) reports
+        # its drops into the same counter block, so the host backend emits
+        # the sim engines' fault_blocked/fault_lost schema.
+        emulator = getattr(transport, "network_emulator", None)
+        if emulator is not None:
+            emulator.attach_counters(counters)
         transport = SenderAwareTransport(transport, local_member.address, counters)
         rng = random.Random(seed)
         # Epoch from the seed-driven rng: unique per run when unseeded (OS

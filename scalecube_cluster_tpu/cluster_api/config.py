@@ -116,6 +116,16 @@ class TransportConfig(_WithMixin):
     max_frame_length: int = 2 * 1024 * 1024
     #: Dotted path or registered name of the MessageCodec (None = default JSON).
     message_codec: str | None = None
+    #: Reconnect backoff for redials to a destination whose last dial FAILED
+    #: (the reference evicts broken connections and redials on next send,
+    #: TransportImpl.java:299-322; the backoff bounds the dial storm a dead
+    #: peer would otherwise draw from every FD/gossip period): delay doubles
+    #: from min to max per consecutive failure, with ±``jitter`` fractional
+    #: randomization so a cohort of senders doesn't redial in lockstep.
+    #: A successful connect resets the sequence. min=0 disables backoff.
+    reconnect_backoff_min_ms: int = 50
+    reconnect_backoff_max_ms: int = 2_000
+    reconnect_backoff_jitter: float = 0.2
 
     @classmethod
     def default_lan(cls) -> "TransportConfig":

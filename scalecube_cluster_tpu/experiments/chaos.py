@@ -1,0 +1,86 @@
+"""``python -m scalecube_cluster_tpu.experiments.chaos`` — seeded chaos soak.
+
+Samples random fault schedules (testlib/chaos.py), runs each through the
+scanned engines, and certifies the SWIM invariants (testlib/invariants.py).
+One line per trial; a violation prints its ``CHAOS-REPRO`` stamp — paste the
+seed back into ``--seed-start``/``--seeds 1`` (or ``chaos_trial`` directly)
+to replay the exact trajectory. Exit status is the number of violations.
+
+    python -m scalecube_cluster_tpu.experiments.chaos --cpu --seeds 25
+    python -m scalecube_cluster_tpu.experiments.chaos --n 64 --engines sparse
+
+``--out FILE`` appends each trial as schema-versioned JSONL (obs/export.py),
+so soak results can be committed/diffed like the experiment grid's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--seeds", type=int, default=10, help="number of seeds")
+    ap.add_argument("--seed-start", type=int, default=0)
+    ap.add_argument("--n", type=int, default=24, help="cluster size")
+    ap.add_argument(
+        "--engines",
+        default="dense,sparse",
+        help="comma list from {dense,sparse}",
+    )
+    ap.add_argument("--out", default=None, help="append JSONL rows to FILE")
+    ap.add_argument(
+        "--cpu", action="store_true", help="force the CPU backend"
+    )
+    args = ap.parse_args(argv)
+
+    if args.cpu:
+        # Must run before any other jax op; env vars alone don't stick on
+        # boxes with an installed TPU plugin (tests/conftest.py).
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from scalecube_cluster_tpu.obs.export import (
+        append_jsonl,
+        make_row,
+        run_metadata,
+    )
+    from scalecube_cluster_tpu.testlib.chaos import chaos_soak
+
+    engines = tuple(e for e in args.engines.split(",") if e)
+    seeds = range(args.seed_start, args.seed_start + args.seeds)
+
+    def emit(r: dict) -> None:
+        if r["ok"]:
+            print(
+                f"ok seed={r['seed']} engine={r['engine']} "
+                f"digest={r['digest']} conv={r['final_convergence']:.3f} "
+                f"blocked={r['fault_blocked']} lost={r['fault_lost']} "
+                f"kills={r['kills']} restarts={r['restarts']}"
+            )
+        else:
+            print(f"FAIL {r['reproducer']} :: {r['error']}")
+        sys.stdout.flush()
+
+    results = chaos_soak(seeds, args.n, engines=engines, on_result=emit)
+    failures = [r for r in results if not r["ok"]]
+    if args.out:
+        meta = run_metadata()
+        append_jsonl(args.out, [make_row("chaos", r, meta) for r in results])
+    print(
+        json.dumps(
+            {
+                "trials": len(results),
+                "violations": len(failures),
+                "reproducers": [r["reproducer"] for r in failures],
+            }
+        )
+    )
+    return len(failures)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

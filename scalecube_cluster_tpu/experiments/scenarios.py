@@ -36,6 +36,7 @@ from scalecube_cluster_tpu.cluster_api.member import MemberStatus
 from scalecube_cluster_tpu.ops.merge import decode_status
 from scalecube_cluster_tpu.sim import (
     FaultPlan,
+    ScheduleBuilder,
     SimParams,
     init_full_view,
     init_seeded,
@@ -94,7 +95,20 @@ def lossy_suspicion_scenario(
 
 
 def partition_recovery_scenario(n: int = 1000, minority_frac: float = 0.3) -> dict:
-    """Partition → suspicion-timeout removal → SYNC heal after reconnection."""
+    """Partition → suspicion-timeout removal → SYNC heal after reconnection.
+
+    The cut and the heal are segments of ONE :class:`FaultSchedule`
+    (sim/schedule.py) resolved inside the scanned tick loop, so the whole
+    scenario is a single ``run_chunked`` call — no host-side plan swap (and
+    no second executable) between the phases. Detection is read off the
+    collected traces: with every cross-partition cell non-ALIVE the
+    convergence metric sits exactly on the partition floor
+    ``(k² + (n-k)²)/n²`` (each side matches only itself), and
+    ``n_suspected == 0`` certifies the cells have progressed past SUSPECT
+    to DEAD/UNKNOWN — together equivalent to the old mid-state
+    cross-status check (tests/test_chaos.py pins trace identity against
+    the segmented two-call form on both engines).
+    """
     params = SimParams.from_cluster_config(n)
     k = int(n * minority_frac)
     side_a, side_b = list(range(k)), list(range(k, n))
@@ -112,23 +126,24 @@ def partition_recovery_scenario(n: int = 1000, minority_frac: float = 0.3) -> di
         + params.periods_to_sweep
         + 150
     )
-    state, _ = run_chunked(params, state, cut, seeds, hold)
-    cross = np.asarray(jax.device_get(decode_status(state.view)))[:k, k:]
-    detected = bool(
-        np.all(
-            (cross == int(MemberStatus.DEAD)) | (cross == int(MemberStatus.UNKNOWN))
-        )
+    heal = params.sync_period_ticks * 3 + 200
+    schedule = (
+        ScheduleBuilder(n)
+        .add_segment(0, cut)  # ticks 1..hold (global tick starts at 1)
+        .add_segment(hold + 1, FaultPlan.clean(n))
+        .build()
     )
-
-    state, traces = run_chunked(
-        params, state, FaultPlan.clean(n), seeds, params.sync_period_ticks * 3 + 200
-    )
+    state, traces = run_chunked(params, state, schedule, seeds, hold + heal)
+    conv = np.asarray(jax.device_get(traces["convergence"]))
+    n_susp = np.asarray(jax.device_get(traces["n_suspected"]))
+    floor = (k * k + (n - k) * (n - k)) / (n * n)
+    detected = bool(conv[hold - 1] <= floor + 1e-6 and n_susp[hold - 1] == 0)
     return {
         "scenario": "partition_recovery",
         "n": n,
         "minority": k,
         "partition_detected": detected,
-        "healed_convergence": _final(traces, "convergence"),
+        "healed_convergence": float(conv[-1]),
     }
 
 

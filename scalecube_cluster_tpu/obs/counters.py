@@ -22,6 +22,10 @@ from __future__ import annotations
 #   msgs_fd            FD wire messages sent (pings + relayed ping-reqs)
 #   msgs_sync          SYNC / SYNC_ACK messages sent
 #   msgs_gossip        gossip protocol messages sent
+#   fault_blocked      membership-plane messages dropped by a BLOCKED link
+#                      (FaultPlan.block / NetworkEmulator blockOutbound)
+#   fault_lost         membership-plane messages dropped by probabilistic
+#                      link loss (FaultPlan.loss / emulator loss_percent)
 SHARED_COUNTERS: tuple[str, ...] = (
     "pings",
     "ping_reqs",
@@ -33,15 +37,23 @@ SHARED_COUNTERS: tuple[str, ...] = (
     "msgs_fd",
     "msgs_sync",
     "msgs_gossip",
+    "fault_blocked",
+    "fault_lost",
 )
 
 # Emitted by the sparse engine only — they measure the compact working-set
 # machinery, which has no host-backend analog (a dict has no slots).
+# ``link_attempts`` / ``link_delivered`` complete the sim engines' per-tick
+# conservation split (attempts == delivered + fault_blocked + fault_lost,
+# checked by testlib/invariants.py); the host backend counts only the drop
+# sides, so the attempt totals stay sim-only.
 SIM_ONLY_COUNTERS: tuple[str, ...] = (
     "slot_activations",
     "slot_frees",
     "slot_overflow",
     "sync_window_accepts",
+    "link_attempts",
+    "link_delivered",
 )
 
 

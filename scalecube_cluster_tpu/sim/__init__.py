@@ -26,6 +26,7 @@ from scalecube_cluster_tpu.sim.monitor import (
     user_gossip_swept,
 )
 from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.schedule import FaultSchedule, ScheduleBuilder
 from scalecube_cluster_tpu.sim.state import (
     SimState,
     init_full_view,
@@ -41,6 +42,8 @@ from scalecube_cluster_tpu.sim.run import run_chunked, run_ticks, run_until
 
 __all__ = [
     "FaultPlan",
+    "FaultSchedule",
+    "ScheduleBuilder",
     "SimParams",
     "SimState",
     "cluster_summary",

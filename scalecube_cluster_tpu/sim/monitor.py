@@ -77,7 +77,7 @@ def cluster_summary(state: SimState) -> dict:
     }
 
 
-def sparse_summary(state) -> dict:
+def sparse_summary(state, traces=None) -> dict:
     """Whole-cluster aggregates for the compact-rumor engine
     (sim/sparse.py::SparseState) — the working-set twin of
     :func:`cluster_summary`, plus slot-table health (the metric the
@@ -86,6 +86,11 @@ def sparse_summary(state) -> dict:
     Reduces ON DEVICE and transfers only scalars — at the engine's target
     scale the slab is ~1 GB, so a host copy per monitoring call would
     dwarf the ticks being monitored.
+
+    Pass the run's collected ``traces`` to additionally surface the fault
+    accounting totals (``fault_blocked_total`` / ``fault_lost_total`` /
+    ``link_attempts_total`` / ``link_delivered_total`` — obs/counters.py
+    conservation split) over the traced window.
     """
     import jax.numpy as jnp
 
@@ -116,6 +121,19 @@ def sparse_summary(state) -> dict:
     out = {k: int(v) for k, v in jax.device_get(summary).items()}
     out["n"] = int(state.alive.size)
     out["slot_budget"] = int(state.slot_subj.size)
+    if traces is not None:
+        for key in (
+            "link_attempts",
+            "link_delivered",
+            "fault_blocked",
+            "fault_lost",
+        ):
+            if key in traces:
+                # Traces may already be host numpy (run_sparse_chunked) —
+                # sum host-side; python ints don't overflow.
+                out[f"{key}_total"] = int(
+                    np.sum(np.asarray(jax.device_get(traces[key])))
+                )
     return out
 
 

@@ -16,8 +16,17 @@ from functools import partial
 import jax
 from jax import lax
 
+import jax.numpy as jnp
+
 from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.schedule import (
+    FaultSchedule,
+    apply_events_dense,
+    events_at,
+    plan_at,
+    plan_dirty_at,
+)
 from scalecube_cluster_tpu.sim.state import SimState
 from scalecube_cluster_tpu.sim.tick import sim_tick
 
@@ -26,17 +35,38 @@ from scalecube_cluster_tpu.sim.tick import sim_tick
 def run_ticks(
     params: SimParams,
     state: SimState,
-    plan: FaultPlan,
+    plan: FaultPlan | FaultSchedule,
     seeds: jax.Array,
     n_ticks: int,
     collect: bool = True,
 ):
     """Run ``n_ticks`` gossip periods. Returns ``(final_state, metric_traces)``
     where each trace has leading axis ``n_ticks``. ``collect=False`` trims the
-    traces to the tick counter (benchmark mode)."""
+    traces to the tick counter (benchmark mode).
+
+    ``plan`` may be a fixed :class:`FaultPlan` or a :class:`FaultSchedule`
+    (sim/schedule.py): a scheduled run resolves the plan in force and applies
+    scripted kill/restart events at the top of every scanned tick — fault
+    transitions cost no host round trip and no recompile (the two plan forms
+    are distinct pytree treedefs, so each gets its own cached executable).
+    Scheduled traces additionally carry ``plan_dirty`` / ``kills_fired`` /
+    ``restarts_fired`` per tick for the invariant certifier."""
+    scheduled = isinstance(plan, FaultSchedule)
 
     def step(carry: SimState, _):
-        new_state, metrics = sim_tick(params, carry, plan, seeds, collect=collect)
+        if scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
+            t = carry.tick + 1  # the global tick about to execute
+            kill_m, restart_m = events_at(plan, t, params.n)
+            carry = apply_events_dense(carry, kill_m, restart_m)
+            plan_t = plan_at(plan, t)
+        else:
+            plan_t = plan
+        new_state, metrics = sim_tick(params, carry, plan_t, seeds, collect=collect)
+        if scheduled and collect:  # tpulint: disable=R1 -- both are trace-time constants (pytree type + static argname)
+            metrics = dict(metrics)
+            metrics["plan_dirty"] = plan_dirty_at(plan, t)
+            metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
+            metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
         return new_state, metrics
 
     return lax.scan(step, state, None, length=n_ticks)
@@ -45,7 +75,7 @@ def run_ticks(
 def run_chunked(
     params: SimParams,
     state: SimState,
-    plan: FaultPlan,
+    plan: FaultPlan | FaultSchedule,
     seeds: jax.Array,
     n_ticks: int,
     chunk: int = 50,
@@ -57,7 +87,9 @@ def run_chunked(
     compile. Returns ``(final_state, traces)`` with traces concatenated and
     trimmed to exactly ``n_ticks``; the state itself advances to the next
     chunk boundary (ceil(n_ticks/chunk)·chunk ticks — the cluster simply
-    keeps running a few periods longer)."""
+    keeps running a few periods longer). ``plan`` may be a
+    :class:`FaultSchedule` — segments are keyed by GLOBAL tick numbers, so
+    chunking never rebuilds or re-phases the timeline."""
     import numpy as np
 
     if chunk <= 0:

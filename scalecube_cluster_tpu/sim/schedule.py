@@ -1,0 +1,369 @@
+"""Time-varying fault timelines resolved inside the scanned tick loop.
+
+A :class:`FaultPlan` (sim/faults.py) is one *snapshot* of the emulated
+network; real chaos is a *timeline* — a partition that heals, a link that
+flaps on a square wave, a process that crashes and restarts mid-run. The host
+backend scripts such timelines imperatively against the NetworkEmulator
+(MembershipProtocolTest.java:94-263 flips settings between awaits); before
+this module the sim had to do the same by breaking out of ``lax.scan``,
+rebuilding a plan on the host and re-entering — one compiled call per fault
+transition (the old three-segment ``partition_recovery_scenario``).
+
+:class:`FaultSchedule` turns the timeline into static data:
+
+- **piecewise plans** — K segments, segment k active for
+  ``starts[k] <= t < starts[k+1]`` (the last segment is open-ended; ticks
+  before ``starts[0]`` clamp to segment 0). Each per-link matrix obeys the
+  same compact ``[1, 1]``-means-uniform rule as FaultPlan, per segment; the
+  builder broadcasts all segments to one common side M so the stacked
+  ``[K, M, M]`` gather stays shape-stable.
+- **flapping links** — per segment, an optional square wave: the links in
+  ``flap_mask[k]`` are additionally blocked while
+  ``(t - starts[k]) % flap_period[k] < flap_on[k]`` (the Rapid paper's
+  flip-flopping-link regime, arXiv:1803.03620 §6).
+- **scripted events** — E (tick, node, kind) records, kind 0 = kill,
+  kind 1 = restart, applied to the carried state at the *top* of the tick
+  (before the protocol step), vectorized twins of the host-side
+  ``sim.state.kill``/``restart`` ops.
+
+Everything is resolved per tick by :func:`plan_at` / :func:`events_at` with
+O(1) gathers — no host round trip, no recompile; the only static shapes are
+the segment count K, the event capacity E and the matrix side M.
+
+Scheduled-vs-segmented equivalence: resolving a schedule inside the scan
+consumes NO extra RNG and ticks keep their global numbering
+(``t = state.tick + 1`` across run calls), so a scheduled run is bit-identical
+to the equivalent sequence of fixed-plan runs with the same host-side
+kill/restart calls between them (pinned by tests/test_chaos.py). One
+documented deviation: host-side ``restart`` raises when a slot exhausts its
+:data:`~scalecube_cluster_tpu.ops.merge.EPOCH_MAX` epochs, while the in-scan
+twin cannot raise — the builder enforces the budget statically instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.tree_util import register_dataclass
+
+from scalecube_cluster_tpu.ops import merge as merge_ops
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.state import AGE_STALE, SimState
+
+#: Event kinds for ``FaultSchedule.ev_kind``.
+EV_KILL = 0
+EV_RESTART = 1
+
+
+@register_dataclass
+@dataclass
+class FaultSchedule:
+    """A piecewise fault timeline over global tick numbers.
+
+    Built by :class:`ScheduleBuilder`; consumed by the runners in
+    sim/run.py and sim/sparse.py, which accept it anywhere a
+    :class:`FaultPlan` is accepted (the pytree treedefs differ, so the two
+    forms compile to distinct — individually cached — executables).
+    """
+
+    starts: jax.Array  # [K] int32 segment start ticks, strictly increasing
+    block: jax.Array  # [K, M, M] bool (M may be 1: uniform per segment)
+    loss: jax.Array  # [K, M, M] float32
+    mean_delay: jax.Array  # [K, M, M] float32
+    flap_mask: jax.Array  # [K, M, M] bool links riding the square wave
+    flap_period: jax.Array  # [K] int32, 0 = no flapping in this segment
+    flap_on: jax.Array  # [K] int32 blocked-phase length in ticks
+    #: Precomputed per-segment "any fault possible" flags so per-tick
+    #: dirtiness is an O(1) gather, not an O(M^2) reduction (the sparse
+    #: engine must stay o(N^2) per tick even under a dense schedule).
+    seg_dirty: jax.Array  # [K] bool: block/loss/delay present in segment
+    flap_any: jax.Array  # [K] bool: flap_mask non-empty in segment
+    ev_tick: jax.Array  # [E] int32 global tick (-1 = unused slot)
+    ev_node: jax.Array  # [E] int32 member index
+    ev_kind: jax.Array  # [E] int32 EV_KILL | EV_RESTART
+
+    def replace(self, **changes) -> "FaultSchedule":
+        return dataclasses.replace(self, **changes)
+
+    @property
+    def n_segments(self) -> int:
+        return self.starts.shape[0]
+
+    def digest(self) -> str:
+        """Stable content hash for chaos reproducer lines (host-side)."""
+        h = hashlib.sha1()
+        for field in dataclasses.fields(self):
+            arr = np.asarray(getattr(self, field.name))
+            h.update(field.name.encode())
+            h.update(str(arr.shape).encode())
+            h.update(np.ascontiguousarray(arr).tobytes())
+        return h.hexdigest()[:12]
+
+
+def segment_at(schedule: FaultSchedule, t: jax.Array) -> jax.Array:
+    """Index of the segment active at global tick ``t`` (clamped)."""
+    seg = jnp.searchsorted(schedule.starts, t, side="right") - 1
+    return jnp.clip(seg, 0, schedule.starts.shape[0] - 1)
+
+
+def plan_at(schedule: FaultSchedule, t: jax.Array) -> FaultPlan:
+    """Resolve the :class:`FaultPlan` in force at global tick ``t``.
+
+    One gather per matrix plus the flap overlay — traced inside the tick
+    scan, so a fault transition is just the gather index moving.
+    """
+    k = segment_at(schedule, t)
+    block = schedule.block[k]
+    flap_active = (schedule.flap_period[k] > 0) & (
+        (t - schedule.starts[k]) % jnp.maximum(schedule.flap_period[k], 1)
+        < schedule.flap_on[k]
+    )
+    block = block | (schedule.flap_mask[k] & flap_active)
+    return FaultPlan(
+        block=block,
+        loss=schedule.loss[k],
+        mean_delay=schedule.mean_delay[k],
+    )
+
+
+def plan_dirty_at(schedule: FaultSchedule, t: jax.Array) -> jax.Array:
+    """Scalar bool: could ANY link fault fire at tick ``t``?
+
+    Uses the per-segment flags precomputed by the builder (block/loss/delay
+    presence, flap-mask presence gated on the wave being in its ON phase), so
+    the certifier's "clean tick" predicate costs O(1) regardless of M.
+    """
+    k = segment_at(schedule, t)
+    flap_active = (schedule.flap_period[k] > 0) & (
+        (t - schedule.starts[k]) % jnp.maximum(schedule.flap_period[k], 1)
+        < schedule.flap_on[k]
+    )
+    return schedule.seg_dirty[k] | (schedule.flap_any[k] & flap_active)
+
+
+def events_at(
+    schedule: FaultSchedule, t: jax.Array, n: int
+) -> tuple[jax.Array, jax.Array]:
+    """``(kill_mask, restart_mask)`` — bool [N] masks of events firing at
+    tick ``t`` (unused slots carry tick -1 and never fire)."""
+    fire = schedule.ev_tick == t
+    node = jnp.clip(schedule.ev_node, 0, n - 1)
+    zeros = jnp.zeros((n,), bool)
+    kill = zeros.at[node].max(fire & (schedule.ev_kind == EV_KILL))
+    restart = zeros.at[node].max(fire & (schedule.ev_kind == EV_RESTART))
+    return kill, restart
+
+
+def apply_events_dense(
+    state: SimState, kill_mask: jax.Array, restart_mask: jax.Array
+) -> SimState:
+    """In-scan vectorized twin of ``sim.state.kill`` / ``sim.state.restart``.
+
+    Applied at the top of a tick, before the protocol step — matching the
+    host-side convention where kill/restart run between jitted tick calls.
+    Events consume no RNG, so trajectories without events are untouched
+    bit-for-bit and scheduled runs stay identical to segmented ones.
+    """
+    n = state.view.shape[0]
+    any_ev = jnp.any(kill_mask | restart_mask)
+
+    def apply(state: SimState) -> SimState:
+        diag = jnp.eye(n, dtype=bool)
+        # Epoch budget: the host op raises past EPOCH_MAX; in-scan we clamp
+        # (the builder statically rejects schedules that would get here).
+        new_epoch = jnp.where(
+            restart_mask,
+            jnp.minimum(state.epoch + 1, merge_ops.EPOCH_MAX),
+            state.epoch,
+        )
+        zeros_n = jnp.zeros((n,), jnp.int32)
+        self_keys = merge_ops.encode_key(zeros_n, zeros_n, new_epoch)  # [N]
+        fresh_view = jnp.where(diag, self_keys[:, None], merge_ops.UNKNOWN_KEY)
+        fresh_age = jnp.where(diag, 0, AGE_STALE).astype(state.rumor_age.dtype)
+        row = restart_mask[:, None]
+        tracked = state.uinf.shape[1] == n
+        uinf = jnp.where(restart_mask[:, None, None], False, state.uinf)
+        if tracked:
+            uinf = jnp.where(restart_mask[None, :, None], False, uinf)
+        return state.replace(
+            alive=(state.alive & ~kill_mask) | restart_mask,
+            epoch=new_epoch,
+            inc_self=jnp.where(restart_mask, 0, state.inc_self),
+            view=jnp.where(row, fresh_view, state.view),
+            rumor_age=jnp.where(row, fresh_age, state.rumor_age),
+            suspect_left=jnp.where(
+                row, jnp.zeros((), state.suspect_left.dtype), state.suspect_left
+            ),
+            rows=jnp.where(row, fresh_view, state.rows),
+            known_cnt=jnp.where(restart_mask, 0, state.known_cnt),
+            useen=jnp.where(restart_mask[:, None], False, state.useen),
+            uinf=uinf,
+            # A restarted process has a fresh socket: in-flight copies TO it
+            # are lost; copies it sent keep flying (sim/state.py restart).
+            uflight=jnp.where(restart_mask[:, None, None], False, state.uflight),
+        )
+
+    return jax.lax.cond(any_ev, apply, lambda s: s, state)
+
+
+class ScheduleBuilder:
+    """Host-side assembly of a :class:`FaultSchedule`.
+
+    Usage::
+
+        sched = (
+            ScheduleBuilder(n)
+            .add_segment(1, FaultPlan.clean(n).partition(a, b))
+            .add_segment(500, FaultPlan.clean(n))
+            .kill(200, 7)
+            .restart(350, 7)
+            .build()
+        )
+
+    Segments may mix compact ``[1, 1]`` and dense ``[n, n]`` plans; the
+    builder broadcasts everything to the largest side present, so an
+    all-uniform schedule stays O(K) bytes.
+    """
+
+    def __init__(self, n: int):
+        self.n = int(n)
+        self._segments: list[tuple[int, FaultPlan, np.ndarray | None, int, int]] = []
+        self._events: list[tuple[int, int, int]] = []
+
+    def add_segment(
+        self,
+        start_tick: int,
+        plan: FaultPlan,
+        *,
+        flap_mask=None,
+        flap_period: int = 0,
+        flap_on: int = 0,
+    ) -> "ScheduleBuilder":
+        """Arm ``plan`` from global tick ``start_tick`` until the next
+        segment. Optional square-wave overlay: the links in ``flap_mask``
+        ([n, n] or [1, 1] bool) are blocked for the first ``flap_on`` ticks
+        of every ``flap_period``-tick window (phase anchored at
+        ``start_tick``)."""
+        if flap_period < 0 or flap_on < 0 or flap_on > flap_period:
+            raise ValueError(
+                f"need 0 <= flap_on <= flap_period, got {flap_on}/{flap_period}"
+            )
+        if (flap_period > 0) != (flap_mask is not None):
+            raise ValueError("flap_mask and flap_period come together")
+        mask = None if flap_mask is None else np.asarray(flap_mask, bool)
+        self._segments.append(
+            (int(start_tick), plan, mask, int(flap_period), int(flap_on))
+        )
+        return self
+
+    def kill(self, tick: int, node: int) -> "ScheduleBuilder":
+        """Hard-stop process ``node`` at the top of global tick ``tick``."""
+        self._events.append((int(tick), int(node), EV_KILL))
+        return self
+
+    def restart(self, tick: int, node: int) -> "ScheduleBuilder":
+        """Restart ``node`` as a fresh identity (epoch bump) at ``tick``."""
+        self._events.append((int(tick), int(node), EV_RESTART))
+        return self
+
+    def build(self, *, epoch0: np.ndarray | int = 0) -> FaultSchedule:
+        """Validate and freeze. ``epoch0`` (scalar or [n]) is the starting
+        epoch of the state the schedule will run against, used to enforce the
+        EPOCH_MAX restart budget statically."""
+        if not self._segments:
+            raise ValueError("a schedule needs at least one segment")
+        segs = sorted(self._segments, key=lambda s: s[0])
+        starts = [s[0] for s in segs]
+        if len(set(starts)) != len(starts):
+            raise ValueError(f"duplicate segment start ticks: {starts}")
+
+        sides = {1}
+        for _, plan, mask, _, _ in segs:
+            for m in (plan.block, plan.loss, plan.mean_delay):
+                if m.shape[0] not in (1, self.n) or m.shape[0] != m.shape[1]:
+                    raise ValueError(
+                        f"plan matrix side {m.shape} is neither [1,1] nor"
+                        f" [{self.n},{self.n}]"
+                    )
+                sides.add(int(m.shape[0]))
+            if mask is not None and mask.shape not in ((1, 1), (self.n, self.n)):
+                raise ValueError(f"flap_mask shape {mask.shape} invalid")
+        m_side = max(sides)
+
+        def bcast(mat, dtype) -> np.ndarray:
+            return np.broadcast_to(
+                np.asarray(mat, dtype), (m_side, m_side)
+            ).copy()
+
+        block = np.stack([bcast(p.block, bool) for _, p, _, _, _ in segs])
+        loss = np.stack([bcast(p.loss, np.float32) for _, p, _, _, _ in segs])
+        delay = np.stack(
+            [bcast(p.mean_delay, np.float32) for _, p, _, _, _ in segs]
+        )
+        flap = np.stack(
+            [
+                np.zeros((m_side, m_side), bool) if m is None else bcast(m, bool)
+                for _, _, m, _, _ in segs
+            ]
+        )
+        seg_dirty = np.array(
+            [
+                bool(b.any() or (l > 0).any() or (d > 0).any())
+                for b, l, d in zip(block, loss, delay)
+            ]
+        )
+        flap_any = np.array([bool(m.any()) for m in flap])
+
+        by_tick_node: dict[tuple[int, int], int] = {}
+        restarts_per_node: dict[int, int] = {}
+        for tick, node, kind in self._events:
+            if tick < 1:
+                raise ValueError(f"event tick {tick} precedes the first tick")
+            if not 0 <= node < self.n:
+                raise ValueError(f"event node {node} outside [0, {self.n})")
+            if (tick, node) in by_tick_node:
+                raise ValueError(
+                    f"node {node} has two events at tick {tick}"
+                    " (kill+restart the same tick is ambiguous)"
+                )
+            by_tick_node[(tick, node)] = kind
+            if kind == EV_RESTART:
+                restarts_per_node[node] = restarts_per_node.get(node, 0) + 1
+        e0 = np.broadcast_to(np.asarray(epoch0, np.int32), (self.n,))
+        for node, count in restarts_per_node.items():
+            if int(e0[node]) + count > merge_ops.EPOCH_MAX:
+                raise ValueError(
+                    f"node {node}: {count} scheduled restarts exhaust the"
+                    f" {merge_ops.EPOCH_MAX}-epoch budget (start epoch"
+                    f" {int(e0[node])})"
+                )
+
+        events = sorted(self._events)
+        n_ev = max(1, len(events))  # at least one (inert) slot: static shape
+        ev_tick = np.full((n_ev,), -1, np.int32)
+        ev_node = np.zeros((n_ev,), np.int32)
+        ev_kind = np.zeros((n_ev,), np.int32)
+        for i, (tick, node, kind) in enumerate(events):
+            ev_tick[i], ev_node[i], ev_kind[i] = tick, node, kind
+
+        return FaultSchedule(
+            starts=jnp.asarray(starts, jnp.int32),
+            block=jnp.asarray(block),
+            loss=jnp.asarray(loss),
+            mean_delay=jnp.asarray(delay),
+            flap_mask=jnp.asarray(flap),
+            flap_period=jnp.asarray(
+                [s[3] for s in segs], jnp.int32
+            ),
+            flap_on=jnp.asarray([s[4] for s in segs], jnp.int32),
+            seg_dirty=jnp.asarray(seg_dirty),
+            flap_any=jnp.asarray(flap_any),
+            ev_tick=jnp.asarray(ev_tick),
+            ev_node=jnp.asarray(ev_node),
+            ev_kind=jnp.asarray(ev_kind),
+        )

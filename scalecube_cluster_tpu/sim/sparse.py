@@ -87,6 +87,7 @@ from scalecube_cluster_tpu.sim.usergossip import (
 )
 from scalecube_cluster_tpu.ops.merge import (
     DEAD_BIT,
+    EPOCH_MAX,
     UNKNOWN_KEY,
     decode_epoch,
     decode_incarnation,
@@ -98,9 +99,21 @@ from scalecube_cluster_tpu.ops.merge import (
     overrides_same_epoch,
 )
 from scalecube_cluster_tpu.ops.select import probe_cursor_targets
-from scalecube_cluster_tpu.sim.faults import FaultPlan, link_pass, round_trip_in_time
+from scalecube_cluster_tpu.sim.faults import (
+    FaultPlan,
+    _edge_lookup,
+    link_pass,
+    round_trip_in_time,
+)
 from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.schedule import (
+    FaultSchedule,
+    events_at,
+    plan_at,
+    plan_dirty_at,
+)
 from scalecube_cluster_tpu.sim.state import AGE_STALE
+from scalecube_cluster_tpu.sim.tick import _acct_add, _acct_zero, _link_acct
 
 def sync_accept(learned, mine):
     """Merge-lattice accept test for SYNC-learned records (broadcast-poly).
@@ -569,6 +582,74 @@ def restart_many_sparse(state: SparseState, idxs) -> SparseState:
     )
 
 
+def apply_events_sparse(
+    state: SparseState, kill_mask: jax.Array, restart_mask: jax.Array
+) -> SparseState:
+    """In-scan scheduled kill/restart for the sparse engine (sim/schedule.py).
+
+    Kill matches :func:`kill_sparse` exactly. Restart is the
+    **fast-restart-with-persistence** model — a documented deviation from
+    the host op :func:`restart_many_sparse`, which copies a live seed
+    viewer's whole table into the restarted slot (the initial-sync outcome
+    as a host op; an O(N) column copy plus host slot bookkeeping, neither of
+    which belongs inside the scan). Here the restarted process keeps its
+    pre-crash table on disk (its view_T column and slab row stay), comes
+    back with epoch+1 / incarnation 0, forgets its user-gossip state, and
+    announces the new identity through the normal slot-activation path
+    (sparse_tick step 3) — the anti-entropy lattice heals any staleness the
+    kept table carries, exactly as it does for a partitioned node. Events
+    consume no RNG, so event-free schedule ticks are bit-identical to
+    fixed-plan ticks.
+
+    The epoch bump clamps at EPOCH_MAX instead of raising (no host control
+    flow in-scan); ScheduleBuilder enforces the restart budget statically.
+    """
+    n = state.alive.shape[0]
+    any_ev = jnp.any(kill_mask | restart_mask)
+
+    def apply(state: SparseState) -> SparseState:
+        new_epoch = jnp.where(
+            restart_mask, jnp.minimum(state.epoch + 1, EPOCH_MAX), state.epoch
+        )
+        uinf_ids = state.uinf_ids
+        if uinf_ids.shape[2] > 0:
+            # A restarted sender is a new identity: scrub it from every
+            # suppression ring, and clear the node's own rings.
+            hit = (uinf_ids >= 0) & restart_mask[jnp.clip(uinf_ids, 0, n - 1)]
+            uinf_ids = jnp.where(hit, -1, uinf_ids)
+            uinf_ids = jnp.where(restart_mask[:, None, None], -1, uinf_ids)
+        st = state.replace(
+            alive=(state.alive & ~kill_mask) | restart_mask,
+            epoch=new_epoch,
+            inc_self=jnp.where(restart_mask, 0, state.inc_self),
+            # The restarted node's working row restarts cold: nothing young,
+            # no armed timers (its pre-crash countdowns died with it).
+            age=jnp.where(
+                restart_mask[:, None], jnp.asarray(AGE_STALE, jnp.int8), state.age
+            ),
+            susp=jnp.where(
+                restart_mask[:, None], jnp.asarray(0, jnp.int16), state.susp
+            ),
+            useen=jnp.where(restart_mask[:, None], False, state.useen),
+            uptr=jnp.where(restart_mask[:, None], 0, state.uptr),
+            uinf_ids=uinf_ids,
+        )
+        if st.lat_first_suspect is not None:
+            st = st.replace(
+                lat_first_suspect=jnp.where(
+                    restart_mask, -1, st.lat_first_suspect
+                ),
+                lat_first_dead=jnp.where(restart_mask, -1, st.lat_first_dead),
+            )
+        if st.wb_valid is not None:
+            # alive/age/susp changed: the carried pin mask is stale
+            # (the in-scan twin of _invalidate_wb).
+            st = st.replace(wb_valid=jnp.zeros((), bool))
+        return st
+
+    return lax.cond(any_ev, apply, lambda s: s, state)
+
+
 def _free_plan(params: SparseParams, state: SparseState, gate=True):
     """THE slot free/write-back rule, shared by the in-scan path and the
     host-boundary :func:`writeback_free` so the two modes cannot diverge.
@@ -635,12 +716,25 @@ def sparse_tick(
     state: SparseState,
     plan: FaultPlan,
     collect: bool = True,
+    events=None,
 ):
-    """One gossip period on the working set. Returns ``(state, metrics)``."""
+    """One gossip period on the working set. Returns ``(state, metrics)``.
+
+    ``events`` is ``None`` (no scheduled events — the default graph, traced
+    structure unchanged) or a ``(kill_mask, restart_mask)`` pair of [N]
+    bools from sim/schedule.py::events_at, applied before the tick body
+    (:func:`apply_events_sparse`); a restarted node additionally requests
+    its own slot through the step-3 activation path and announces its
+    bumped-epoch identity there. Events consume no RNG, so an event-free
+    scheduled tick is bit-identical to the fixed-plan tick.
+    """
     p = params.base
     n, S = p.n, params.slot_budget
     if n % GROUP != 0:
         raise ValueError("sparse engine needs n % 8 == 0 (structured fan-out)")
+    if events is not None:
+        state = apply_events_sparse(state, events[0], events[1])
+        restart_m = events[1]
     t = state.tick + 1
     (rng_next, k_tgt, k_ping, k_relay, k_gsel, k_glink, k_ssel, k_slink) = (
         jax.random.split(state.rng, 8)
@@ -689,12 +783,11 @@ def sparse_tick(
             & (rkey >= 0)
             & ((rkey & DEAD_BIT) == 0)
         )
-        legs = (
-            link_pass(rk1, plan, col[:, None], ridx)
-            & link_pass(rk2, plan, ridx, tgt[:, None])
-            & link_pass(rk3, plan, tgt[:, None], ridx)
-            & link_pass(rk4, plan, ridx, col[:, None])
-        )
+        leg_or = link_pass(rk1, plan, col[:, None], ridx)  # origin->relay
+        leg_rt = link_pass(rk2, plan, ridx, tgt[:, None])  # relay->target
+        leg_tr = link_pass(rk3, plan, tgt[:, None], ridx)  # target->relay
+        leg_ro = link_pass(rk4, plan, ridx, col[:, None])  # relay->origin
+        legs = leg_or & leg_rt & leg_tr & leg_ro
         path_ok = round_trip_in_time(
             rk5,
             plan,
@@ -712,13 +805,36 @@ def sparse_tick(
         )
         fire = ((probing & ~reached) | gone) & overrides_same_epoch(fd_key, vkey)
         n_pings = jnp.sum(probing)
-        n_ping_reqs = jnp.sum((probing & ~direct)[:, None] & rvalid)
+        req_att = (probing & ~direct)[:, None] & rvalid
+        n_ping_reqs = jnp.sum(req_att)
         msgs = n_pings + n_ping_reqs
         out = (tgt, fd_key, fire, msgs)
         if collect:
             # Flight-recorder extras ride the same cond; gated at trace time
             # on the STATIC collect flag so the bench graph is unchanged.
-            out = out + (n_pings, n_ping_reqs, jnp.sum(reached))
+            # Fault accounting mirrors tick.py::_fd_vectors exactly: each
+            # wire message is delivered, blocked, or lost; the deadline
+            # draws (rt_ok/path_ok) are late deliveries, not drops.
+            blk_fwd = _edge_lookup(plan.block, col, tgt)
+            blk_ack = _edge_lookup(plan.block, tgt, col)
+            ack_att = probing & fwd_ok & alive[tgt]
+            blk1 = _edge_lookup(plan.block, col[:, None], ridx)
+            blk2 = _edge_lookup(plan.block, ridx, tgt[:, None])
+            blk3 = _edge_lookup(plan.block, tgt[:, None], ridx)
+            blk4 = _edge_lookup(plan.block, ridx, col[:, None])
+            att1 = req_att
+            att2 = att1 & leg_or & alive[ridx]
+            att3 = att2 & leg_rt & alive[tgt][:, None]
+            att4 = att3 & leg_tr
+            acct = _acct_add(
+                _link_acct(probing, blk_fwd, fwd_ok),
+                _link_acct(ack_att, blk_ack, ack_ok),
+                _link_acct(att1, blk1, leg_or),
+                _link_acct(att2, blk2, leg_rt),
+                _link_acct(att3, blk3, leg_tr),
+                _link_acct(att4, blk4, leg_ro),
+            )
+            out = out + (n_pings, n_ping_reqs, jnp.sum(reached)) + acct
         return out
 
     def fd_skip_phase(_):
@@ -730,7 +846,7 @@ def sparse_tick(
         )
         if collect:
             zero = jnp.asarray(0, jnp.int32)
-            out = out + (zero, zero, zero)
+            out = out + (zero, zero, zero) + _acct_zero()
         return out
 
     fd_out = lax.cond(do_fd, fd_fire_phase, fd_skip_phase, None)
@@ -742,12 +858,8 @@ def sparse_tick(
     # about the partner subjects.
     def sync_fire_phase(_):
         prt = jax.random.randint(k_ssel, (n,), 0, n, jnp.int32)
-        ok = (
-            alive
-            & alive[prt]
-            & (prt != col)
-            & link_pass(k_slink, plan, col, prt)
-        )
+        s_pass = link_pass(k_slink, plan, col, prt)
+        ok = alive & alive[prt] & (prt != col) & s_pass
         # I learn the partner's ACTUAL own-record — which may be a leave
         # tombstone (DEAD at the bumped incarnation, sim/sparse.py::
         # leave_sparse); synthesizing ALIVE here would resurrect graceful
@@ -778,11 +890,21 @@ def sparse_tick(
             )
         else:
             learned_w, accept_w, self_win = _window_zeros()
-        return prt, learned_key, accept, jnp.sum(ok) * 2, learned_w, accept_w, self_win
+        out = (prt, learned_key, accept, jnp.sum(ok) * 2, learned_w, accept_w, self_win)
+        if collect:
+            # Fault accounting: the forward leg is a real link draw; the
+            # reverse reply rides the SAME draw (module deviation 2 — one
+            # draw covers both directions), so a reverse attempt exists iff
+            # the exchange happened (``ok``) and is always delivered.
+            att_f = alive & (prt != col)
+            acct_f = _link_acct(att_f, _edge_lookup(plan.block, col, prt), s_pass)
+            n_rev = jnp.sum(ok, dtype=jnp.int32)
+            out = out + (acct_f[0] + n_rev, acct_f[1] + n_rev, acct_f[2], acct_f[3])
+        return out
 
     def sync_skip_phase(_):
         learned_w, accept_w, self_win = _window_zeros()
-        return (
+        out = (
             jnp.zeros((n,), jnp.int32),
             jnp.zeros((n,), jnp.int32),
             jnp.zeros((n,), bool),
@@ -791,6 +913,9 @@ def sparse_tick(
             accept_w,
             self_win,
         )
+        if collect:
+            out = out + _acct_zero()
+        return out
 
     # Rotating global window: full table coverage every ceil(n/W) sync
     # periods; W <= n keeps in-window subjects distinct (wrap at the last
@@ -807,9 +932,8 @@ def sparse_tick(
             jnp.full((n,), UNKNOWN_KEY, jnp.int32),
         )
 
-    (sy_subj, sy_key, sy_accept, msgs_sync, win_key, win_accept, self_win) = lax.cond(
-        do_sync, sync_fire_phase, sync_skip_phase, None
-    )
+    sy_out = lax.cond(do_sync, sync_fire_phase, sync_skip_phase, None)
+    (sy_subj, sy_key, sy_accept, msgs_sync, win_key, win_accept, self_win) = sy_out[:7]
 
     # -------------------------------------------- 3. slot free + allocation
     # A slot stays pinned while any LIVE viewer still has (a) a young copy,
@@ -864,6 +988,13 @@ def sparse_tick(
             & ((st_w == _SUSPECT) | (st_w == _DEAD))
         )
         req = req | self_threat_pre
+    if events is not None:
+        # A restarted node must announce its new identity: request its own
+        # subject's slot so the post-load announce below has a cell to
+        # write. May lose the alloc_cap race under contention — the next
+        # FD/SYNC touch re-requests (the chaos sampler caps restarts per
+        # tick at alloc_cap so scheduled restarts always land).
+        req = req | restart_m
     req = req & (subj_slot < 0)
     # Rank requests; grant the first alloc_cap into the first free slots.
     cap = params.alloc_cap
@@ -902,6 +1033,27 @@ def sparse_tick(
         (state.slab, state.age, state.susp),
     )
     active = slot_subj >= 0
+
+    if events is not None:
+        # Restart self-announce: the restarted node writes its bumped-epoch
+        # ALIVE key into its own row's own-subject cell, young (age 0) so it
+        # gossips out this very tick — the sparse twin of the fresh
+        # self-record a dense restart seeds. Placed BEFORE the slab0
+        # snapshot: the announcement is part of the event, not a tick
+        # verdict, so it must not count as verdicts_alive (dense parity —
+        # events there apply before sim_tick entirely).
+        r_slot = subj_slot[col]
+        r_fire = restart_m & (r_slot >= 0)
+        r_safe = jnp.where(r_fire, r_slot, 0)
+        r_key = encode_key(
+            jnp.full((n,), _ALIVE, jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+            state.epoch,
+        )
+        slab = slab.at[col, r_safe].set(jnp.where(r_fire, r_key, slab[col, r_safe]))
+        age = age.at[col, r_safe].set(
+            jnp.where(r_fire, jnp.asarray(0, jnp.int8), age[col, r_safe])
+        )
 
     # ------------------------------ 4. apply FD verdicts + SYNC learnings
     # Both are per-viewer single-slot updates; as fused [N, S] where-passes
@@ -962,11 +1114,11 @@ def sparse_tick(
         k_gsel, n, p.gossip_fanout, group=group
     )
     lks = jax.random.split(k_glink, p.gossip_fanout)
+    gpass = [
+        link_pass(lks[c], plan, inv_perm[c], col) for c in range(p.gossip_fanout)
+    ]
     edge_ok = jnp.stack(
-        [
-            alive[inv_perm[c]] & link_pass(lks[c], plan, inv_perm[c], col)
-            for c in range(p.gossip_fanout)
-        ]
+        [alive[inv_perm[c]] & gpass[c] for c in range(p.gossip_fanout)]
     )
     susp_in = susp  # post-load countdowns: what dead viewers keep frozen
     age_in = age  # post-point ages: this tick's young mask (metrics below)
@@ -1301,7 +1453,21 @@ def sparse_tick(
     # demotion timing (write-back here vs in-tick sweep in the dense
     # engine) cannot skew cross-engine parity. Newly loaded slots baseline
     # at their stale view_T record, matching the dense cell's history.
-    fd_pings, fd_ping_reqs, fd_acks = fd_out[4:]
+    fd_pings, fd_ping_reqs, fd_acks = fd_out[4:7]
+    # Conservation accounting: FD + SYNC legs rode their conds; the gossip
+    # plane is re-attributed here from the same draws (gpass). User gossip
+    # rides membership fan-out edges and is excluded (membership plane only,
+    # matching the dense engine).
+    g_acct = _acct_zero()
+    for c in range(p.gossip_fanout):
+        g_att = (
+            sender_active[inv_perm[c]]
+            & alive[inv_perm[c]]
+            & (inv_perm[c] != col)
+        )
+        g_blk = _edge_lookup(plan.block, inv_perm[c], col)
+        g_acct = _acct_add(g_acct, _link_acct(g_att, g_blk, gpass[c]))
+    acct = _acct_add(fd_out[7:], g_acct, sy_out[7:])
     viewer_live = alive[:, None] & active[None, :]
     was_dead = ((slab0 & DEAD_BIT) != 0) & (slab0 >= 0)
     now_dead = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
@@ -1343,6 +1509,15 @@ def sparse_tick(
             jnp.sum(freeing) if freeing is not None else jnp.asarray(0, jnp.int32)
         ),
         "sync_window_accepts": jnp.sum(win_accept),
+        # Fault-conservation split (certifier invariant:
+        # attempts == delivered + blocked + lost, every tick).
+        "link_attempts": acct[0],
+        "link_delivered": acct[1],
+        "fault_blocked": acct[2],
+        "fault_lost": acct[3],
+        # Monotonicity witnesses for the invariant certifier.
+        "inc_max": jnp.max(inc_self),
+        "epoch_max": jnp.max(state.epoch),
     }
     return new_state, metrics
 
@@ -1353,7 +1528,7 @@ def sparse_tick(
 def run_sparse_ticks(
     params: SparseParams,
     state: SparseState,
-    plan: FaultPlan,
+    plan: FaultPlan | FaultSchedule,
     n_ticks: int,
     collect: bool = True,
 ):
@@ -1365,15 +1540,36 @@ def run_sparse_ticks(
     without frees saturates the slot table and drops new rumors (visible as
     a climbing ``slot_overflow`` metric).
 
+    ``plan`` may be a fixed :class:`FaultPlan` or a :class:`FaultSchedule`
+    (sim/schedule.py): scheduled runs resolve the plan in force and apply
+    scripted kill/restart events inside every scanned tick — no host round
+    trip, no recompile (the two plan forms are distinct pytree treedefs, so
+    each keeps its own cached executable). Scheduled collected traces add
+    ``plan_dirty`` / ``kills_fired`` / ``restarts_fired`` per tick.
+
     The input state is DONATED (its buffers are reused for the output) — at
     100k members the view_T alone is ~40 GB, so holding input + output
     copies would double the footprint. Rebind the result over the input
     (``st, tr = run_sparse_ticks(p, st, ...)``) and never touch the old
     reference.
     """
+    scheduled = isinstance(plan, FaultSchedule)
 
     def step(carry, _):
-        return sparse_tick(params, carry, plan, collect=collect)
+        if not scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
+            return sparse_tick(params, carry, plan, collect=collect)
+        t = carry.tick + 1  # the global tick about to execute
+        kill_m, restart_m = events_at(plan, t, params.base.n)
+        plan_t = plan_at(plan, t)
+        new_state, metrics = sparse_tick(
+            params, carry, plan_t, collect=collect, events=(kill_m, restart_m)
+        )
+        if collect:
+            metrics = dict(metrics)
+            metrics["plan_dirty"] = plan_dirty_at(plan, t)
+            metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
+            metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+        return new_state, metrics
 
     return lax.scan(step, state, None, length=n_ticks)
 
@@ -1402,12 +1598,16 @@ def writeback_free(params: SparseParams, state: SparseState) -> SparseState:
 def run_sparse_chunked(
     params: SparseParams,
     state: SparseState,
-    plan: FaultPlan,
+    plan: FaultPlan | FaultSchedule,
     n_ticks: int,
     chunk: int = 48,
     collect: bool = True,
 ):
     """Scan in chunks with host-boundary slot frees between them.
+
+    ``plan`` may be a :class:`FaultSchedule` — segments and events are keyed
+    by GLOBAL tick numbers (``state.tick``), so chunk boundaries never
+    rebuild or re-phase the timeline.
 
     The big-n driver: build ``params`` with ``in_scan_writeback=False`` so
     the scan holds a single view_T buffer, then frees amortize to once per

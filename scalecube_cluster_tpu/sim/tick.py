@@ -115,6 +115,7 @@ from scalecube_cluster_tpu.ops.select import (
 )
 from scalecube_cluster_tpu.sim.faults import (
     FaultPlan,
+    _edge_lookup,
     link_delay_within_tick,
     link_pass,
     round_trip_in_time,
@@ -128,8 +129,33 @@ _SUSPECT = int(MemberStatus.SUSPECT)
 _DEAD = int(MemberStatus.DEAD)
 
 
-def _fd_vectors(params, state, plan, keys, cand, view0, fd_round):
-    """One FD round as per-row vectors: ``(tgt, fd_key, fire, msgs)``.
+def _link_acct(att, blk, passed):
+    """Fault-conservation split of one message channel: ``att`` messages are
+    sent; each is delivered, blocked, or lost to the loss draw — the three
+    outcomes partition the attempts (``passed = ~blk & survived-loss`` by
+    link_pass construction), which is the counter-conservation invariant the
+    certifier replays (testlib/invariants.py). Returns int32
+    ``(attempts, delivered, blocked, lost)``."""
+    return (
+        jnp.sum(att, dtype=jnp.int32),
+        jnp.sum(att & passed, dtype=jnp.int32),
+        jnp.sum(att & blk, dtype=jnp.int32),
+        jnp.sum(att & ~blk & ~passed, dtype=jnp.int32),
+    )
+
+
+def _acct_add(*accts):
+    return tuple(sum(parts) for parts in zip(*accts))
+
+
+def _acct_zero():
+    # Built lazily (not at import) so importing the module never touches a
+    # device backend.
+    return tuple(jnp.zeros((), jnp.int32) for _ in range(4))
+
+
+def _fd_vectors(params, state, plan, keys, cand, view0, fd_round, collect):
+    """One FD round as per-row vectors: ``(tgt, fd_key, fire, msgs, extras)``.
 
     The whole doPing/doPingReq flow (FailureDetectorImpl.java:126-209) runs
     on [N]-sized data: each node's probe target, the ack-carried verdict key,
@@ -213,8 +239,50 @@ def _fd_vectors(params, state, plan, keys, cand, view0, fd_round):
     # both; an existing DEAD record stays sticky.
     accept = (vkey >= 0) & overrides_same_epoch(fd_key, vkey)
     fire = fd_fire & accept
-    msgs = jnp.sum(probing) + jnp.sum((probing & ~direct_reach)[:, None] & rvalid)
-    return tgt, fd_key, fire, msgs
+    req_att = (probing & ~direct_reach)[:, None] & rvalid
+    msgs = jnp.sum(probing) + jnp.sum(req_att)
+    if not collect:
+        return tgt, fd_key, fire, msgs, None
+
+    # Flight-recorder extras + fault accounting, all rebuilt from the draws
+    # above (no extra RNG — trajectories are bit-identical with/without
+    # collect). Each FD wire message is attributed to exactly one of
+    # delivered/blocked/lost; the deadline draws (rt_ok/path_ok) are late
+    # deliveries, not drops, so they do not enter the conservation split.
+    blk_fwd = _edge_lookup(plan.block, i_idx, tgt)
+    blk_ack = _edge_lookup(plan.block, tgt, i_idx)
+    ping_acct = _link_acct(probing, blk_fwd, fwd_ok)
+    # The target acks only a ping it actually received while alive.
+    ack_att = probing & fwd_ok & alive[tgt]
+    ack_acct = _link_acct(ack_att, blk_ack, ack_ok)
+    # Indirect cascade: each leg's attempt requires the previous leg to have
+    # delivered to a live hop (origin→relay PING_REQ, relay→target transit,
+    # target→relay ack, relay→origin forward).
+    blk1 = _edge_lookup(plan.block, i_idx[:, None], ridx)
+    blk2 = _edge_lookup(plan.block, ridx, tgt[:, None])
+    blk3 = _edge_lookup(plan.block, tgt[:, None], ridx)
+    blk4 = _edge_lookup(plan.block, ridx, i_idx[:, None])
+    att1 = req_att
+    att2 = att1 & leg_or & alive[ridx]
+    att3 = att2 & leg_rt & alive[tgt][:, None]
+    att4 = att3 & leg_tr
+    acct = _acct_add(
+        ping_acct,
+        ack_acct,
+        _link_acct(att1, blk1, leg_or),
+        _link_acct(att2, blk2, leg_rt),
+        _link_acct(att3, blk3, leg_tr),
+        _link_acct(att4, blk4, leg_ro),
+    )
+    extras = jnp.stack(
+        [
+            jnp.sum(probing, dtype=jnp.int32),  # pings
+            jnp.sum(att1, dtype=jnp.int32),  # ping_reqs
+            jnp.sum(reached, dtype=jnp.int32),  # acks
+            *acct,
+        ]
+    )
+    return tgt, fd_key, fire, msgs, extras
 
 
 @partial(jax.jit, static_argnums=0, static_argnames=("collect",))
@@ -280,6 +348,7 @@ def sim_tick(
             cand,
             view0,
             t // params.fd_period_ticks,
+            collect,
         )
 
     def fd_skip_phase(_):
@@ -288,9 +357,10 @@ def sim_tick(
             jnp.zeros((n,), jnp.int32),
             jnp.zeros((n,), bool),
             jnp.asarray(0, jnp.int32),
+            jnp.zeros((7,), jnp.int32) if collect else None,
         )
 
-    fd_tgt, fd_key, fd_fire, msgs_fd = lax.cond(
+    fd_tgt, fd_key, fd_fire, msgs_fd, fd_extras = lax.cond(
         do_fd, fd_fire_phase, fd_skip_phase, None
     )
     # Mask-combined form consumed by both core paths: -1 = "no verdict".
@@ -307,11 +377,15 @@ def sim_tick(
         _, inv_perm = fanout_permutations(k_gsel, n, params.gossip_fanout)
         ginv = rots = None
     lks = jax.random.split(k_glink, params.gossip_fanout)
+    # The bare loss/block draw per edge is kept separate from edge_ok (which
+    # folds in sender liveness) so the fault accounting below can attribute
+    # each sent gossip message to delivered/blocked/lost.
+    gpass = [
+        link_pass(lks[c], plan, inv_perm[c], i_idx)
+        for c in range(params.gossip_fanout)
+    ]
     edge_ok = jnp.stack(
-        [
-            alive[inv_perm[c]] & link_pass(lks[c], plan, inv_perm[c], i_idx)
-            for c in range(params.gossip_fanout)
-        ]
+        [alive[inv_perm[c]] & gpass[c] for c in range(params.gossip_fanout)]
     )
 
     # A node whose table knows nobody retries its join SYNC every tick (the
@@ -357,10 +431,25 @@ def sim_tick(
             prt, p_valid = masked_random_choice(k_ssel, s_cand)
             do_sync = (do_sync_tick | joining) & alive
             sk1, sk2 = jax.random.split(k_slink)
-            s_fwd = (
-                do_sync & p_valid & alive[prt] & link_pass(sk1, plan, i_idx, prt)
-            )
-            s_rev = s_fwd & link_pass(sk2, plan, prt, i_idx)
+            s_pass_fwd = link_pass(sk1, plan, i_idx, prt)
+            s_pass_rev = link_pass(sk2, plan, prt, i_idx)
+            s_fwd = do_sync & p_valid & alive[prt] & s_pass_fwd
+            s_rev = s_fwd & s_pass_rev
+            if collect:
+                # A SYNC is sent whenever a partner was picked (the sender
+                # can't know a dead partner won't reply); the SYNC_ACK is
+                # attempted only by a live partner that received the SYNC.
+                s_att = do_sync & p_valid
+                sync_acct = _acct_add(
+                    _link_acct(
+                        s_att, _edge_lookup(plan.block, i_idx, prt), s_pass_fwd
+                    ),
+                    _link_acct(
+                        s_fwd, _edge_lookup(plan.block, prt, i_idx), s_pass_rev
+                    ),
+                )
+            else:
+                sync_acct = _acct_zero()
 
             best_any_s = deliver_rows_max(view1, prt[:, None], s_fwd[:, None], n)
             full_alive_rows = jnp.where(is_alive_key(view1), view1, UNKNOWN_KEY)
@@ -385,6 +474,7 @@ def sim_tick(
             msgs_sync = jnp.sum(s_fwd) + jnp.sum(s_rev)
         else:
             msgs_sync = jnp.asarray(0, jnp.int32)
+            sync_acct = _acct_zero()
 
         # ------------------ 4. suspicion sweep + aging + tombstones (fused)
         # Countdown form: the timer decrements once per tick after the tick
@@ -443,7 +533,16 @@ def sim_tick(
             ((view2 >= 0) & ((view2 & DEAD_BIT) == 0) & ~diag).astype(jnp.int32),
             axis=1,
         )
-        return view2, rumor_age, suspect_left, rows_next, known_cnt, self_rumor, msgs_sync
+        return (
+            view2,
+            rumor_age,
+            suspect_left,
+            rows_next,
+            known_cnt,
+            self_rumor,
+            msgs_sync,
+            jnp.stack(sync_acct),
+        )
 
     def core_fast(_):
         if use_fused:
@@ -473,15 +572,23 @@ def sim_tick(
                 known_cnt,
                 self_rumor,
                 jnp.asarray(0, jnp.int32),
+                jnp.stack(_acct_zero()),
             )
         return _core_xla(with_sync=False)
 
     def core_slow(_):
         return _core_xla(with_sync=True)
 
-    (view2, rumor_age, suspect_left, rows_next, known_cnt, self_rumor, msgs_sync) = (
-        lax.cond(need_slow, core_slow, core_fast, None)
-    )
+    (
+        view2,
+        rumor_age,
+        suspect_left,
+        rows_next,
+        known_cnt,
+        self_rumor,
+        msgs_sync,
+        sync_acct,
+    ) = lax.cond(need_slow, core_slow, core_fast, None)
 
     # --------------------------------------------------- 5. self-refutation
     own_key = jnp.diagonal(view2)
@@ -652,6 +759,20 @@ def sim_tick(
         jnp.sum(sender_active[inv_perm[c]] & alive[inv_perm[c]] & nonself[c])
         for c in range(params.gossip_fanout)
     )
+    # Fault accounting, membership plane only (FD + SYNC + membership
+    # gossip; user gossip is excluded — its send mask lives inside
+    # user_gossip_step and it has no protocol-safety invariant to certify).
+    # Gossip attempts reuse the msgs_gossip sender mask; the split reuses
+    # this tick's link draws, so conservation holds by construction:
+    # link_attempts == link_delivered + fault_blocked + fault_lost.
+    g_acct = _acct_zero()
+    for c in range(params.gossip_fanout):
+        g_att = sender_active[inv_perm[c]] & alive[inv_perm[c]] & nonself[c]
+        g_blk = _edge_lookup(plan.block, inv_perm[c], i_idx)
+        g_acct = _acct_add(g_acct, _link_acct(g_att, g_blk, gpass[c]))
+    acct = _acct_add(
+        tuple(fd_extras[3 + k] for k in range(4)), g_acct, tuple(sync_acct)
+    )
     # Status-transition counters (flight-recorder schema, obs/counters.py):
     # transitions INTO a status between the pre-tick table and the final
     # one. Counting entries only (not DEAD->UNKNOWN demotion) keeps the
@@ -679,5 +800,16 @@ def sim_tick(
             is_alive_key(view2) & ~is_alive_key(view0) & (view0 >= 0) & viewer_live
         ),
         "gossip_infections": jnp.sum(new_seen & ~state.useen),
+        "pings": fd_extras[0],
+        "ping_reqs": fd_extras[1],
+        "acks": fd_extras[2],
+        "link_attempts": acct[0],
+        "link_delivered": acct[1],
+        "fault_blocked": acct[2],
+        "fault_lost": acct[3],
+        # Monotonicity gauges for the invariant certifier: max incarnation
+        # (post-refutation) and max restart epoch across the cluster.
+        "inc_max": jnp.max(inc_self),
+        "epoch_max": jnp.max(state.epoch),
     }
     return new_state, metrics

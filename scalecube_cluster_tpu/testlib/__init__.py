@@ -1,11 +1,22 @@
 """Test library: fault injection + cluster factories (reference: cluster-testlib/)."""
 
+from scalecube_cluster_tpu.testlib.chaos import (
+    chaos_soak,
+    chaos_trial,
+    sample_schedule,
+)
 from scalecube_cluster_tpu.testlib.fixtures import (
     await_until,
     fast_test_config,
     shutdown_all,
     start_node,
     suspicion_settle_time,
+)
+from scalecube_cluster_tpu.testlib.invariants import (
+    InvariantViolation,
+    certify_heal,
+    certify_traces,
+    heal_bound,
 )
 from scalecube_cluster_tpu.testlib.network_emulator import (
     InboundSettings,
@@ -17,8 +28,15 @@ from scalecube_cluster_tpu.testlib.network_emulator import (
 
 __all__ = [
     "InboundSettings",
+    "InvariantViolation",
     "await_until",
+    "certify_heal",
+    "certify_traces",
+    "chaos_soak",
+    "chaos_trial",
     "fast_test_config",
+    "heal_bound",
+    "sample_schedule",
     "shutdown_all",
     "start_node",
     "suspicion_settle_time",

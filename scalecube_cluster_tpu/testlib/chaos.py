@@ -1,0 +1,225 @@
+"""Seeded chaos soak: sample random fault schedules, run them through the
+scanned engines, certify the SWIM invariants (testlib/invariants.py).
+
+Every trial is a pure function of ``(seed, n, engine)``: the schedule is
+drawn from ``np.random.default_rng(seed)`` and both engines are
+deterministic, so a violation reproduces from its one-line stamp:
+
+    CHAOS-REPRO seed=17 n=24 engine=sparse ticks=239 digest=3f1c0a9d2b41
+
+All sampled schedules share one static shape — exactly ``CHAOS_SEGMENTS``
+segments and ``CHAOS_KILLS`` kill/restart pairs over dense ``[n, n]`` fault
+matrices — so a whole seed matrix reuses a single compiled executable per
+engine (segment/event counts are the only static dims of a FaultSchedule).
+
+Timeline per trial: a clean warm-up, one disturbance window (uniform loss,
+a minority partition, or a flapping cross-partition link set, plus the
+kill/restart pairs), then a clean tail long enough for the C7 heal bound —
+so every trial exercises detection AND recovery.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu.ops.merge import decode_epoch, decode_status
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.run import run_ticks
+from scalecube_cluster_tpu.sim.schedule import FaultSchedule, ScheduleBuilder
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    effective_view,
+    init_sparse_full_view,
+    run_sparse_ticks,
+)
+from scalecube_cluster_tpu.sim.state import init_full_view, seeds_mask
+from scalecube_cluster_tpu.testlib.invariants import (
+    InvariantViolation,
+    certify_heal,
+    certify_traces,
+    heal_bound,
+)
+
+_ALIVE, _DEAD = 0, 2
+
+#: Fixed schedule shape — every seed compiles to the same executable.
+CHAOS_SEGMENTS = 3
+CHAOS_KILLS = 2
+
+#: Disturbance-window placement (global ticks). The clean tail after
+#: ``DISTURB_END_MAX`` is sized by heal_bound, so total tick count is a
+#: function of params only (another static shape shared across seeds).
+DISTURB_START_LO, DISTURB_START_HI = 5, 15
+DISTURB_LEN_LO, DISTURB_LEN_HI = 40, 60
+DISTURB_END_MAX = DISTURB_START_HI + DISTURB_LEN_HI
+
+ENGINES = ("dense", "sparse")
+
+
+def chaos_params(n: int) -> SimParams:
+    """Fast protocol constants for chaos trials (tests/test_sim.py's small
+    cadence): short FD/SYNC periods keep the heal bound — and therefore the
+    trial length — in the low hundreds of ticks."""
+    return SimParams(
+        n=n,
+        gossip_fanout=3,
+        periods_to_spread=8,
+        periods_to_sweep=18,
+        fd_period_ticks=2,
+        sync_period_ticks=10,
+        suspicion_ticks=30,
+        ping_req_members=2,
+        user_gossip_slots=2,
+    )
+
+
+def trial_ticks(params: SimParams) -> int:
+    """Trial length: worst-case disturbance end + the C7 heal bound + a
+    cadence cushion. Static given params, so all seeds share it."""
+    return DISTURB_END_MAX + heal_bound(params) + 10
+
+
+def sample_schedule(seed: int, n: int) -> FaultSchedule:
+    """Draw one chaos schedule from ``seed``: clean warm-up, one disturbance
+    segment (loss / partition / flap, uniformly chosen), kill+restart pairs
+    inside the window, then clean through the end of the run."""
+    rng = np.random.default_rng(seed)
+    d0 = int(rng.integers(DISTURB_START_LO, DISTURB_START_HI + 1))
+    d1 = d0 + int(rng.integers(DISTURB_LEN_LO, DISTURB_LEN_HI + 1))
+
+    # Minority group for partition/flap variants (and the kill pool's
+    # complement, so a partitioned minority never loses its restarts).
+    m = max(1, n // 4)
+    minority = np.arange(m)
+    majority = np.arange(m, n)
+    clean = FaultPlan.clean(n)
+    variant = int(rng.integers(0, 3))
+    flap_kw: dict = {}
+    if variant == 0:
+        disturb = clean.with_loss(float(rng.uniform(5.0, 30.0)))
+    elif variant == 1:
+        disturb = clean.partition(minority, majority)
+    else:
+        # Square-wave flap across the minority/majority cut: blocked half of
+        # every 8-tick window — links heal and fail repeatedly in-scan.
+        cross = np.zeros((n, n), bool)
+        cross[minority[:, None], majority[None, :]] = True
+        cross[majority[:, None], minority[None, :]] = True
+        disturb = clean
+        flap_kw = {"flap_mask": cross, "flap_period": 8, "flap_on": 4}
+
+    b = (
+        ScheduleBuilder(n)
+        .add_segment(0, clean)
+        .add_segment(d0, disturb, **flap_kw)
+        .add_segment(d1, clean)
+    )
+    # Kill majority-side nodes early in the window, restart each before the
+    # window closes — the heal tail then certifies full reintegration at
+    # the bumped epoch. Restarts/tick stay far under the sparse engine's
+    # alloc_cap, so the in-scan announce never loses the slot-grant race.
+    victims = rng.choice(majority, size=CHAOS_KILLS, replace=False)
+    for i, node in enumerate(victims):
+        k_tick = d0 + 1 + 2 * i
+        r_tick = int(rng.integers(k_tick + 5, d1))
+        b.kill(k_tick, int(node)).restart(r_tick, int(node))
+    return b.build()
+
+
+def sparse_convergence(state) -> float:
+    """The dense engine's convergence measure (sim/tick.py metrics) computed
+    on a sparse state's materialized view — O(n²), small-n trials only."""
+    view = effective_view(state)
+    n = view.shape[0]
+    alive = state.alive
+    status = decode_status(view)
+    truth_alive = alive[None, :] & (decode_epoch(view) == state.epoch[None, :])
+    ok_alive = truth_alive & (status == _ALIVE)
+    ok_dead = ~alive[None, :] & ((status == _DEAD) | (view < 0))
+    match = jnp.where(alive[None, :], ok_alive, ok_dead) | jnp.eye(n, dtype=bool)
+    viewer_conv = jnp.mean(match, axis=1)
+    n_alive = jnp.sum(alive)
+    conv = jnp.sum(viewer_conv * alive) / jnp.maximum(n_alive, 1)
+    return float(jax.device_get(conv))
+
+
+def run_scheduled(
+    engine: str, params: SimParams, schedule: FaultSchedule, n_ticks: int,
+    seed: int = 0
+):
+    """Run ``schedule`` for ``n_ticks`` on one engine from the standard
+    full-view start. Returns ``(final_state, traces, final_convergence)``."""
+    n = params.n
+    if engine == "dense":
+        state = init_full_view(n, params.user_gossip_slots, seed=seed)
+        state, traces = run_ticks(
+            params, state, schedule, seeds_mask(n, [0]), n_ticks
+        )
+        conv = float(jax.device_get(traces["convergence"][-1]))
+        return state, traces, conv
+    if engine == "sparse":
+        sp = SparseParams(
+            base=params, slot_budget=max(64, 4 * n), alloc_cap=16
+        )
+        state = init_sparse_full_view(
+            n,
+            slot_budget=sp.slot_budget,
+            seed=seed,
+            user_gossip_slots=params.user_gossip_slots,
+        )
+        state, traces = run_sparse_ticks(sp, state, schedule, n_ticks)
+        return state, traces, sparse_convergence(state)
+    raise ValueError(f"unknown engine {engine!r}; expected one of {ENGINES}")
+
+
+def reproducer_line(seed: int, n: int, engine: str, ticks: int, digest: str) -> str:
+    """The one-line stamp that fully determines a trial."""
+    return (
+        f"CHAOS-REPRO seed={seed} n={n} engine={engine} "
+        f"ticks={ticks} digest={digest}"
+    )
+
+
+def chaos_trial(seed: int, n: int, engine: str) -> dict:
+    """One seeded trial: sample, run, certify C1-C7. Never raises — a
+    violation comes back as ``ok=False`` with the reproducer line."""
+    params = chaos_params(n)
+    schedule = sample_schedule(seed, n)
+    ticks = trial_ticks(params)
+    repro = reproducer_line(seed, n, engine, ticks, schedule.digest())
+    result = {
+        "seed": seed,
+        "n": n,
+        "engine": engine,
+        "ticks": ticks,
+        "digest": schedule.digest(),
+        "reproducer": repro,
+    }
+    try:
+        _, traces, conv = run_scheduled(engine, params, schedule, ticks)
+        summary = certify_traces(params, traces)
+        certify_heal(params, summary, conv)
+    except InvariantViolation as e:
+        result.update(ok=False, violation=e.invariant, error=str(e))
+        return result
+    result.update(ok=True, final_convergence=conv, **summary)
+    return result
+
+
+def chaos_soak(
+    seeds, n: int, engines=ENGINES, on_result=None
+) -> list[dict]:
+    """Run the seed x engine matrix; returns all trial results (violations
+    included — callers assert). ``on_result`` (optional callable) sees each
+    result as it lands, for streaming CLI output."""
+    results = []
+    for seed in seeds:
+        for engine in engines:
+            r = chaos_trial(int(seed), n, engine)
+            results.append(r)
+            if on_result is not None:
+                on_result(r)
+    return results

@@ -480,6 +480,116 @@ def sim_protocol_counters(n: int, fd_rounds: int, seed: int = 0) -> dict:
     return {"counters": totals, "fd_periods": n * fd_rounds}
 
 
+async def host_scheduled_block_counters(
+    n: int, block_rounds: int, heal_rounds: int, emulator_seed: int = 31
+) -> dict:
+    """Block/heal timeline on the host backend: partition node 0 from the
+    rest (both directions, emulator ``blockOutbound``) for ``block_rounds``
+    FD periods, then unblock for ``heal_rounds`` more. Returns the counter
+    deltas of each window: ``{"block": {...}, "heal": {...}}``.
+
+    The emulator reports every deterministic drop into the nodes'
+    ProtocolCounters blocks as ``fault_blocked`` (network_emulator.py::
+    attach_counters), so the windows carry the same drop-cause schema the
+    sim engines emit — the host half of the scheduled-fault crossval.
+    """
+    from scalecube_cluster_tpu.obs.counters import diff_counters, sum_counters
+
+    cfg = fast_test_config()
+    interval_s = cfg.failure_detector_config.ping_interval / 1000.0
+    seed = await start_node(cfg)
+    others = []
+    for i in range(n - 1):
+        others.append(
+            await start_node(
+                cfg, seeds=(seed.address,), emulator_seed=emulator_seed + i
+            )
+        )
+    nodes = [seed, *others]
+    try:
+        await await_until(
+            lambda: all(len(c.members()) == n for c in nodes), timeout=20.0
+        )
+        await asyncio.sleep(interval_s)  # settle in-flight join probes
+
+        def snap():
+            return sum_counters([c.counters.snapshot() for c in nodes])
+
+        base = snap()
+        nodes[0].network_emulator.block_all_outbound()
+        for other in others:
+            other.network_emulator.block_outbound(nodes[0].address)
+        await asyncio.sleep(block_rounds * interval_s)
+        at_heal = snap()
+        for c in nodes:
+            c.network_emulator.unblock_all()
+        await asyncio.sleep(heal_rounds * interval_s)
+        final = snap()
+        return {
+            "block": diff_counters(at_heal, base),
+            "heal": diff_counters(final, at_heal),
+        }
+    finally:
+        await shutdown_all(*nodes)
+
+
+def sim_scheduled_block_counters(
+    n: int, block_ticks: int, heal_ticks: int, seed: int = 0
+) -> dict:
+    """Sim twin of :func:`host_scheduled_block_counters`: ONE in-scan
+    :class:`FaultSchedule` — a {0} vs rest partition segment followed by a
+    clean segment — run on the sparse engine, with the per-window counter
+    deltas read straight off the collected traces (no host-side plan swap
+    anywhere in the timeline)."""
+    from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS
+    from scalecube_cluster_tpu.sim import FaultPlan, ScheduleBuilder, SimParams
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+        run_sparse_ticks,
+    )
+
+    import jax
+
+    base = SimParams.from_cluster_config(n, fast_test_config())
+    params = SparseParams(base=base, slot_budget=max(64, 2 * n))
+    schedule = (
+        ScheduleBuilder(n)
+        .add_segment(0, FaultPlan.clean(n).partition([0], list(range(1, n))))
+        .add_segment(block_ticks + 1, FaultPlan.clean(n))
+        .build()
+    )
+    state = init_sparse_full_view(n, params.slot_budget, seed=seed)
+    _, traces = run_sparse_ticks(params, state, schedule, block_ticks + heal_ticks)
+    traces = {
+        k: np.asarray(jax.device_get(v))
+        for k, v in traces.items()
+        if k in SHARED_COUNTERS
+    }
+    return {
+        "block": {k: int(v[:block_ticks].sum()) for k, v in traces.items()},
+        "heal": {k: int(v[block_ticks:].sum()) for k, v in traces.items()},
+    }
+
+
+async def compare_scheduled_block_counters(
+    n: int = 8, block_rounds: int = 5, heal_rounds: int = 5
+) -> dict:
+    """Run the block/heal timeline on both backends; per-window deltas for
+    assertion. The sim window is ``rounds * fd_period_ticks`` ticks — the
+    same number of FD rounds the host slept through."""
+    from scalecube_cluster_tpu.sim import SimParams
+
+    host = await host_scheduled_block_counters(n, block_rounds, heal_rounds)
+    base = SimParams.from_cluster_config(n, fast_test_config())
+    sim = sim_scheduled_block_counters(
+        n,
+        block_rounds * base.fd_period_ticks,
+        heal_rounds * base.fd_period_ticks,
+    )
+    return {"host": host, "sim": sim}
+
+
 async def compare_protocol_counters(n: int = 8, fd_rounds: int = 6) -> dict:
     """Run the steady-state scenario on both backends; return the counter
     totals plus per-FD-period rates for assertion."""

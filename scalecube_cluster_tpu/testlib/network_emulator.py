@@ -40,13 +40,21 @@ class NetworkEmulatorException(ConnectionError):
 
 @dataclass(frozen=True)
 class OutboundSettings:
-    """Per-destination outbound link settings (NetworkEmulator.java:309-374)."""
+    """Per-destination outbound link settings (NetworkEmulator.java:309-374).
+
+    ``blocked`` marks a deterministic directional block (blockOutbound) as
+    distinct from probabilistic loss — the two drop causes feed the separate
+    ``fault_blocked`` / ``fault_lost`` counters (obs/counters.py), matching
+    the sim engines' FaultPlan.block vs FaultPlan.loss split. A blocked link
+    drops every send regardless of ``loss_percent``.
+    """
 
     loss_percent: float = 0.0
     mean_delay_ms: float = 0.0
+    blocked: bool = False
 
     def evaluate_loss(self, rng: random.Random) -> bool:
-        """True if this send should be dropped."""
+        """True if this send should be dropped by probabilistic loss."""
         return self.loss_percent > 0 and rng.uniform(0, 100) < self.loss_percent
 
     def evaluate_delay(self, rng: random.Random) -> float:
@@ -77,6 +85,14 @@ class NetworkEmulator:
         self.total_message_sent_count = 0
         self.total_outbound_lost_count = 0
         self.total_inbound_lost_count = 0
+        self._counters = None  # optional ProtocolCounters (attach_counters)
+
+    def attach_counters(self, counters) -> None:
+        """Feed drop events into a node's :class:`ProtocolCounters` block so
+        the host backend emits the same ``fault_blocked`` / ``fault_lost``
+        schema the sim engines do (Cluster.start wires this automatically
+        when its transport carries a ``network_emulator``)."""
+        self._counters = counters
 
     # -- settings resolution (NetworkEmulator.java:60-85)
 
@@ -100,7 +116,7 @@ class NetworkEmulator:
 
     def block_outbound(self, *destinations: Address) -> None:
         for d in destinations:
-            self._outbound[d] = OutboundSettings(loss_percent=100.0)
+            self._outbound[d] = OutboundSettings(blocked=True)
         logger.debug("%s: blocked outbound to %s", self._local, destinations)
 
     def unblock_outbound(self, *destinations: Address) -> None:
@@ -109,7 +125,7 @@ class NetworkEmulator:
 
     def block_all_outbound(self) -> None:
         self._outbound.clear()
-        self._default_outbound = OutboundSettings(loss_percent=100.0)
+        self._default_outbound = OutboundSettings(blocked=True)
 
     def unblock_all_outbound(self) -> None:
         self._outbound.clear()
@@ -139,8 +155,18 @@ class NetworkEmulator:
 
     def try_fail_outbound(self, destination: Address) -> None:
         self.total_message_sent_count += 1
-        if self.outbound_settings_of(destination).evaluate_loss(self._rng):
+        settings = self.outbound_settings_of(destination)
+        if settings.blocked:
             self.total_outbound_lost_count += 1
+            if self._counters is not None:
+                self._counters.inc("fault_blocked")
+            raise NetworkEmulatorException(
+                f"emulated block {self._local} -> {destination}"
+            )
+        if settings.evaluate_loss(self._rng):
+            self.total_outbound_lost_count += 1
+            if self._counters is not None:
+                self._counters.inc("fault_lost")
             raise NetworkEmulatorException(
                 f"emulated loss {self._local} -> {destination}"
             )

@@ -8,7 +8,10 @@ semantics:
 - one lazily-created cached outbound connection per destination, evicted on
   disconnect or connect error (TransportImpl.java:56, 299-322) — which also
   yields the reference's per-connection FIFO ordering
-  (TransportSendOrderTest.java:41-207);
+  (TransportSendOrderTest.java:41-207); stale cache entries (failed or
+  cancelled dial futures, closing writers) are also evicted at lookup, and
+  redials to a failing destination apply bounded exponential backoff with
+  jitter (TransportConfig.reconnect_backoff_*);
 - flush (drain) per message send (TransportImpl.java:280);
 - a single multicast inbound stream fed by all accepted connections
   (TransportImpl.java:53-54), completed on ``stop()``;
@@ -26,6 +29,7 @@ from __future__ import annotations
 import asyncio
 import contextlib
 import logging
+import random
 
 from scalecube_cluster_tpu.cluster_api.config import TransportConfig
 from scalecube_cluster_tpu.native import load_framing
@@ -74,6 +78,10 @@ class TcpTransport(_ListenMixin, Transport):
         # (not the connection) is cached so concurrent senders share one dial
         # (TransportImpl.java:299-322).
         self._connections: dict[Address, asyncio.Future[_Connection]] = {}
+        # Consecutive failed-dial count per destination; drives the bounded
+        # reconnect backoff and resets on a successful connect.
+        self._dial_failures: dict[Address, int] = {}
+        self._jitter_rng = random.Random()  # tpulint: disable=R3 -- backoff jitter exists to DECORRELATE redialing senders; tests pin the envelope, not values
         self._accepted: set[asyncio.Task] = set()
         self._stopped = False
 
@@ -145,16 +153,54 @@ class TcpTransport(_ListenMixin, Transport):
             self._evict(to)
             raise
 
+    def _backoff_delay(self, attempt: int) -> float:
+        """Seconds to wait before dial ``attempt`` (0 = first try, no wait):
+        exponential from ``reconnect_backoff_min_ms`` capped at
+        ``reconnect_backoff_max_ms``, with ±jitter randomization."""
+        if attempt <= 0 or self._config.reconnect_backoff_min_ms <= 0:
+            return 0.0
+        # Cap the exponent before shifting so huge failure streaks don't
+        # build a bignum only for min() to discard it.
+        exp = min(attempt - 1, 16)
+        delay_ms = min(
+            self._config.reconnect_backoff_min_ms * (1 << exp),
+            self._config.reconnect_backoff_max_ms,
+        )
+        spread = self._config.reconnect_backoff_jitter
+        if spread > 0:
+            delay_ms *= 1.0 + self._jitter_rng.uniform(-spread, spread)
+        return delay_ms / 1000.0
+
     async def _get_or_connect(self, to: Address) -> _Connection:
         fut = self._connections.get(to)
+        if fut is not None and fut.done():
+            # A cached entry can go stale without a send noticing: the dial
+            # future failed or was cancelled, or the peer closed the socket
+            # and the writer is already shutting down while the reader task
+            # hasn't run its eviction yet. Writing to any of these would
+            # fail (or silently buffer into a closing writer) — evict and
+            # redial instead (TransportImpl.java:299-322's disconnect
+            # eviction, applied at lookup time too).
+            stale = (
+                fut.cancelled()
+                or fut.exception() is not None
+                or fut.result().writer.is_closing()
+            )
+            if stale:
+                self._evict(to)
+                fut = None
         if fut is None:
             fut = asyncio.get_running_loop().create_future()
             self._connections[to] = fut
             try:
+                await asyncio.sleep(
+                    self._backoff_delay(self._dial_failures.get(to, 0))
+                )
                 reader, writer = await asyncio.wait_for(
                     asyncio.open_connection(to.host, to.port),
                     timeout=self._config.connect_timeout / 1000.0,
                 )
+                self._dial_failures.pop(to, None)
                 conn = _Connection(reader, writer)
                 if fut.cancelled() or self._stopped:
                     # stop() cancelled the cached future while we dialed.
@@ -167,6 +213,8 @@ class TcpTransport(_ListenMixin, Transport):
                 )
                 fut.set_result(conn)
             except BaseException as exc:
+                if not isinstance(exc, asyncio.CancelledError):
+                    self._dial_failures[to] = self._dial_failures.get(to, 0) + 1
                 self._evict(to)
                 if not fut.done():
                     if isinstance(exc, asyncio.CancelledError):

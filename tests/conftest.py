@@ -76,6 +76,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "fast: auto-applied complement of `deep` — see that marker"
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-minute soaks (extended chaos matrices) excluded from "
+        "the tier-1 gate (`-m 'not slow'`)",
+    )
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1,0 +1,292 @@
+"""In-scan fault schedules + SWIM invariant certifier + seeded chaos soak.
+
+Four layers:
+
+1. Zero-event pin — a single-clean-segment FaultSchedule is bit-identical
+   to the fixed-FaultPlan run on both engines (the scheduled step consumes
+   no extra RNG and perturbs nothing when no fault/event is armed).
+2. Scheduled-vs-segmented pin — the partition→heal timeline as ONE scanned
+   schedule produces the exact traces of the old two-call segmented form
+   (the contract behind experiments/scenarios.py::partition_recovery_scenario's
+   single-run_chunked port), on both engines.
+3. Seeded chaos smoke — a ≥3-seed × {dense, sparse} matrix of sampled
+   schedules passes the C1-C7 certifier (testlib/invariants.py); the
+   extended matrix is the slow-marked soak.
+4. Negative — tampered counters / doctored traces are caught by the
+   certifier with the right invariant id (the certifier actually bites).
+"""
+
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.sim import (
+    FaultPlan,
+    ScheduleBuilder,
+    init_full_view,
+    run_ticks,
+)
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    run_sparse_ticks,
+)
+from scalecube_cluster_tpu.sim.state import seeds_mask
+from scalecube_cluster_tpu.testlib.chaos import (
+    chaos_params,
+    chaos_trial,
+    run_scheduled,
+    sample_schedule,
+    trial_ticks,
+)
+from scalecube_cluster_tpu.testlib.invariants import (
+    InvariantViolation,
+    certify_heal,
+    certify_traces,
+    heal_bound,
+)
+from tests.test_sim import small_params
+
+SCHED_ONLY = {"plan_dirty", "kills_fired", "restarts_fired"}
+
+
+def _assert_traces_equal(a, b, context):
+    keys = (set(a) & set(b)) - SCHED_ONLY
+    assert keys, (context, sorted(a), sorted(b))
+    for k in sorted(keys):
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), (context, k)
+
+
+def _sparse_params(n):
+    return SparseParams(base=small_params(n), slot_budget=64, alloc_cap=16)
+
+
+# -- 1. zero-event schedules are bit-identical to fixed plans ---------------
+
+
+def test_clean_schedule_bit_identical_dense():
+    n, ticks = 16, 40
+    p = small_params(n)
+    sm = seeds_mask(n, [0])
+    schedule = ScheduleBuilder(n).add_segment(0, FaultPlan.clean(n)).build()
+    st_a, tr_a = run_ticks(p, init_full_view(n, 2), FaultPlan.clean(n), sm, ticks)
+    st_b, tr_b = run_ticks(p, init_full_view(n, 2), schedule, sm, ticks)
+    _assert_traces_equal(tr_a, tr_b, "dense clean")
+    assert not np.asarray(tr_b["plan_dirty"]).any()
+    assert np.array_equal(np.asarray(st_a.view), np.asarray(st_b.view))
+    assert np.array_equal(np.asarray(st_a.rng), np.asarray(st_b.rng))
+
+
+def test_clean_schedule_bit_identical_sparse():
+    n, ticks = 16, 40
+    p = _sparse_params(n)
+    schedule = ScheduleBuilder(n).add_segment(0, FaultPlan.clean(n)).build()
+    st_a, tr_a = run_sparse_ticks(
+        p, init_sparse_full_view(n, p.slot_budget), FaultPlan.clean(n), ticks
+    )
+    st_b, tr_b = run_sparse_ticks(
+        p, init_sparse_full_view(n, p.slot_budget), schedule, ticks
+    )
+    _assert_traces_equal(tr_a, tr_b, "sparse clean")
+    for field in ("slab", "view_T", "alive", "epoch", "rng"):
+        assert np.array_equal(
+            np.asarray(getattr(st_a, field)), np.asarray(getattr(st_b, field))
+        ), field
+
+
+# -- 2. scheduled == segmented (the partition_recovery port contract) -------
+
+
+def test_partition_schedule_matches_segmented_dense():
+    n, hold, heal = 16, 40, 50
+    p = small_params(n)
+    sm = seeds_mask(n, [0, n - 1])
+    k = n // 3
+    cut = FaultPlan.clean(n).partition(list(range(k)), list(range(k, n)))
+    schedule = (
+        ScheduleBuilder(n)
+        .add_segment(0, cut)
+        .add_segment(hold + 1, FaultPlan.clean(n))
+        .build()
+    )
+    st_s, tr_s = run_ticks(p, init_full_view(n, 2), schedule, sm, hold + heal)
+    # The old three-segment form: two host-boundary plan swaps.
+    st_g, tr_g1 = run_ticks(p, init_full_view(n, 2), cut, sm, hold)
+    st_g, tr_g2 = run_ticks(p, st_g, FaultPlan.clean(n), sm, heal)
+    tr_g = {
+        key: np.concatenate([np.asarray(tr_g1[key]), np.asarray(tr_g2[key])])
+        for key in tr_g1
+    }
+    _assert_traces_equal(tr_g, tr_s, "dense partition")
+    dirty = np.asarray(tr_s["plan_dirty"])
+    assert dirty[:hold].all() and not dirty[hold:].any()
+    assert np.array_equal(np.asarray(st_g.view), np.asarray(st_s.view))
+    assert np.array_equal(np.asarray(st_g.rng), np.asarray(st_s.rng))
+
+
+def test_partition_schedule_matches_segmented_sparse():
+    n, hold, heal = 16, 40, 50
+    p = _sparse_params(n)
+    k = n // 3
+    cut = FaultPlan.clean(n).partition(list(range(k)), list(range(k, n)))
+    schedule = (
+        ScheduleBuilder(n)
+        .add_segment(0, cut)
+        .add_segment(hold + 1, FaultPlan.clean(n))
+        .build()
+    )
+    st_s, tr_s = run_sparse_ticks(
+        p, init_sparse_full_view(n, p.slot_budget), schedule, hold + heal
+    )
+    st_g, tr_g1 = run_sparse_ticks(
+        p, init_sparse_full_view(n, p.slot_budget), cut, hold
+    )
+    st_g, tr_g2 = run_sparse_ticks(p, st_g, FaultPlan.clean(n), heal)
+    tr_g = {
+        key: np.concatenate([np.asarray(tr_g1[key]), np.asarray(tr_g2[key])])
+        for key in tr_g1
+    }
+    _assert_traces_equal(tr_g, tr_s, "sparse partition")
+    for field in ("slab", "view_T", "alive", "epoch", "rng"):
+        assert np.array_equal(
+            np.asarray(getattr(st_g, field)), np.asarray(getattr(st_s, field))
+        ), field
+
+
+# -- 3. seeded chaos matrix -------------------------------------------------
+
+CHAOS_N = 24
+SMOKE_SEEDS = (0, 1, 2)
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse"])
+def test_chaos_matrix(engine):
+    """≥3 seeds per engine: sampled kill/restart/loss/partition/flap
+    schedules satisfy C1-C7. All seeds share one executable per engine
+    (fixed segment/event counts), so only the first trial compiles."""
+    for seed in SMOKE_SEEDS:
+        r = chaos_trial(seed, CHAOS_N, engine)
+        assert r["ok"], (r["reproducer"], r.get("error"))
+        assert r["final_convergence"] == 1.0, r
+        # Every sampled schedule disturbs something and heals.
+        assert r["kills"] == 2 and r["restarts"] == 2, r
+        assert r["fault_blocked"] + r["fault_lost"] > 0, r
+
+
+def test_chaos_schedule_sampling_deterministic():
+    a, b = sample_schedule(7, CHAOS_N), sample_schedule(7, CHAOS_N)
+    assert a.digest() == b.digest()
+    assert a.digest() != sample_schedule(8, CHAOS_N).digest()
+
+
+@pytest.mark.slow
+def test_chaos_soak_extended():
+    """The long matrix (tier-2): many seeds, both engines."""
+    from scalecube_cluster_tpu.testlib.chaos import chaos_soak
+
+    results = chaos_soak(range(10), CHAOS_N)
+    bad = [r for r in results if not r["ok"]]
+    assert not bad, [(r["reproducer"], r["error"]) for r in bad]
+
+
+# -- 4. the certifier bites (negative tests) --------------------------------
+
+
+def _clean_traces(ticks=50):
+    """A synthetic trajectory that satisfies every invariant."""
+    z = np.zeros(ticks, np.int64)
+    return {
+        "link_attempts": z + 10,
+        "link_delivered": z + 10,
+        "fault_blocked": z.copy(),
+        "fault_lost": z.copy(),
+        "pings": z + 4,
+        "acks": z + 4,
+        "suspicions_raised": z.copy(),
+        "verdicts_dead": z.copy(),
+        "inc_max": z.copy(),
+        "epoch_max": z.copy(),
+        "plan_dirty": np.zeros(ticks, bool),
+        "kills_fired": z.copy(),
+        "restarts_fired": z.copy(),
+    }
+
+
+@pytest.mark.parametrize(
+    "tamper, invariant",
+    [
+        # Drop a delivered message without attributing it anywhere.
+        (lambda t: t["link_delivered"].__setitem__(20, 9), "C1-conservation"),
+        # Claim a blocked drop on a tick whose plan was clean (attempts
+        # tampered too, so conservation still balances).
+        (
+            lambda t: (
+                t["fault_blocked"].__setitem__(20, 1),
+                t["link_attempts"].__setitem__(20, 11),
+            ),
+            "C2-clean-tick",
+        ),
+        # DEAD verdict with no disturbance anywhere.
+        (lambda t: t["verdicts_dead"].__setitem__(30, 1), "C3-false-dead"),
+        # Epoch going backwards.
+        (lambda t: t["epoch_max"].__setitem__(10, 1), "C4-epoch-monotone"),
+        # Epoch bump with no scheduled restart.
+        (
+            lambda t: t["epoch_max"].__setitem__(slice(10, None), 1),
+            "C4-epoch-source",
+        ),
+        # Incarnation dropping without a restart.
+        (
+            lambda t: t["inc_max"].__setitem__(slice(0, 10), 2),
+            "C5-incarnation-monotone",
+        ),
+        # Suspicion with no missed probe before it.
+        (
+            lambda t: t["suspicions_raised"].__setitem__(5, 1),
+            "C3-false-suspicion",
+        ),
+        # Same, but on a dirty timeline so C3 doesn't trip first: C6.
+        (
+            lambda t: (
+                t["plan_dirty"].__setitem__(40, True),
+                t["suspicions_raised"].__setitem__(5, 1),
+            ),
+            "C6-suspicion-cause",
+        ),
+    ],
+)
+def test_tampered_traces_caught(tamper, invariant):
+    params = chaos_params(CHAOS_N)
+    traces = _clean_traces()
+    certify_traces(params, traces)  # baseline passes
+    tamper(traces)
+    with pytest.raises(InvariantViolation) as e:
+        certify_traces(params, traces)
+    assert e.value.invariant == invariant, str(e.value)
+
+
+def test_tampered_real_run_caught():
+    """Counters from a REAL scheduled run are conserved; zeroing the blocked
+    bucket breaks C1 — the certifier catches doctored telemetry, not just
+    synthetic shapes."""
+    params = chaos_params(CHAOS_N)
+    schedule = sample_schedule(0, CHAOS_N)  # seed 0 samples a blocking variant
+    _, traces, conv = run_scheduled(
+        "dense", params, schedule, trial_ticks(params)
+    )
+    traces = {k: np.asarray(v).copy() for k, v in traces.items()}
+    summary = certify_traces(params, traces)
+    certify_heal(params, summary, conv)
+    assert summary["fault_blocked"] > 0
+    traces["fault_blocked"][:] = 0
+    with pytest.raises(InvariantViolation) as e:
+        certify_traces(params, traces)
+    assert e.value.invariant == "C1-conservation"
+
+
+def test_heal_certifier_rejects_partial_convergence():
+    params = chaos_params(CHAOS_N)
+    summary = certify_traces(params, _clean_traces(heal_bound(params) + 5))
+    certify_heal(params, summary, 1.0)
+    with pytest.raises(InvariantViolation) as e:
+        certify_heal(params, summary, 0.97)
+    assert e.value.invariant == "C7-heal-convergence"

@@ -137,6 +137,35 @@ def test_sim_dissemination_tracks_cluster_math():
 
 
 @pytest.mark.asyncio
+async def test_scheduled_block_heal_counters_match():
+    """Scheduled-fault crossval (ISSUE 4 satellite): the same block→heal
+    timeline — partition node 0, then reconnect — run as emulator
+    blockOutbound windows on the host and as ONE in-scan FaultSchedule on
+    the sparse engine, produces matching drop-cause deltas: ``fault_blocked``
+    accumulates only inside the block window on both backends, and
+    ``fault_lost`` stays zero everywhere (deterministic blocks are not
+    probabilistic loss). Absolute counts differ (traffic volumes do); the
+    schema and the window placement are the cross-checked contract."""
+    from scalecube_cluster_tpu.testlib.crossval import (
+        compare_scheduled_block_counters,
+    )
+
+    result = await compare_scheduled_block_counters(
+        n=8, block_rounds=5, heal_rounds=5
+    )
+    for side in ("host", "sim"):
+        block, heal = result[side]["block"], result[side]["heal"]
+        assert block["fault_blocked"] > 0, (side, result)
+        assert heal["fault_blocked"] == 0, (side, result)
+        assert block["fault_lost"] == 0, (side, result)
+        assert heal["fault_lost"] == 0, (side, result)
+    print(
+        f"block/heal crossval n=8: host blocked={result['host']['block']['fault_blocked']} "
+        f"sim blocked={result['sim']['block']['fault_blocked']}"
+    )
+
+
+@pytest.mark.asyncio
 async def test_protocol_counters_match_host():
     """Cross-backend counter parity (ISSUE 2): both backends report the
     SHARED_COUNTERS schema, and on a clean network their FD cadence agrees

@@ -210,6 +210,96 @@ async def test_oversized_frame_rejected_on_send():
         await small.stop()
 
 
+@pytest.mark.asyncio
+async def test_failed_dial_evicted_and_backoff_counted():
+    """A failed connect leaves no future in the cache (a poisoned entry
+    would fail every later send to that address without redialing), and
+    consecutive failures advance the reconnect-backoff counter."""
+    a = await TcpTransport.bind(
+        TransportConfig(connect_timeout=1000, reconnect_backoff_min_ms=1)
+    )
+    try:
+        dead = Address("127.0.0.1", 1)  # nothing listens on port 1
+        for expected_failures in (1, 2):
+            with pytest.raises((ConnectionError, OSError, asyncio.TimeoutError)):
+                await a.send(dead, Message.create(qualifier="x", sender=a.address))
+            assert dead not in a._connections
+            assert a._dial_failures[dead] == expected_failures
+    finally:
+        await a.stop()
+
+
+@pytest.mark.asyncio
+async def test_closing_writer_evicted_and_redialed():
+    """A cached connection whose writer is shutting down (peer died; the
+    reader task hasn't evicted yet) is dropped at lookup and the send
+    succeeds over a fresh dial instead of writing into the closing socket."""
+    a, b = await bind(), await bind()
+    got = []
+
+    async def collect():
+        async for msg in b.listen():
+            got.append(msg.data)
+
+    task = asyncio.create_task(collect())
+    try:
+        await a.send(b.address, Message.create(qualifier="x", data=1, sender=a.address))
+        stale_fut = a._connections[b.address]
+        stale_fut.result().writer.close()  # simulate peer-side shutdown
+        await a.send(b.address, Message.create(qualifier="x", data=2, sender=a.address))
+        assert a._connections[b.address] is not stale_fut
+        await asyncio.sleep(0.1)
+        assert got == [1, 2]
+    finally:
+        task.cancel()
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_successful_connect_resets_backoff():
+    a, b = await bind(), await bind()
+    server = await echo_server(b)
+    try:
+        a._dial_failures[b.address] = 5  # as if earlier dials failed
+        # Keep the pre-dial backoff sleep short for the test.
+        a._config = dataclasses.replace(
+            a._config, reconnect_backoff_min_ms=1, reconnect_backoff_max_ms=2
+        )
+        req = Message.create(
+            qualifier="hi", data="ping", correlation_id="c-7", sender=a.address
+        )
+        resp = await a.request_response(b.address, req, timeout=2)
+        assert resp.data == ("echo", "ping")
+        assert b.address not in a._dial_failures
+    finally:
+        server.cancel()
+        await a.stop()
+        await b.stop()
+
+
+def test_backoff_delay_bounded_with_jitter():
+    """The redial delay grows exponentially from min to max and stays inside
+    the jitter envelope at every attempt (never negative, never unbounded)."""
+    cfg = TransportConfig(
+        reconnect_backoff_min_ms=50,
+        reconnect_backoff_max_ms=2_000,
+        reconnect_backoff_jitter=0.2,
+    )
+    t = TcpTransport(cfg)
+    assert t._backoff_delay(0) == 0.0
+    for attempt in range(1, 40):
+        lo = min(50 * 2 ** min(attempt - 1, 16), 2_000) / 1000.0
+        for _ in range(8):
+            d = t._backoff_delay(attempt)
+            assert lo * 0.8 <= d <= lo * 1.2, (attempt, d)
+    # Jitter off -> deterministic; min 0 -> disabled entirely.
+    t0 = TcpTransport(dataclasses.replace(cfg, reconnect_backoff_jitter=0.0))
+    assert t0._backoff_delay(3) == 0.2
+    t_off = TcpTransport(dataclasses.replace(cfg, reconnect_backoff_min_ms=0))
+    assert t_off._backoff_delay(10) == 0.0
+
+
 @register_data_type("test/payload")
 @dataclasses.dataclass(frozen=True)
 class _Payload:
