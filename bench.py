@@ -32,9 +32,12 @@ one JSON line on stdout, no matter what the TPU tunnel does.
   ready event completes independently).
 
 Usage: ``python bench.py`` (driver mode — one JSON line),
-``python bench.py --child <engine> <n>`` (internal single-config worker), or
+``python bench.py --child <engine> <n>`` (internal single-config worker),
 ``python bench.py --telemetry [out.jsonl] [n]`` (flight-recorder run: counter
-totals + detection-latency histograms as schema-versioned JSONL + Prometheus).
+totals + detection-latency histograms as schema-versioned JSONL + Prometheus),
+or ``python bench.py --ensemble <B> [n]`` (vmapped multi-universe rung,
+sim/ensemble.py: B universes stepped in one compiled call; the reported
+aggregate is universes × member·rounds/s).
 """
 
 from __future__ import annotations
@@ -181,6 +184,61 @@ def _measure_sparse(
             int(state.view_T[0, 0])
     dt = time.perf_counter() - t0
     return n_members * (reps * chunk / dt)
+
+
+def _measure_ensemble(
+    b_count: int, n_members: int = 1024, chunk: int = 40, reps: int = 4
+) -> dict:
+    """The ``--ensemble B`` rung: B dense universes under independent
+    uniform-5%-loss plans stepped together by sim/ensemble.py — ONE compiled
+    call per timing rep, ``collect=False``. The aggregate metric is
+    universes × member·rounds/s (B · n · ticks / dt): what one chip
+    sustains across a whole population, the sweep-throughput number PERF.md
+    accounts for. Uses the XLA tick core — vmap batches it directly."""
+    import dataclasses
+
+    from scalecube_cluster_tpu.sim import FaultPlan, SimParams
+    from scalecube_cluster_tpu.sim.ensemble import (
+        init_ensemble_dense,
+        run_ensemble_ticks,
+        stack_universes,
+    )
+    from scalecube_cluster_tpu.sim.state import seeds_mask
+
+    params = dataclasses.replace(
+        SimParams.from_cluster_config(n_members), pallas_delivery=False
+    )
+    states = init_ensemble_dense(
+        n_members, range(b_count), user_gossip_slots=params.user_gossip_slots
+    )
+    plans = stack_universes(
+        FaultPlan.uniform(loss_percent=5.0) for _ in range(b_count)
+    )
+    seeds = seeds_mask(n_members, [0, 1])
+
+    # Warmup (compile + steady state); the element fetch off the large
+    # stacked view buffer is the host sync, as in the single-run rungs.
+    states, _ = run_ensemble_ticks(params, states, plans, seeds, chunk, collect=False)
+    int(states.view[0, 0, 0])
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        states, _ = run_ensemble_ticks(
+            params, states, plans, seeds, chunk, collect=False
+        )
+        int(states.view[0, 0, 0])
+    dt = time.perf_counter() - t0
+    value = b_count * n_members * (reps * chunk / dt)
+    return {
+        "metric": "ensemble_member_gossip_rounds_per_sec",
+        "value": round(value, 1),
+        "unit": "universes·member·rounds/s",
+        "per_universe": round(value / b_count, 1),
+        "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+        "n_members": n_members,
+        "universes": b_count,
+        "engine": "dense-ensemble",
+    }
 
 
 def _measure(engine: str, n_members: int, slot_budget: int | None = None) -> dict:
@@ -453,6 +511,22 @@ if __name__ == "__main__":
             pass
         s_arg = int(sys.argv[4]) if len(sys.argv) == 5 else 0
         print(json.dumps(_measure(sys.argv[2], int(sys.argv[3]), s_arg or None)))
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--ensemble":
+        try:
+            from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+            enable_repo_jax_cache()
+        except Exception:
+            pass
+        from scalecube_cluster_tpu.obs.export import jsonl_line, make_row, run_metadata
+
+        b_count = int(sys.argv[2])
+        n_arg = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+        out = _measure_ensemble(b_count, n_arg)
+        print(
+            jsonl_line(make_row("bench_ensemble", out, run_metadata(seed=0))),
+            flush=True,
+        )
     elif len(sys.argv) >= 2 and sys.argv[1] == "--telemetry":
         _telemetry(
             n_members=int(sys.argv[3]) if len(sys.argv) > 3 else 4096,

@@ -34,6 +34,13 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--cpu", action="store_true", help="force the CPU backend"
     )
+    ap.add_argument(
+        "--no-ensemble",
+        action="store_true",
+        help="run trials as B host-driven loops instead of one vmapped "
+        "ensemble call per engine (sim/ensemble.py); results are identical "
+        "— this is the bisection/debug path",
+    )
     args = ap.parse_args(argv)
 
     if args.cpu:
@@ -65,7 +72,13 @@ def main(argv=None) -> int:
             print(f"FAIL {r['reproducer']} :: {r['error']}")
         sys.stdout.flush()
 
-    results = chaos_soak(seeds, args.n, engines=engines, on_result=emit)
+    results = chaos_soak(
+        seeds,
+        args.n,
+        engines=engines,
+        on_result=emit,
+        ensemble=not args.no_ensemble,
+    )
     failures = [r for r in results if not r["ok"]]
     if args.out:
         meta = run_metadata()

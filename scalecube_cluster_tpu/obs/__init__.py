@@ -31,16 +31,41 @@ from scalecube_cluster_tpu.obs.export import (
 from scalecube_cluster_tpu.obs.latency import detection_latencies, latency_histogram
 from scalecube_cluster_tpu.obs.profiling import trace_scope
 
+#: obs/ensemble.py names re-exported LAZILY (PEP 562): that module imports
+#: jax, and this package must stay importable without it — the bench driver
+#: process imports obs.export and relies on run_metadata's platform
+#: detection staying passive (no jax import on its account).
+_ENSEMBLE_EXPORTS = (
+    "ensemble_report",
+    "first_tick_where",
+    "masked_quantiles",
+    "population_stats",
+)
+
+
+def __getattr__(name):
+    if name in _ENSEMBLE_EXPORTS:
+        from scalecube_cluster_tpu.obs import ensemble as _ensemble
+
+        return getattr(_ensemble, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
 __all__ = [
     "SCHEMA_VERSION",
     "SHARED_COUNTERS",
     "ProtocolCounters",
     "append_jsonl",
     "detection_latencies",
+    "ensemble_report",
+    "first_tick_where",
     "jsonl_line",
     "latency_histogram",
     "make_row",
+    "masked_quantiles",
+    "population_stats",
     "prometheus_text",
+    # (ensemble_report / first_tick_where / masked_quantiles /
+    # population_stats resolve lazily — see __getattr__ below.)
     "run_metadata",
     "trace_scope",
     "write_prometheus",

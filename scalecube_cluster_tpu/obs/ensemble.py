@@ -1,0 +1,258 @@
+"""Population statistics over an ensemble run — reduced ON DEVICE, then one
+transfer.
+
+An ensemble run (sim/ensemble.py) leaves its flight-recorder traces shaped
+``[B, T]`` per counter. The per-universe numbers a sweep actually reports —
+convergence times, first-verdict latencies, counter totals — are reductions
+over the tick axis, and the population shape over universes (CDF support,
+nearest-rank percentiles, min/mean/max envelopes) is a reduction over the
+batch axis. Both happen here under one jit (:func:`population_stats`) so the
+host sees B-sized vectors and a handful of scalars instead of ``B × T``
+trace matrices.
+
+:func:`ensemble_report` is the full pipeline: device stats + raw traces in a
+SINGLE ``device_get``, the batched C1-C7 certifier
+(testlib/invariants.py::certify_population) for the per-universe pass/fail
+bitmap, and schema-versioned rows (obs/export.py) — one
+``ensemble_population`` aggregate row plus one ``ensemble_universe`` row per
+universe, both JSONL/Prometheus-ready.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu.obs.export import make_row
+from scalecube_cluster_tpu.testlib.invariants import certify_population
+
+#: Nearest-rank percentiles reported for every latency population.
+QUANTILES = (0.5, 0.9, 0.99)
+
+#: Per-tick counters whose per-universe TOTALS get population envelopes.
+ENVELOPE_KEYS = (
+    "link_attempts",
+    "link_delivered",
+    "fault_blocked",
+    "fault_lost",
+    "msgs_gossip",
+    "msgs_fd",
+    "msgs_sync",
+    "pings",
+    "acks",
+    "suspicions_raised",
+    "verdicts_dead",
+)
+
+#: Trace keys excluded from generic counter handling (not event counts).
+_NON_COUNTER = ("tick", "convergence")
+
+
+def first_tick_where(mask: jax.Array) -> jax.Array:
+    """``[B, T]`` bool -> ``[B]`` int32: first tick where the condition
+    holds per universe, ``-1`` where it never does. The device primitive
+    behind every latency statistic here (argmax of a bool row is its first
+    True)."""
+    hit = jnp.any(mask, axis=1)
+    idx = jnp.argmax(mask, axis=1).astype(jnp.int32)
+    return jnp.where(hit, idx, jnp.int32(-1))
+
+
+def masked_quantiles(x: jax.Array, valid: jax.Array, qs=QUANTILES) -> jax.Array:
+    """Nearest-rank quantiles of ``x[valid]`` without a host round trip.
+
+    ``jnp.percentile`` cannot mask, so invalid entries sort to ``+inf`` and
+    ranks index only the first ``count(valid)`` slots. Returns ``[len(qs)]``
+    float32, NaN when nothing is valid (empty population)."""
+    xf = jnp.where(valid, x.astype(jnp.float32), jnp.inf)
+    s = jnp.sort(xf)
+    cnt = jnp.sum(valid)
+    picks = []
+    for q in qs:
+        rank = jnp.ceil(q * cnt).astype(jnp.int32) - 1
+        rank = jnp.clip(rank, 0, x.shape[0] - 1)
+        picks.append(jnp.where(cnt > 0, s[rank], jnp.float32(jnp.nan)))
+    return jnp.stack(picks)
+
+
+@jax.jit
+def population_stats(traces: dict) -> dict:
+    """On-device population reductions over ``[B, T]`` ensemble traces.
+
+    Emits, per available signal:
+
+    - ``convergence_time`` ``[B]`` (re-convergence: first tick from which
+      the universe STAYS fully converged; -1 if still unconverged at the
+      end), its sorted form ``convergence_time_sorted`` (the empirical CDF
+      support; never-converged universes sort last as ``T``), nearest-rank
+      ``convergence_time_q`` (:data:`QUANTILES`), ``frac_converged``, and
+      ``final_convergence`` ``[B]``;
+    - ``first_<k>_tick`` ``[B]`` + ``first_<k>_q`` for the suspicion /
+      DEAD-verdict latency counters;
+    - per-universe totals ``<k>_total`` ``[B]`` and scalar population
+      envelopes ``<k>_env`` ``[3]`` (min/mean/max of the totals) for every
+      :data:`ENVELOPE_KEYS` counter present;
+    - per-tick envelopes ``<k>_tick_env`` ``[3, T]`` (min/mean/max across
+      universes at each tick) for the same counters — the band plots of a
+      sweep report.
+
+    The whole dict is device-resident; callers batch it into ONE
+    ``device_get`` (see :func:`ensemble_report`).
+    """
+    stats: dict = {}
+    some = next(iter(traces.values()))
+    t_len = some.shape[1]
+    if "convergence" in traces:  # tpulint: disable=R1 -- dict-key membership: trace-time structural, not a traced value
+        conv = traces["convergence"]
+        # Re-convergence time: the first tick FROM WHICH the universe stays
+        # fully converged (runs start converged, so "first converged tick"
+        # would be 0 everywhere — the interesting number is how long the
+        # disturbance's damage lasts). -1 = still unconverged at the end.
+        bad = conv < 1.0
+        any_bad = jnp.any(bad, axis=1)
+        last_bad = t_len - 1 - jnp.argmax(bad[:, ::-1], axis=1).astype(jnp.int32)
+        settled = jnp.where(any_bad, last_bad + 1, 0).astype(jnp.int32)
+        reached = conv[:, -1] >= 1.0
+        ct = jnp.where(reached, settled, jnp.int32(-1))
+        stats["convergence_time"] = ct
+        stats["convergence_time_sorted"] = jnp.sort(
+            jnp.where(reached, ct, jnp.int32(t_len))
+        )
+        stats["convergence_time_q"] = masked_quantiles(ct, reached)
+        stats["frac_converged"] = jnp.mean(reached.astype(jnp.float32))
+        stats["final_convergence"] = conv[:, -1]
+    for key in ("suspicions_raised", "verdicts_dead"):
+        if key in traces:  # tpulint: disable=R1 -- dict-key membership: trace-time structural, not a traced value
+            ft = first_tick_where(traces[key] > 0)
+            stats[f"first_{key}_tick"] = ft
+            stats[f"first_{key}_q"] = masked_quantiles(ft, ft >= 0)
+    for key in ENVELOPE_KEYS:
+        arr = traces.get(key)
+        if arr is None or arr.ndim != 2 or key in _NON_COUNTER:
+            continue
+        tot = jnp.sum(arr, axis=1)
+        stats[f"{key}_total"] = tot
+        stats[f"{key}_env"] = jnp.stack(
+            [
+                jnp.min(tot).astype(jnp.float32),
+                jnp.mean(tot.astype(jnp.float32)),
+                jnp.max(tot).astype(jnp.float32),
+            ]
+        )
+        stats[f"{key}_tick_env"] = jnp.stack(
+            [
+                jnp.min(arr, axis=0).astype(jnp.float32),
+                jnp.mean(arr.astype(jnp.float32), axis=0),
+                jnp.max(arr, axis=0).astype(jnp.float32),
+            ]
+        )
+    return stats
+
+
+def _scalar(x) -> float:
+    v = float(x)
+    return v
+
+
+def ensemble_report(
+    params,
+    traces: dict,
+    final_convergence=None,
+    meta: dict | None = None,
+    certify: bool = True,
+) -> dict:
+    """Full population report for one ensemble run.
+
+    ``params`` is the run's :class:`~..sim.params.SimParams` (sparse runs
+    pass ``sparse_params.base``); ``traces`` the ``[B, T]`` trace dict;
+    ``final_convergence`` an optional ``[B]`` end-of-run convergence vector
+    (dense callers can omit it — the ``convergence`` trace supplies it).
+    The device stats and the raw certifier traces come back in a SINGLE
+    ``jax.device_get``.
+
+    Returns ``{"stats", "certification", "rows"}``: host-side stats arrays,
+    the :func:`certify_population` verdict (or ``None`` when ``certify`` is
+    off / event gauges are absent), and export rows — one aggregate
+    ``ensemble_population`` row followed by B ``ensemble_universe`` rows,
+    ready for obs/export.py::append_jsonl / write_prometheus.
+    """
+    from scalecube_cluster_tpu.testlib.invariants import REQUIRED_KEYS
+
+    dev = {"stats": population_stats(traces)}
+    certifiable = certify and all(k in traces for k in REQUIRED_KEYS)
+    if certifiable:
+        dev["cert_traces"] = {k: traces[k] for k in REQUIRED_KEYS}
+    if final_convergence is not None:
+        dev["final_convergence"] = final_convergence
+    pulled = jax.device_get(dev)
+    stats = pulled["stats"]
+
+    b_count = None
+    for v in traces.values():
+        b_count = int(v.shape[0])
+        break
+    if b_count is None:
+        raise ValueError("ensemble_report needs at least one trace")
+
+    final_conv = pulled.get("final_convergence")
+    if final_conv is None and "final_convergence" in stats:
+        final_conv = stats["final_convergence"]
+
+    cert = None
+    if certifiable:
+        cert = certify_population(
+            params, pulled["cert_traces"], final_convergence=final_conv
+        )
+
+    agg: dict = {"universes": b_count}
+    if "frac_converged" in stats:
+        agg["frac_converged"] = _scalar(stats["frac_converged"])
+        for q, v in zip(QUANTILES, stats["convergence_time_q"]):
+            agg[f"convergence_time_p{int(q * 100)}"] = _scalar(v)
+    if "first_verdicts_dead_q" in stats:
+        for q, v in zip(QUANTILES, stats["first_verdicts_dead_q"]):
+            agg[f"verdict_latency_p{int(q * 100)}"] = _scalar(v)
+    for key in ENVELOPE_KEYS:
+        env = stats.get(f"{key}_env")
+        if env is None:
+            continue
+        agg[f"{key}_total_min"] = _scalar(env[0])
+        agg[f"{key}_total_mean"] = _scalar(env[1])
+        agg[f"{key}_total_max"] = _scalar(env[2])
+    if cert is not None:
+        agg["pass_rate"] = float(np.mean(cert["ok"]))
+        agg["failures"] = int(np.sum(~cert["ok"]))
+    # NaN quantiles (no universe qualified — e.g. none re-converged yet)
+    # would serialize as bare `NaN`, which is not RFC-8259 JSON; drop them.
+    agg = {
+        k: v
+        for k, v in agg.items()
+        if not (isinstance(v, float) and math.isnan(v))
+    }
+    rows = [make_row("ensemble_population", agg, meta)]
+
+    for b in range(b_count):
+        payload: dict = {"universe": b}
+        if "convergence_time" in stats:
+            payload["convergence_time"] = int(stats["convergence_time"][b])
+        if final_conv is not None:
+            payload["final_convergence"] = float(np.asarray(final_conv)[b])
+        if "first_verdicts_dead_tick" in stats:
+            payload["first_verdict_tick"] = int(
+                stats["first_verdicts_dead_tick"][b]
+            )
+        for key in ("link_attempts", "suspicions_raised", "verdicts_dead"):
+            tot = stats.get(f"{key}_total")
+            if tot is not None:
+                payload[f"{key}_total"] = int(tot[b])
+        if cert is not None:
+            payload["ok"] = bool(cert["ok"][b])
+            violation = cert["violations"][b]
+            if violation is not None:
+                payload["violation"] = violation["invariant"]
+        rows.append(make_row("ensemble_universe", payload, meta))
+
+    return {"stats": stats, "certification": cert, "rows": rows}

@@ -29,6 +29,11 @@ AXIS = "members"
 #: Second mesh axis of :func:`make_mesh2d`: shards the SUBJECT (column) axis
 #: of the [viewer, subject] matrices — the SP×TP analog of SURVEY.md §2.10.
 SUBJECT_AXIS = "subjects"
+#: Mesh axis of :func:`make_universe_mesh`: shards the LEADING batch axis of
+#: an ensemble run (sim/ensemble.py) — universes are embarrassingly parallel
+#: (vmap inserts no cross-universe ops), so the axis is pure data-parallel
+#: fan-out: no collectives, per-device memory and FLOPs scale 1/D.
+UNIVERSE_AXIS = "universes"
 
 
 def make_mesh(devices=None) -> Mesh:
@@ -50,6 +55,32 @@ def make_mesh2d(shape: tuple[int, int], devices=None) -> Mesh:
     devices = jax.devices() if devices is None else devices
     dm, ds = shape
     return Mesh(np.asarray(devices[: dm * ds]).reshape(dm, ds), (AXIS, SUBJECT_AXIS))
+
+
+def make_universe_mesh(devices=None) -> Mesh:
+    """One-axis mesh over the ENSEMBLE batch axis (B % D == 0 required by
+    GSPMD for an even split). Orthogonal to :func:`make_mesh` — a member-axis
+    mesh shards one big cluster across chips; a universe mesh runs D small
+    clusters per chip-group side by side (the sweep layout)."""
+    devices = jax.devices() if devices is None else devices
+    return Mesh(np.asarray(devices), (UNIVERSE_AXIS,))
+
+
+def ensemble_shardings(tree, mesh: Mesh):
+    """A ``tree``-shaped pytree of NamedShardings splitting every leaf's
+    leading (universe) axis. Uniform by construction: stacked ensemble
+    pytrees (sim/ensemble.py::stack_universes) give every leaf — state
+    matrices, schedule segments, knob scalars — the same leading B."""
+    shard = NamedSharding(mesh, P(UNIVERSE_AXIS))
+    return jax.tree_util.tree_map(lambda _: shard, tree)
+
+
+def shard_ensemble(tree, mesh: Mesh):
+    """Place a stacked ensemble pytree (states / plans / knobs) onto a
+    universe mesh. The jitted ensemble runners see sharded inputs and GSPMD
+    propagates the universe axis through the whole scan — zero collectives,
+    since vmap never mixes universes."""
+    return jax.device_put(tree, ensemble_shardings(tree, mesh))
 
 
 def _specs(mesh: Mesh) -> tuple[P, P, P]:

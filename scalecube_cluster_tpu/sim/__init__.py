@@ -39,13 +39,40 @@ from scalecube_cluster_tpu.sim.state import (
 )
 from scalecube_cluster_tpu.sim.tick import sim_tick
 from scalecube_cluster_tpu.sim.run import run_chunked, run_ticks, run_until
+from scalecube_cluster_tpu.sim.knobs import Knobs, make_knobs
+from scalecube_cluster_tpu.sim.ensemble import (
+    ensemble_size,
+    ensemble_sparse_convergence,
+    index_universe,
+    init_ensemble_dense,
+    init_ensemble_sparse,
+    knob_grid,
+    run_ensemble_chunked,
+    run_ensemble_sparse_chunked,
+    run_ensemble_sparse_ticks,
+    run_ensemble_ticks,
+    stack_universes,
+)
 
 __all__ = [
     "FaultPlan",
     "FaultSchedule",
+    "Knobs",
     "ScheduleBuilder",
     "SimParams",
     "SimState",
+    "ensemble_size",
+    "ensemble_sparse_convergence",
+    "index_universe",
+    "init_ensemble_dense",
+    "init_ensemble_sparse",
+    "knob_grid",
+    "make_knobs",
+    "run_ensemble_chunked",
+    "run_ensemble_sparse_chunked",
+    "run_ensemble_sparse_ticks",
+    "run_ensemble_ticks",
+    "stack_universes",
     "cluster_summary",
     "sparse_summary",
     "init_full_view",

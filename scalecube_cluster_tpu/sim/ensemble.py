@@ -1,0 +1,292 @@
+"""Ensemble engine: B independent cluster universes, one compiled call.
+
+Every scenario the repo runs today — a chaos seed, a fault timeline, a
+config point — is a separate host-driven run even though the schedules were
+deliberately made fixed-shape (sim/schedule.py) so ONE executable covers
+them all. This module closes that gap: B universes stack along a leading
+axis (states, schedules, knobs — same pytree treedef, stacked leaves) and
+step together under ``jax.vmap`` of the UNJITTED scan cores
+(sim/run.py::scan_ticks, sim/sparse.py::scan_sparse_ticks), jitted once out
+here. The executable is keyed on (engine, n, B, n_ticks, plan treedef) —
+every seed and every knob point of a sweep is pure data, so a whole
+seed×config grid is zero recompiles after the first call (pinned by
+tests/test_ensemble.py).
+
+Population statistics over the batch (convergence CDFs, latency
+percentiles, counter envelopes) live in obs/ensemble.py; the universe-axis
+device sharding in parallel/mesh.py; the CLI in experiments/sweep.py.
+
+Semantics: universe b of a vmapped run is bit-identical to the equivalent
+single run — vmap only adds a batch dimension; ``lax.cond`` lowers to
+``select`` under vmap (all universes execute both branches every tick, a
+throughput cost accounted in PERF.md, never a correctness one).
+
+Per-universe SCALAR protocol knobs ride as a stacked :class:`~.knobs.Knobs`
+pytree — traced data, not static params — so e.g. 4 suspicion multipliers ×
+2 fan-out caps × 4 seeds is one executable, not 8 (sim/knobs.py).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from scalecube_cluster_tpu.ops.merge import decode_epoch, decode_status
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.knobs import Knobs, make_knobs
+from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.run import scan_ticks
+from scalecube_cluster_tpu.sim.schedule import FaultSchedule
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    SparseState,
+    _writeback_free_impl,
+    effective_view,
+    init_sparse_full_view,
+    scan_sparse_ticks,
+)
+from scalecube_cluster_tpu.sim.state import SimState, init_full_view
+
+from scalecube_cluster_tpu.cluster_api.member import MemberStatus
+
+_ALIVE = int(MemberStatus.ALIVE)
+_DEAD = int(MemberStatus.DEAD)
+
+
+# --------------------------------------------------------------- stacking
+def stack_universes(items):
+    """Stack B same-treedef pytrees into one batched pytree (leading B).
+
+    The fixed-shape property of :class:`FaultSchedule` (constant segment /
+    event counts) is exactly what makes a batch of sampled schedules
+    stackable: every leaf has the same shape, the treedef never changes, so
+    the stacked plan keeps the SAME treedef as an unstacked one — and with
+    it the same cached executable family.
+    """
+    items = list(items)
+    if not items:
+        raise ValueError("stack_universes needs at least one universe")
+    treedefs = {jax.tree_util.tree_structure(it) for it in items}
+    if len(treedefs) != 1:
+        raise ValueError(
+            f"universes disagree on pytree structure: {sorted(map(str, treedefs))}"
+        )
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *items)
+
+
+def index_universe(tree, b: int):
+    """Slice universe ``b`` back out of a stacked pytree/trace dict."""
+    return jax.tree_util.tree_map(lambda a: a[b], tree)
+
+
+def init_ensemble_dense(
+    n: int, init_seeds, user_gossip_slots: int = 4, **kw
+) -> SimState:
+    """Stacked :func:`init_full_view` states, one per RNG seed in
+    ``init_seeds`` (each universe gets its own PRNG stream — the seed axis
+    of a sweep)."""
+    return stack_universes(
+        init_full_view(n, user_gossip_slots, seed=int(s), **kw)
+        for s in init_seeds
+    )
+
+
+def init_ensemble_sparse(
+    n: int,
+    init_seeds,
+    slot_budget: int = 2048,
+    user_gossip_slots: int = 4,
+    **kw,
+) -> SparseState:
+    """Stacked :func:`init_sparse_full_view` states, one per RNG seed."""
+    return stack_universes(
+        init_sparse_full_view(
+            n,
+            slot_budget=slot_budget,
+            seed=int(s),
+            user_gossip_slots=user_gossip_slots,
+            **kw,
+        )
+        for s in init_seeds
+    )
+
+
+def knob_grid(
+    params: SimParams, suspicion_mults=(1.0,), fanout_caps=(None,)
+) -> Knobs:
+    """Stacked knob lattice: the cross-product of the two scalar sweeps, in
+    ``suspicion_mults``-major order. Pair with equal-length seed lists for a
+    full seed×config grid (repeat seeds across the lattice as needed)."""
+    return stack_universes(
+        make_knobs(params, suspicion_mult=float(m), fanout_cap=c)
+        for m in suspicion_mults
+        for c in fanout_caps
+    )
+
+
+def ensemble_size(states) -> int:
+    """B, read off the stacked state's leading axis."""
+    return int(jax.tree_util.tree_leaves(states)[0].shape[0])
+
+
+# ---------------------------------------------------------- dense engine
+@partial(jax.jit, static_argnums=(0, 4), static_argnames=("collect",))
+def run_ensemble_ticks(
+    params: SimParams,
+    states: SimState,
+    plans: FaultPlan | FaultSchedule,
+    seeds: jax.Array,
+    n_ticks: int,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Step B dense universes ``n_ticks`` periods in ONE compiled call.
+
+    ``states``/``plans``/``knobs`` are stacked pytrees (leading axis B);
+    ``seeds`` is the SHARED ``[N]`` bool seed-slot mask (universes model the
+    same deployment topology — per-universe randomness lives in each state's
+    PRNG stream). Returns ``(final_states, traces)`` with every trace leaf
+    shaped ``[B, n_ticks, ...]``.
+    """
+
+    def one(st, pl, kn):
+        return scan_ticks(params, st, pl, seeds, n_ticks, collect=collect, knobs=kn)
+
+    return jax.vmap(one)(states, plans, knobs)
+
+
+def run_ensemble_chunked(
+    params: SimParams,
+    states: SimState,
+    plans: FaultPlan | FaultSchedule,
+    seeds: jax.Array,
+    n_ticks: int,
+    chunk: int = 50,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Chunked ensemble driver (sim/run.py::run_chunked lifted over B):
+    fixed-size scan chunks reuse one executable per (params, B, chunk);
+    traces concatenate along the TICK axis and trim to ``[B, n_ticks, ...]``.
+    The states advance to the next chunk boundary, exactly like the
+    single-universe driver."""
+    if chunk <= 0:
+        raise ValueError("chunk must be positive")
+    if n_ticks <= 0:
+        return states, {}
+    pieces = []
+    done = 0
+    while done < n_ticks:
+        states, tr = run_ensemble_ticks(
+            params, states, plans, seeds, chunk, collect=collect, knobs=knobs
+        )
+        take = min(chunk, n_ticks - done)
+        pieces.append(
+            jax.tree_util.tree_map(
+                lambda a: np.asarray(jax.device_get(a))[:, :take], tr
+            )
+        )
+        done += take
+    traces = jax.tree_util.tree_map(lambda *xs: np.concatenate(xs, axis=1), *pieces)
+    return states, traces
+
+
+# --------------------------------------------------------- sparse engine
+@partial(
+    jax.jit, static_argnums=(0, 3), static_argnames=("collect",), donate_argnums=(1,)
+)
+def run_ensemble_sparse_ticks(
+    params: SparseParams,
+    states: SparseState,
+    plans: FaultPlan | FaultSchedule,
+    n_ticks: int,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Sparse twin of :func:`run_ensemble_ticks`: B working-set universes,
+    one donated call (the stacked ``view_T`` is B × the single-run
+    footprint — donation matters even more here)."""
+
+    def one(st, pl, kn):
+        return scan_sparse_ticks(params, st, pl, n_ticks, collect=collect, knobs=kn)
+
+    return jax.vmap(one)(states, plans, knobs)
+
+
+@partial(jax.jit, static_argnums=0, donate_argnums=(1,))
+def ensemble_writeback_free(params: SparseParams, states: SparseState) -> SparseState:
+    """Batched host-boundary slot free/write-back (sim/sparse.py::
+    writeback_free vmapped; state donated for the in-place scatter)."""
+    return jax.vmap(partial(_writeback_free_impl, params))(states)
+
+
+def run_ensemble_sparse_chunked(
+    params: SparseParams,
+    states: SparseState,
+    plans: FaultPlan | FaultSchedule,
+    n_ticks: int,
+    chunk: int = 48,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Chunked sparse ensemble driver with host-boundary frees between
+    chunks (run_sparse_chunked lifted over B — requires
+    ``in_scan_writeback=False``, same two-variant chunk/tail compile
+    pattern). Traces accumulate host-side as ``[B, n_ticks, ...]``."""
+    if params.in_scan_writeback:
+        raise ValueError("use in_scan_writeback=False with the chunked runner")
+    whole, tail = divmod(n_ticks, chunk)
+    pieces = []
+
+    def grab(tr):
+        pieces.append(
+            jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a)), tr)
+        )
+
+    for _ in range(whole):
+        states, tr = run_ensemble_sparse_ticks(
+            params, states, plans, chunk, collect=collect, knobs=knobs
+        )
+        states = ensemble_writeback_free(params, states)
+        if collect:
+            grab(tr)
+    if tail:
+        states, tr = run_ensemble_sparse_ticks(
+            params, states, plans, tail, collect=collect, knobs=knobs
+        )
+        states = ensemble_writeback_free(params, states)
+        if collect:
+            grab(tr)
+    if not pieces:
+        return states, {}
+    traces = jax.tree_util.tree_map(lambda *xs: np.concatenate(xs, axis=1), *pieces)
+    return states, traces
+
+
+# ---------------------------------------------------------- convergence
+def sparse_convergence_device(state: SparseState) -> jax.Array:
+    """The dense engine's convergence measure (sim/tick.py metrics) on a
+    sparse state's materialized view, AS A DEVICE SCALAR — O(n²), small-n
+    analysis only. testlib/chaos.py::sparse_convergence is the host-float
+    wrapper; :func:`ensemble_sparse_convergence` the batched form."""
+    view = effective_view(state)
+    n = view.shape[0]
+    alive = state.alive
+    status = decode_status(view)
+    truth_alive = alive[None, :] & (decode_epoch(view) == state.epoch[None, :])
+    ok_alive = truth_alive & (status == _ALIVE)
+    ok_dead = ~alive[None, :] & ((status == _DEAD) | (view < 0))
+    match = jnp.where(alive[None, :], ok_alive, ok_dead) | jnp.eye(n, dtype=bool)
+    viewer_conv = jnp.mean(match, axis=1)
+    n_alive = jnp.sum(alive)
+    return jnp.sum(viewer_conv * alive) / jnp.maximum(n_alive, 1)
+
+
+@jax.jit
+def ensemble_sparse_convergence(states: SparseState) -> jax.Array:
+    """``[B]`` final convergence across a stacked sparse ensemble — one
+    device reduction, one scalar vector to the host."""
+    return jax.vmap(sparse_convergence_device)(states)

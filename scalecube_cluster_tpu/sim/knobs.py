@@ -1,0 +1,88 @@
+"""Per-universe protocol knobs: traced scalars instead of static params.
+
+``SimParams`` is a frozen, hashable dataclass passed as a STATIC jit
+argument — every distinct value compiles a fresh executable. That is right
+for shape-carrying constants (``n``, the fan-out loop bound) but wrong for
+an ensemble sweep (sim/ensemble.py), where B universes want to vary scalar
+protocol constants WITHOUT B executables. :class:`Knobs` is the traced
+escape hatch: a tiny pytree of per-universe scalars threaded through
+``sim_tick`` / ``sparse_tick`` as DATA, so one vmapped program sweeps a
+config lattice the way it sweeps seeds.
+
+Semantics (identity knobs reproduce the knob-free tick bit-for-bit):
+
+- ``suspicion_mult`` (f32, identity 1.0) scales ``params.suspicion_ticks``
+  wherever a tick ARMS a suspicion countdown. The timeout is a fill value,
+  never a shape, so scaling it is pure data flow.
+- ``fanout_cap`` (i32, identity ``params.gossip_fanout``) masks gossip
+  fan-out channels ``c >= cap`` out of existence: a capped channel's edges
+  deliver nothing, attempt nothing, and count nothing (message counters and
+  the C1 conservation split see the same masked world). The static
+  ``params.gossip_fanout`` stays the loop bound — the lattice's MAX fanout —
+  while the cap is the traced effective fanout.
+
+Knobs require the XLA tick paths: the fused Pallas cores bake the suspicion
+timeout as a kernel constant, so knobbed runs must keep
+``pallas_delivery=False`` / ``pallas_core=False`` (enforced at trace time by
+the ticks).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu.sim.params import SimParams
+
+#: ``suspect_left`` / ``susp`` countdowns are int16 — a scaled timeout must
+#: stay representable (mirrors the SimParams.__post_init__ validation).
+_SUSP_MAX = (1 << 15) - 1
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class Knobs:
+    """Traced per-universe protocol scalars (see module docstring)."""
+
+    suspicion_mult: jax.Array  # f32 scalar
+    fanout_cap: jax.Array  # i32 scalar
+
+
+def make_knobs(
+    params: SimParams,
+    suspicion_mult: float = 1.0,
+    fanout_cap: int | None = None,
+) -> Knobs:
+    """One universe's knob point; defaults are the identity (no change)."""
+    cap = params.gossip_fanout if fanout_cap is None else fanout_cap
+    if not isinstance(cap, jax.Array):
+        cap = int(cap)
+        if not 0 <= cap <= params.gossip_fanout:
+            raise ValueError(
+                f"fanout_cap {cap} outside [0, {params.gossip_fanout}] — the "
+                "static params.gossip_fanout is the lattice maximum"
+            )
+    return Knobs(
+        suspicion_mult=jnp.asarray(suspicion_mult, jnp.float32),
+        fanout_cap=jnp.asarray(cap, jnp.int32),
+    )
+
+
+def suspicion_fill(suspicion_ticks: int, knobs: Knobs | None):
+    """The countdown value armed on a fresh SUSPECT record: the static
+    constant without knobs (bit-identical legacy graph), else the scaled
+    traced scalar."""
+    if knobs is None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+        return suspicion_ticks
+    scaled = jnp.round(suspicion_ticks * knobs.suspicion_mult).astype(jnp.int32)
+    return jnp.clip(scaled, 1, _SUSP_MAX)
+
+
+def edge_live(gossip_fanout: int, knobs: Knobs | None):
+    """``[fanout]`` bool mask of live gossip channels (None without knobs —
+    callers skip the mask entirely and keep the legacy graph)."""
+    if knobs is None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+        return None
+    return jnp.arange(gossip_fanout, dtype=jnp.int32) < knobs.fanout_cap

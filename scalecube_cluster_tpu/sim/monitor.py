@@ -77,21 +77,11 @@ def cluster_summary(state: SimState) -> dict:
     }
 
 
-def sparse_summary(state, traces=None) -> dict:
-    """Whole-cluster aggregates for the compact-rumor engine
-    (sim/sparse.py::SparseState) — the working-set twin of
-    :func:`cluster_summary`, plus slot-table health (the metric the
-    reference's gossip-map size would expose via JMX).
-
-    Reduces ON DEVICE and transfers only scalars — at the engine's target
-    scale the slab is ~1 GB, so a host copy per monitoring call would
-    dwarf the ticks being monitored.
-
-    Pass the run's collected ``traces`` to additionally surface the fault
-    accounting totals (``fault_blocked_total`` / ``fault_lost_total`` /
-    ``link_attempts_total`` / ``link_delivered_total`` — obs/counters.py
-    conservation split) over the traced window.
-    """
+def _sparse_summary_device(state) -> dict:
+    """Device-side reduction dict behind :func:`sparse_summary` — pure jnp
+    on ONE universe's state, so the batched path is exactly ``jax.vmap`` of
+    it (the ``wb_pinned`` branch is structural: pytree field presence, the
+    same across a stacked ensemble)."""
     import jax.numpy as jnp
 
     status = decode_status(state.slab)
@@ -116,11 +106,44 @@ def sparse_summary(state, traces=None) -> dict:
             state.wb_pinned & (state.slot_subj >= 0)
         )
         summary["wb_mask_valid"] = state.wb_valid.astype(jnp.int32)
+    return summary
+
+
+def sparse_summary(state, traces=None) -> dict:
+    """Whole-cluster aggregates for the compact-rumor engine
+    (sim/sparse.py::SparseState) — the working-set twin of
+    :func:`cluster_summary`, plus slot-table health (the metric the
+    reference's gossip-map size would expose via JMX).
+
+    Reduces ON DEVICE and transfers only scalars — at the engine's target
+    scale the slab is ~1 GB, so a host copy per monitoring call would
+    dwarf the ticks being monitored.
+
+    Accepts a stacked ENSEMBLE state too (sim/ensemble.py — every leaf with
+    a leading universe axis, detected off ``alive.ndim == 2``): the same
+    reductions run vmapped and every value comes back as an ``[B]`` numpy
+    vector instead of an int, still in ONE batched ``device_get``.
+
+    Pass the run's collected ``traces`` to additionally surface the fault
+    accounting totals (``fault_blocked_total`` / ``fault_lost_total`` /
+    ``link_attempts_total`` / ``link_delivered_total`` — obs/counters.py
+    conservation split) over the traced window (per universe, summed over
+    the tick axis, when batched).
+    """
+    batched = state.alive.ndim == 2
+    if batched:
+        summary = jax.vmap(_sparse_summary_device)(state)
+    else:
+        summary = _sparse_summary_device(state)
     # One batched transfer for the whole dict — per-metric device_get would
     # issue a blocking round-trip per key.
-    out = {k: int(v) for k, v in jax.device_get(summary).items()}
-    out["n"] = int(state.alive.size)
-    out["slot_budget"] = int(state.slot_subj.size)
+    pulled = jax.device_get(summary)
+    if batched:
+        out: dict = {k: np.asarray(v) for k, v in pulled.items()}
+    else:
+        out = {k: int(v) for k, v in pulled.items()}
+    out["n"] = int(state.alive.shape[-1])
+    out["slot_budget"] = int(state.slot_subj.shape[-1])
     if traces is not None:
         for key in (
             "link_attempts",
@@ -130,10 +153,13 @@ def sparse_summary(state, traces=None) -> dict:
         ):
             if key in traces:
                 # Traces may already be host numpy (run_sparse_chunked) —
-                # sum host-side; python ints don't overflow.
-                out[f"{key}_total"] = int(
-                    np.sum(np.asarray(jax.device_get(traces[key])))
-                )
+                # sum host-side; python ints don't overflow. Batched traces
+                # are [B, T]: keep the universe axis, reduce ticks.
+                arr = np.asarray(jax.device_get(traces[key]))
+                if batched:
+                    out[f"{key}_total"] = arr.sum(axis=tuple(range(1, arr.ndim)))
+                else:
+                    out[f"{key}_total"] = int(arr.sum())
     return out
 
 

@@ -19,6 +19,7 @@ from jax import lax
 import jax.numpy as jnp
 
 from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.knobs import Knobs
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
@@ -31,6 +32,40 @@ from scalecube_cluster_tpu.sim.state import SimState
 from scalecube_cluster_tpu.sim.tick import sim_tick
 
 
+def scan_ticks(
+    params: SimParams,
+    state: SimState,
+    plan: FaultPlan | FaultSchedule,
+    seeds: jax.Array,
+    n_ticks: int,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """UNJITTED scan body of :func:`run_ticks` — the piece the ensemble
+    engine (sim/ensemble.py) vmaps directly under its own jit."""
+    scheduled = isinstance(plan, FaultSchedule)
+
+    def step(carry: SimState, _):
+        if scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
+            t = carry.tick + 1  # the global tick about to execute
+            kill_m, restart_m = events_at(plan, t, params.n)
+            carry = apply_events_dense(carry, kill_m, restart_m)
+            plan_t = plan_at(plan, t)
+        else:
+            plan_t = plan
+        new_state, metrics = sim_tick(
+            params, carry, plan_t, seeds, collect=collect, knobs=knobs
+        )
+        if scheduled and collect:  # tpulint: disable=R1 -- both are trace-time constants (pytree type + static argname)
+            metrics = dict(metrics)
+            metrics["plan_dirty"] = plan_dirty_at(plan, t)
+            metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
+            metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+        return new_state, metrics
+
+    return lax.scan(step, state, None, length=n_ticks)
+
+
 @partial(jax.jit, static_argnums=(0, 4), static_argnames=("collect",))
 def run_ticks(
     params: SimParams,
@@ -39,6 +74,7 @@ def run_ticks(
     seeds: jax.Array,
     n_ticks: int,
     collect: bool = True,
+    knobs: Knobs | None = None,
 ):
     """Run ``n_ticks`` gossip periods. Returns ``(final_state, metric_traces)``
     where each trace has leading axis ``n_ticks``. ``collect=False`` trims the
@@ -50,26 +86,11 @@ def run_ticks(
     transitions cost no host round trip and no recompile (the two plan forms
     are distinct pytree treedefs, so each gets its own cached executable).
     Scheduled traces additionally carry ``plan_dirty`` / ``kills_fired`` /
-    ``restarts_fired`` per tick for the invariant certifier."""
-    scheduled = isinstance(plan, FaultSchedule)
+    ``restarts_fired`` per tick for the invariant certifier.
 
-    def step(carry: SimState, _):
-        if scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
-            t = carry.tick + 1  # the global tick about to execute
-            kill_m, restart_m = events_at(plan, t, params.n)
-            carry = apply_events_dense(carry, kill_m, restart_m)
-            plan_t = plan_at(plan, t)
-        else:
-            plan_t = plan
-        new_state, metrics = sim_tick(params, carry, plan_t, seeds, collect=collect)
-        if scheduled and collect:  # tpulint: disable=R1 -- both are trace-time constants (pytree type + static argname)
-            metrics = dict(metrics)
-            metrics["plan_dirty"] = plan_dirty_at(plan, t)
-            metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
-            metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
-        return new_state, metrics
-
-    return lax.scan(step, state, None, length=n_ticks)
+    ``knobs`` (sim/knobs.py) threads per-run protocol scalars as traced
+    data; ``None`` keeps the legacy graph."""
+    return scan_ticks(params, state, plan, seeds, n_ticks, collect=collect, knobs=knobs)
 
 
 def run_chunked(
@@ -80,6 +101,7 @@ def run_chunked(
     n_ticks: int,
     chunk: int = 50,
     collect: bool = True,
+    knobs: Knobs | None = None,
 ):
     """Run ``n_ticks`` in fixed-size scan chunks so every call reuses ONE
     compiled executable per (params, chunk) — scan length is a static jit
@@ -100,7 +122,9 @@ def run_chunked(
     pieces = []
     done = 0
     while done < n_ticks:
-        state, tr = run_ticks(params, state, plan, seeds, chunk, collect=collect)
+        state, tr = run_ticks(
+            params, state, plan, seeds, chunk, collect=collect, knobs=knobs
+        )
         take = min(chunk, n_ticks - done)
         pieces.append(
             jax.tree_util.tree_map(lambda a: np.asarray(jax.device_get(a))[:take], tr)

@@ -105,6 +105,7 @@ from scalecube_cluster_tpu.sim.faults import (
     link_pass,
     round_trip_in_time,
 )
+from scalecube_cluster_tpu.sim.knobs import Knobs, edge_live, suspicion_fill
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
@@ -717,6 +718,7 @@ def sparse_tick(
     plan: FaultPlan,
     collect: bool = True,
     events=None,
+    knobs: Knobs | None = None,
 ):
     """One gossip period on the working set. Returns ``(state, metrics)``.
 
@@ -727,11 +729,20 @@ def sparse_tick(
     its own slot through the step-3 activation path and announces its
     bumped-epoch identity there. Events consume no RNG, so an event-free
     scheduled tick is bit-identical to the fixed-plan tick.
+
+    ``knobs`` (sim/knobs.py) threads per-run protocol scalars as traced
+    data — identity knobs are bit-identical to ``knobs=None``; the ensemble
+    engine vmaps over them for one-executable config sweeps.
     """
     p = params.base
     n, S = p.n, params.slot_budget
     if n % GROUP != 0:
         raise ValueError("sparse engine needs n % 8 == 0 (structured fan-out)")
+    if knobs is not None and params.pallas_core:
+        raise ValueError(
+            "knobs require the XLA tick core: sparse_core_pallas bakes the "
+            "suspicion timeout as a kernel constant (set pallas_core=False)"
+        )
     if events is not None:
         state = apply_events_sparse(state, events[0], events[1])
         restart_m = events[1]
@@ -1120,6 +1131,13 @@ def sparse_tick(
     edge_ok = jnp.stack(
         [alive[inv_perm[c]] & gpass[c] for c in range(p.gossip_fanout)]
     )
+    # Per-run knobs (sim/knobs.py): the fan-out cap folds into edge_ok once
+    # so delivery, user gossip, and accounting see the same masked world;
+    # the suspicion fill feeds the sweep and the window apply below.
+    elive = edge_live(p.gossip_fanout, knobs)
+    if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+        edge_ok = edge_ok & elive[:, None]
+    susp_fill = suspicion_fill(p.suspicion_ticks, knobs)
     susp_in = susp  # post-load countdowns: what dead viewers keep frozen
     age_in = age  # post-point ages: this tick's young mask (metrics below)
 
@@ -1207,7 +1225,7 @@ def sparse_tick(
         is_susp = is_suspect_key(slab2)
         susp = jnp.where(
             is_susp & active[None, :],
-            jnp.where(rearm | ~armed, p.suspicion_ticks, left0),
+            jnp.where(rearm | ~armed, susp_fill, left0),
             0,
         ).astype(jnp.int16)
         # Dead viewers freeze their (post-load) countdowns — identical to
@@ -1267,7 +1285,7 @@ def sparse_tick(
             is_s = is_suspect_key(new)
             new_susp = jnp.where(
                 app,
-                jnp.where(is_s, p.suspicion_ticks, 0),
+                jnp.where(is_s, susp_fill, 0),
                 susp_a[:, safe].astype(jnp.int32),
             ).astype(jnp.int16)
             susp_a = susp_a.at[:, route].set(new_susp, mode="drop")
@@ -1350,6 +1368,7 @@ def sparse_tick(
             # Forward perm in closed form from the structured draw — the
             # argsort fallback inside the step costs a full [f, N] sort.
             perm=perm_from_structured(ginv, rots, n, group=group),
+            edge_live=elive,
         )
     else:
         new_seen, uage, msgs_user = user_gossip_step(
@@ -1360,6 +1379,7 @@ def sparse_tick(
             alive,
             p.periods_to_spread,
             p.periods_to_sweep,
+            edge_live=elive,
         )
         uinf_ids, uptr = state.uinf_ids, state.uptr
 
@@ -1458,15 +1478,16 @@ def sparse_tick(
     # plane is re-attributed here from the same draws (gpass). User gossip
     # rides membership fan-out edges and is excluded (membership plane only,
     # matching the dense engine).
+    g_att_c = [
+        sender_active[inv_perm[c]] & alive[inv_perm[c]] & (inv_perm[c] != col)
+        for c in range(p.gossip_fanout)
+    ]
+    if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+        g_att_c = [m & elive[c] for c, m in enumerate(g_att_c)]
     g_acct = _acct_zero()
     for c in range(p.gossip_fanout):
-        g_att = (
-            sender_active[inv_perm[c]]
-            & alive[inv_perm[c]]
-            & (inv_perm[c] != col)
-        )
         g_blk = _edge_lookup(plan.block, inv_perm[c], col)
-        g_acct = _acct_add(g_acct, _link_acct(g_att, g_blk, gpass[c]))
+        g_acct = _acct_add(g_acct, _link_acct(g_att_c[c], g_blk, gpass[c]))
     acct = _acct_add(fd_out[7:], g_acct, sy_out[7:])
     viewer_live = alive[:, None] & active[None, :]
     was_dead = ((slab0 & DEAD_BIT) != 0) & (slab0 >= 0)
@@ -1478,14 +1499,7 @@ def sparse_tick(
         "n_suspected": jnp.sum(is_susp2 & alive[:, None] & active[None, :]),
         "msgs_fd": msgs_fd,
         "msgs_sync": msgs_sync,
-        "msgs_gossip": sum(
-            jnp.sum(
-                sender_active[inv_perm[c]]
-                & alive[inv_perm[c]]
-                & (inv_perm[c] != col)
-            )
-            for c in range(p.gossip_fanout)
-        ),
+        "msgs_gossip": sum(jnp.sum(m) for m in g_att_c),
         "msgs_user": msgs_user,
         "gossip_coverage": jnp.sum(new_seen & alive[:, None], axis=0)
         / jnp.maximum(jnp.sum(alive), 1),
@@ -1522,6 +1536,43 @@ def sparse_tick(
     return new_state, metrics
 
 
+def scan_sparse_ticks(
+    params: SparseParams,
+    state: SparseState,
+    plan: FaultPlan | FaultSchedule,
+    n_ticks: int,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """UNJITTED scan body of :func:`run_sparse_ticks` — the piece the
+    ensemble engine (sim/ensemble.py) vmaps directly, so donation lives only
+    on the outer jit (never jit-in-jit)."""
+    scheduled = isinstance(plan, FaultSchedule)
+
+    def step(carry, _):
+        if not scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
+            return sparse_tick(params, carry, plan, collect=collect, knobs=knobs)
+        t = carry.tick + 1  # the global tick about to execute
+        kill_m, restart_m = events_at(plan, t, params.base.n)
+        plan_t = plan_at(plan, t)
+        new_state, metrics = sparse_tick(
+            params,
+            carry,
+            plan_t,
+            collect=collect,
+            events=(kill_m, restart_m),
+            knobs=knobs,
+        )
+        if collect:
+            metrics = dict(metrics)
+            metrics["plan_dirty"] = plan_dirty_at(plan, t)
+            metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
+            metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+        return new_state, metrics
+
+    return lax.scan(step, state, None, length=n_ticks)
+
+
 @partial(
     jax.jit, static_argnums=(0, 3), static_argnames=("collect",), donate_argnums=(1,)
 )
@@ -1531,6 +1582,7 @@ def run_sparse_ticks(
     plan: FaultPlan | FaultSchedule,
     n_ticks: int,
     collect: bool = True,
+    knobs: Knobs | None = None,
 ):
     """``lax.scan`` driver, the sparse twin of sim/run.py::run_ticks.
 
@@ -1547,41 +1599,23 @@ def run_sparse_ticks(
     each keeps its own cached executable). Scheduled collected traces add
     ``plan_dirty`` / ``kills_fired`` / ``restarts_fired`` per tick.
 
+    ``knobs`` (sim/knobs.py) threads per-run protocol scalars as traced
+    data; ``None`` keeps the legacy graph.
+
     The input state is DONATED (its buffers are reused for the output) — at
     100k members the view_T alone is ~40 GB, so holding input + output
     copies would double the footprint. Rebind the result over the input
     (``st, tr = run_sparse_ticks(p, st, ...)``) and never touch the old
     reference.
     """
-    scheduled = isinstance(plan, FaultSchedule)
-
-    def step(carry, _):
-        if not scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
-            return sparse_tick(params, carry, plan, collect=collect)
-        t = carry.tick + 1  # the global tick about to execute
-        kill_m, restart_m = events_at(plan, t, params.base.n)
-        plan_t = plan_at(plan, t)
-        new_state, metrics = sparse_tick(
-            params, carry, plan_t, collect=collect, events=(kill_m, restart_m)
-        )
-        if collect:
-            metrics = dict(metrics)
-            metrics["plan_dirty"] = plan_dirty_at(plan, t)
-            metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
-            metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
-        return new_state, metrics
-
-    return lax.scan(step, state, None, length=n_ticks)
+    return scan_sparse_ticks(
+        params, state, plan, n_ticks, collect=collect, knobs=knobs
+    )
 
 
-@partial(jax.jit, static_argnums=0, donate_argnums=(1,))
-def writeback_free(params: SparseParams, state: SparseState) -> SparseState:
-    """Free done slots and write them back to ``view_T`` — the host-boundary
-    twin of the in-scan cond write-back (same pin rule, same tombstone
-    demotion). With the state DONATED, the view_T scatter happens in place:
-    exactly one [N, N] buffer stays live, which is what lets 32k+ members
-    run on a single chip (see SparseParams.in_scan_writeback).
-    """
+def _writeback_free_impl(params: SparseParams, state: SparseState) -> SparseState:
+    """Unjitted body of :func:`writeback_free` (the ensemble engine vmaps
+    this under its own donating jit)."""
     freeing, wb_subj, make_writeback = _free_plan(params, state)
     out = state.replace(
         view_T=state.view_T.at[wb_subj, :].set(make_writeback().T, mode="drop"),
@@ -1595,6 +1629,17 @@ def writeback_free(params: SparseParams, state: SparseState) -> SparseState:
     return out
 
 
+@partial(jax.jit, static_argnums=0, donate_argnums=(1,))
+def writeback_free(params: SparseParams, state: SparseState) -> SparseState:
+    """Free done slots and write them back to ``view_T`` — the host-boundary
+    twin of the in-scan cond write-back (same pin rule, same tombstone
+    demotion). With the state DONATED, the view_T scatter happens in place:
+    exactly one [N, N] buffer stays live, which is what lets 32k+ members
+    run on a single chip (see SparseParams.in_scan_writeback).
+    """
+    return _writeback_free_impl(params, state)
+
+
 def run_sparse_chunked(
     params: SparseParams,
     state: SparseState,
@@ -1602,6 +1647,7 @@ def run_sparse_chunked(
     n_ticks: int,
     chunk: int = 48,
     collect: bool = True,
+    knobs: Knobs | None = None,
 ):
     """Scan in chunks with host-boundary slot frees between them.
 
@@ -1635,12 +1681,16 @@ def run_sparse_chunked(
         )
 
     for _ in range(whole):
-        state, tr = run_sparse_ticks(params, state, plan, chunk, collect=collect)
+        state, tr = run_sparse_ticks(
+            params, state, plan, chunk, collect=collect, knobs=knobs
+        )
         state = writeback_free(params, state)
         if collect:
             grab(tr)
     if tail:
-        state, tr = run_sparse_ticks(params, state, plan, tail, collect=collect)
+        state, tr = run_sparse_ticks(
+            params, state, plan, tail, collect=collect, knobs=knobs
+        )
         state = writeback_free(params, state)
         if collect:
             grab(tr)

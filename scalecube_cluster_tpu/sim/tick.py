@@ -120,6 +120,7 @@ from scalecube_cluster_tpu.sim.faults import (
     link_pass,
     round_trip_in_time,
 )
+from scalecube_cluster_tpu.sim.knobs import Knobs, edge_live, suspicion_fill
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.state import AGE_STALE, SimState
 from scalecube_cluster_tpu.sim.usergossip import AGE_CAP as _AGE_CAP, user_gossip_step
@@ -292,6 +293,7 @@ def sim_tick(
     plan: FaultPlan,
     seeds: jax.Array,
     collect: bool = True,
+    knobs: Knobs | None = None,
 ):
     """Advance the cluster one gossip period. Returns ``(new_state, metrics)``.
 
@@ -303,8 +305,16 @@ def sim_tick(
         (selectSyncAddress draws from seeds ∪ members, :416-427).
       collect: static; False trims metrics to the tick counter (benchmark
         mode — skips the convergence/count reductions).
+      knobs: optional traced per-run protocol scalars (sim/knobs.py) —
+        identity knobs reproduce ``knobs=None`` bit-for-bit; the ensemble
+        engine vmaps over them to sweep a config lattice in one executable.
     """
     n = params.n
+    if knobs is not None and params.pallas_delivery:
+        raise ValueError(
+            "knobs require the XLA tick core: tick_core_pallas bakes the "
+            "suspicion timeout as a kernel constant (set pallas_delivery=False)"
+        )
     if params.track_user_infected and state.uinf.shape[1] != n:
         raise ValueError(
             "track_user_infected needs state built with track_infected=True "
@@ -387,6 +397,13 @@ def sim_tick(
     edge_ok = jnp.stack(
         [alive[inv_perm[c]] & gpass[c] for c in range(params.gossip_fanout)]
     )
+    # Per-run fan-out cap (sim/knobs.py): a capped channel delivers nothing
+    # and counts nothing — the mask folds into edge_ok once, every consumer
+    # (delivery, user gossip, accounting) sees the same masked world.
+    elive = edge_live(params.gossip_fanout, knobs)
+    if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+        edge_ok = edge_ok & elive[:, None]
+    susp_fill = suspicion_fill(params.suspicion_ticks, knobs)
 
     # A node whose table knows nobody retries its join SYNC every tick (the
     # initial-sync path, start0, MembershipProtocolImpl.java:222-257) —
@@ -521,7 +538,7 @@ def sim_tick(
         is_susp = ((view2 & 1) != 0) & ((view2 & DEAD_BIT) == 0) & (view2 >= 0)
         suspect_left = jnp.where(
             is_susp,
-            jnp.where(rearm | ~armed, params.suspicion_ticks, left0),
+            jnp.where(rearm | ~armed, susp_fill, left0),
             0,
         ).astype(jnp.int16)
         suspect_left = jnp.where(alive[:, None], suspect_left, state.suspect_left)
@@ -631,6 +648,8 @@ def sim_tick(
                 & ~known
                 & (alive[s] & nonself[c])[:, None]
             )  # [N, G] — message content sent along edge c (loss-independent)
+            if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+                sent_c = sent_c & elive[c]
             sent_cols.append(sent_c)
         got = jnp.zeros_like(urows)
         uinf_new = uinf
@@ -715,6 +734,7 @@ def sim_tick(
             alive,
             params.periods_to_spread,
             params.periods_to_sweep,
+            edge_live=elive,
         )
         uinf_new = state.uinf
         uflight = state.uflight
@@ -755,10 +775,13 @@ def sim_tick(
     # "Young to say" == the sender's payload row is non-empty: state.rows is
     # exactly the young-masked table, plus a fired FD verdict this tick.
     sender_active = jnp.any(state.rows >= 0, axis=1) | (fd_tgtm >= 0)
-    msgs_gossip = sum(
-        jnp.sum(sender_active[inv_perm[c]] & alive[inv_perm[c]] & nonself[c])
+    g_att_c = [
+        sender_active[inv_perm[c]] & alive[inv_perm[c]] & nonself[c]
         for c in range(params.gossip_fanout)
-    )
+    ]
+    if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+        g_att_c = [m & elive[c] for c, m in enumerate(g_att_c)]
+    msgs_gossip = sum(jnp.sum(m) for m in g_att_c)
     # Fault accounting, membership plane only (FD + SYNC + membership
     # gossip; user gossip is excluded — its send mask lives inside
     # user_gossip_step and it has no protocol-safety invariant to certify).
@@ -767,9 +790,8 @@ def sim_tick(
     # link_attempts == link_delivered + fault_blocked + fault_lost.
     g_acct = _acct_zero()
     for c in range(params.gossip_fanout):
-        g_att = sender_active[inv_perm[c]] & alive[inv_perm[c]] & nonself[c]
         g_blk = _edge_lookup(plan.block, inv_perm[c], i_idx)
-        g_acct = _acct_add(g_acct, _link_acct(g_att, g_blk, gpass[c]))
+        g_acct = _acct_add(g_acct, _link_acct(g_att_c[c], g_blk, gpass[c]))
     acct = _acct_add(
         tuple(fd_extras[3 + k] for k in range(4)), g_acct, tuple(sync_acct)
     )

@@ -22,25 +22,31 @@ from scalecube_cluster_tpu.ops.delivery import permuted_delivery
 AGE_CAP = 1 << 20
 
 
-def user_gossip_step(useen, uage, inv_perm, edge_ok, alive, spread, sweep):
+def user_gossip_step(useen, uage, inv_perm, edge_ok, alive, spread, sweep,
+                     edge_live=None):
     """Advance the [N, G] user-gossip state one period.
 
     Returns ``(new_seen, new_age, msgs_user [G])`` — message counting is
     sender-side (selectGossipsToSend non-empty ⇒ one message per edge;
     loss doesn't unsend), comparable to ClusterMath.maxMessagesPerGossip.
+
+    ``edge_live`` (optional ``[f]`` bool, sim/knobs.py::edge_live) masks
+    capped fan-out channels out of the SEND count; delivery is already
+    masked by the caller folding the same mask into ``edge_ok``. ``None``
+    keeps the legacy graph untouched.
     """
     n = useen.shape[0]
     col = jnp.arange(n, dtype=jnp.int32)
     nonself = inv_perm != col[None, :]  # [f, N]: sender != receiver
     urows = useen & (uage < spread)
     got = permuted_delivery(urows.astype(jnp.int32), inv_perm, edge_ok) > 0
-    msgs_user = sum(
-        jnp.sum(
-            urows[inv_perm[c]] & (alive[inv_perm[c]] & nonself[c])[:, None],
-            axis=0,
-        )
+    sent = [
+        urows[inv_perm[c]] & (alive[inv_perm[c]] & nonself[c])[:, None]
         for c in range(inv_perm.shape[0])
-    )
+    ]
+    if edge_live is not None:
+        sent = [m & edge_live[c] for c, m in enumerate(sent)]
+    msgs_user = sum(jnp.sum(m, axis=0) for m in sent)
     new_seen = useen | (got & alive[:, None])
     first_seen = new_seen & ~useen
     new_age = jnp.where(first_seen, 0, jnp.minimum(uage + 1, AGE_CAP))
@@ -50,7 +56,7 @@ def user_gossip_step(useen, uage, inv_perm, edge_ok, alive, spread, sweep):
 
 def user_gossip_step_tracked(
     useen, uage, uinf_ids, uptr, inv_perm, edge_ok, alive, spread, sweep,
-    perm=None,
+    perm=None, edge_live=None,
 ):
     """Tracked variant: last-k-senders infected-set suppression.
 
@@ -94,7 +100,12 @@ def user_gossip_step_tracked(
     for c in range(f):
         tgt = perm[c]  # [N] sender i's receiver this channel
         known = jnp.any(uinf_ids == tgt[:, None, None], axis=2)  # [N, G]
-        sent_s.append(urows & ~known & (alive & (tgt != col))[:, None])
+        s_c = urows & ~known & (alive & (tgt != col))[:, None]
+        if edge_live is not None:
+            # Capped fan-out channel (sim/knobs.py): nothing sent, nothing
+            # counted — delivery below is masked via edge_ok by the caller.
+            s_c = s_c & edge_live[c]
+        sent_s.append(s_c)
     msgs_user = sum(jnp.sum(c_sent, axis=0) for c_sent in sent_s)
 
     got = jnp.zeros_like(urows)

@@ -1,6 +1,7 @@
 """Test library: fault injection + cluster factories (reference: cluster-testlib/)."""
 
 from scalecube_cluster_tpu.testlib.chaos import (
+    chaos_ensemble,
     chaos_soak,
     chaos_trial,
     sample_schedule,
@@ -15,6 +16,7 @@ from scalecube_cluster_tpu.testlib.fixtures import (
 from scalecube_cluster_tpu.testlib.invariants import (
     InvariantViolation,
     certify_heal,
+    certify_population,
     certify_traces,
     heal_bound,
 )
@@ -31,7 +33,9 @@ __all__ = [
     "InvariantViolation",
     "await_until",
     "certify_heal",
+    "certify_population",
     "certify_traces",
+    "chaos_ensemble",
     "chaos_soak",
     "chaos_trial",
     "fast_test_config",

@@ -21,29 +21,35 @@ so every trial exercises detection AND recovery.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
-from scalecube_cluster_tpu.ops.merge import decode_epoch, decode_status
+from scalecube_cluster_tpu.sim.ensemble import (
+    ensemble_sparse_convergence,
+    init_ensemble_dense,
+    init_ensemble_sparse,
+    run_ensemble_sparse_ticks,
+    run_ensemble_ticks,
+    sparse_convergence_device,
+    stack_universes,
+)
 from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.run import run_ticks
 from scalecube_cluster_tpu.sim.schedule import FaultSchedule, ScheduleBuilder
 from scalecube_cluster_tpu.sim.sparse import (
     SparseParams,
-    effective_view,
     init_sparse_full_view,
     run_sparse_ticks,
 )
 from scalecube_cluster_tpu.sim.state import init_full_view, seeds_mask
 from scalecube_cluster_tpu.testlib.invariants import (
+    REQUIRED_KEYS,
     InvariantViolation,
     certify_heal,
+    certify_population,
     certify_traces,
     heal_bound,
 )
-
-_ALIVE, _DEAD = 0, 2
 
 #: Fixed schedule shape — every seed compiles to the same executable.
 CHAOS_SEGMENTS = 3
@@ -131,19 +137,11 @@ def sample_schedule(seed: int, n: int) -> FaultSchedule:
 
 def sparse_convergence(state) -> float:
     """The dense engine's convergence measure (sim/tick.py metrics) computed
-    on a sparse state's materialized view — O(n²), small-n trials only."""
-    view = effective_view(state)
-    n = view.shape[0]
-    alive = state.alive
-    status = decode_status(view)
-    truth_alive = alive[None, :] & (decode_epoch(view) == state.epoch[None, :])
-    ok_alive = truth_alive & (status == _ALIVE)
-    ok_dead = ~alive[None, :] & ((status == _DEAD) | (view < 0))
-    match = jnp.where(alive[None, :], ok_alive, ok_dead) | jnp.eye(n, dtype=bool)
-    viewer_conv = jnp.mean(match, axis=1)
-    n_alive = jnp.sum(alive)
-    conv = jnp.sum(viewer_conv * alive) / jnp.maximum(n_alive, 1)
-    return float(jax.device_get(conv))
+    on a sparse state's materialized view — O(n²), small-n trials only.
+    Host-float wrapper of sim/ensemble.py::sparse_convergence_device (the
+    formula lives there so the vmapped population form shares it bit-for-
+    bit)."""
+    return float(jax.device_get(sparse_convergence_device(state)))
 
 
 def run_scheduled(
@@ -209,13 +207,100 @@ def chaos_trial(seed: int, n: int, engine: str) -> dict:
     return result
 
 
+def chaos_ensemble(seeds, n: int, engine: str) -> list[dict]:
+    """The whole seed matrix of one engine as ONE vmapped ensemble run
+    (sim/ensemble.py): B sampled schedules stack into one plan pytree (their
+    fixed shape is the point — same treedef, same executable), B identical
+    seed-0 start states step together, and the batched certifier
+    (testlib/invariants.py::certify_population) replays every universe.
+
+    Returns per-seed result dicts IDENTICAL to :func:`chaos_trial`'s — vmap
+    adds only a batch axis, so universe b is bit-equal to the loop trial and
+    so are its certifier summaries (pinned by tests/test_ensemble.py).
+    """
+    params = chaos_params(n)
+    ticks = trial_ticks(params)
+    seeds = [int(s) for s in seeds]
+    schedules = [sample_schedule(s, n) for s in seeds]
+    plans = stack_universes(schedules)
+    b_count = len(seeds)
+    if engine == "dense":
+        states = init_ensemble_dense(
+            n, [0] * b_count, user_gossip_slots=params.user_gossip_slots
+        )
+        _, traces = run_ensemble_ticks(
+            params, states, plans, seeds_mask(n, [0]), ticks
+        )
+        pull = {k: traces[k] for k in (*REQUIRED_KEYS, "convergence")}
+        host = jax.device_get(pull)
+        conv = np.asarray(host.pop("convergence"))[:, -1]
+    elif engine == "sparse":
+        sp = SparseParams(base=params, slot_budget=max(64, 4 * n), alloc_cap=16)
+        states = init_ensemble_sparse(
+            n,
+            [0] * b_count,
+            slot_budget=sp.slot_budget,
+            user_gossip_slots=params.user_gossip_slots,
+        )
+        states, traces = run_ensemble_sparse_ticks(sp, states, plans, ticks)
+        pull = {k: traces[k] for k in REQUIRED_KEYS}
+        pull["conv"] = ensemble_sparse_convergence(states)
+        host = jax.device_get(pull)
+        conv = np.asarray(host.pop("conv"))
+    else:
+        raise ValueError(f"unknown engine {engine!r}; expected one of {ENGINES}")
+
+    cert = certify_population(params, host, final_convergence=conv)
+    results = []
+    for b, seed in enumerate(seeds):
+        digest = schedules[b].digest()
+        result = {
+            "seed": seed,
+            "n": n,
+            "engine": engine,
+            "ticks": ticks,
+            "digest": digest,
+            "reproducer": reproducer_line(seed, n, engine, ticks, digest),
+        }
+        if cert["ok"][b]:
+            result.update(
+                ok=True,
+                final_convergence=float(conv[b]),
+                **cert["summaries"][b],
+            )
+        else:
+            violation = cert["violations"][b]
+            result.update(
+                ok=False,
+                violation=violation["invariant"],
+                error=violation["error"],
+            )
+        results.append(result)
+    return results
+
+
 def chaos_soak(
-    seeds, n: int, engines=ENGINES, on_result=None
+    seeds, n: int, engines=ENGINES, on_result=None, ensemble: bool = False
 ) -> list[dict]:
     """Run the seed x engine matrix; returns all trial results (violations
     included — callers assert). ``on_result`` (optional callable) sees each
-    result as it lands, for streaming CLI output."""
+    result as it lands, for streaming CLI output.
+
+    ``ensemble=True`` routes each engine's whole seed matrix through ONE
+    vmapped :func:`chaos_ensemble` call instead of B host-driven trials —
+    same results in the same seed-major order (``on_result`` then fires
+    after the batch lands rather than per trial)."""
     results = []
+    if ensemble:
+        seeds = [int(s) for s in seeds]
+        per_engine = {e: chaos_ensemble(seeds, n, e) for e in engines}
+        for i in range(len(seeds)):
+            for engine in engines:
+                r = per_engine[engine][i]
+                results.append(r)
+                if on_result is not None:
+                    on_result(r)
+        return results
     for seed in seeds:
         for engine in engines:
             r = chaos_trial(int(seed), n, engine)

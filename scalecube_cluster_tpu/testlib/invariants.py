@@ -218,6 +218,55 @@ def certify_traces(params: SimParams, traces: dict) -> dict:
     }
 
 
+def certify_population(
+    params: SimParams, traces: dict, final_convergence=None
+) -> dict:
+    """Batched certifier over an ensemble run (sim/ensemble.py): every trace
+    leaf carries a leading universe axis ``[B, T]``; universe b is certified
+    exactly as a single run (C1-C6, plus C7 when ``final_convergence`` — a
+    ``[B]`` vector of end-of-run convergence — is given).
+
+    Never raises: returns ``{"ok": bool[B], "violations": [None | dict]*B,
+    "summaries": [None | dict]*B}`` — the per-universe pass/fail bitmap the
+    population report exports (obs/ensemble.py). Like the rest of this
+    module it is numpy-only; callers ``device_get`` the traces first.
+    """
+    missing = [k for k in REQUIRED_KEYS if k not in traces]
+    if missing:
+        raise InvariantViolation(
+            "schema", f"population traces must carry {missing!r}"
+        )
+    lead = np.asarray(traces[REQUIRED_KEYS[0]])
+    if lead.ndim != 2:
+        raise InvariantViolation(
+            "schema",
+            f"population traces need a [B, T] universe axis; got {lead.shape}",
+        )
+    b_count = lead.shape[0]
+    if final_convergence is not None:
+        final_convergence = np.asarray(final_convergence).reshape(-1)
+        if final_convergence.size != b_count:
+            raise InvariantViolation(
+                "schema",
+                f"final_convergence has {final_convergence.size} entries "
+                f"for {b_count} universes",
+            )
+    ok = np.ones(b_count, bool)
+    violations: list = [None] * b_count
+    summaries: list = [None] * b_count
+    for b in range(b_count):
+        tb = {k: np.asarray(traces[k])[b] for k in REQUIRED_KEYS}
+        try:
+            summary = certify_traces(params, tb)
+            if final_convergence is not None:
+                certify_heal(params, summary, float(final_convergence[b]))
+            summaries[b] = summary
+        except InvariantViolation as e:
+            ok[b] = False
+            violations[b] = {"invariant": e.invariant, "error": str(e)}
+    return {"ok": ok, "violations": violations, "summaries": summaries}
+
+
 def certify_heal(
     params: SimParams, summary: dict, final_convergence: float
 ) -> None:

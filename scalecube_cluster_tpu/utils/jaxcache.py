@@ -36,6 +36,26 @@ def _host_fingerprint() -> str:
     return hashlib.sha256(platform.processor().encode()).hexdigest()[:12]
 
 
+def jit_cache_size(fn) -> int:
+    """Number of compiled executables a ``jax.jit``-wrapped function holds.
+
+    The zero-recompile assertions (tests/test_ensemble.py) pin the ensemble
+    engine's promise — a whole seed×knob sweep is ONE executable per
+    (engine, n, B, n_ticks, plan treedef) — by reading this before and
+    after a batch of calls: the delta is the number of fresh compiles.
+    Wraps the private ``_cache_size`` hook so test code has one
+    repo-sanctioned spelling; returns 0 when the hook is unavailable
+    (non-jit callable or a future jax that renames it — assertions then
+    degrade to vacuous rather than erroring)."""
+    probe = getattr(fn, "_cache_size", None)
+    if probe is None:
+        return 0
+    try:
+        return int(probe())
+    except Exception:
+        return 0
+
+
 def enable_repo_jax_cache() -> str:
     """Point JAX's persistent compilation cache at ``<repo>/.jax_cache``
     (CPU processes: ``<repo>/.jax_cache/cpu-<host fingerprint>``).

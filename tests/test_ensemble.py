@@ -1,0 +1,439 @@
+"""Ensemble engine (sim/ensemble.py) + population statistics (obs/ensemble.py).
+
+Five layers:
+
+1. Parity — universe b of a vmapped ensemble run is BIT-identical to the
+   equivalent single run on both engines, clean and scheduled-fault, and
+   with per-universe knob points (identity knobs == no knobs).
+2. Zero recompiles — a whole seed×knob sweep reuses ONE executable per
+   (engine, n, B, n_ticks, plan treedef); pinned via the jit cache-size
+   hook (utils/jaxcache.py::jit_cache_size).
+3. Universe-axis sharding — an ensemble sharded over the 8 virtual devices
+   (parallel/mesh.py::make_universe_mesh) produces the unsharded traces.
+4. Population statistics + batched certifier — on-device reductions match
+   hand-computed numpy; certify_population flags exactly the tampered
+   universe; batched sparse_summary equals per-universe summaries.
+5. Re-routes — chaos_soak(ensemble=True) equals the host-driven loop
+   result-for-result; the sweep CLI smoke-runs end to end.
+"""
+
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.obs.ensemble import (
+    ensemble_report,
+    first_tick_where,
+    masked_quantiles,
+    population_stats,
+)
+from scalecube_cluster_tpu.obs.export import jsonl_line, prometheus_text
+from scalecube_cluster_tpu.parallel.mesh import make_universe_mesh, shard_ensemble
+from scalecube_cluster_tpu.sim import FaultPlan, init_full_view, run_ticks
+from scalecube_cluster_tpu.sim.ensemble import (
+    ensemble_sparse_convergence,
+    index_universe,
+    init_ensemble_dense,
+    init_ensemble_sparse,
+    run_ensemble_sparse_ticks,
+    run_ensemble_ticks,
+    stack_universes,
+)
+from scalecube_cluster_tpu.sim.knobs import make_knobs
+from scalecube_cluster_tpu.sim.monitor import sparse_summary
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    run_sparse_ticks,
+)
+from scalecube_cluster_tpu.sim.state import seeds_mask
+from scalecube_cluster_tpu.testlib.chaos import (
+    chaos_params,
+    chaos_soak,
+    sample_schedule,
+    sparse_convergence,
+)
+from scalecube_cluster_tpu.testlib.invariants import certify_population
+from scalecube_cluster_tpu.utils.jaxcache import jit_cache_size
+from tests.test_sim import small_params
+
+N = 16
+SEEDS = (0, 1, 2)
+
+
+def _sparse_params(n):
+    return SparseParams(base=small_params(n), slot_budget=64, alloc_cap=16)
+
+
+def _assert_tree_universe_equal(batched, single, b, context):
+    import jax
+
+    flat_b = jax.tree_util.tree_leaves(batched)
+    flat_s = jax.tree_util.tree_leaves(single)
+    assert len(flat_b) == len(flat_s), context
+    for lb, ls in zip(flat_b, flat_s):
+        assert np.array_equal(np.asarray(lb)[b], np.asarray(ls)), context
+
+
+# -- 1. parity ---------------------------------------------------------------
+
+
+def test_ensemble_parity_dense_scheduled():
+    """Universe b (own init seed, own sampled fault schedule) == the single
+    scheduled run, traces and final state, bit for bit."""
+    ticks = 60
+    p = small_params(N)
+    sm = seeds_mask(N, [0])
+    schedules = [sample_schedule(s, N) for s in SEEDS]
+    states = init_ensemble_dense(N, SEEDS, user_gossip_slots=2)
+    _, traces = run_ensemble_ticks(
+        p, states, stack_universes(schedules), sm, ticks
+    )
+    for b, seed in enumerate(SEEDS):
+        st1 = init_full_view(N, 2, seed=seed)
+        st1, tr1 = run_ticks(p, st1, schedules[b], sm, ticks)
+        for k in tr1:
+            assert np.array_equal(
+                np.asarray(traces[k])[b], np.asarray(tr1[k])
+            ), (k, seed)
+
+
+def test_ensemble_parity_dense_final_state():
+    ticks = 40
+    p = small_params(N)
+    sm = seeds_mask(N, [0])
+    schedules = [sample_schedule(s, N) for s in SEEDS]
+    states = init_ensemble_dense(N, SEEDS, user_gossip_slots=2)
+    fin, _ = run_ensemble_ticks(p, states, stack_universes(schedules), sm, ticks)
+    for b, seed in enumerate(SEEDS):
+        st1 = init_full_view(N, 2, seed=seed)
+        st1, _ = run_ticks(p, st1, schedules[b], sm, ticks)
+        _assert_tree_universe_equal(fin, st1, b, f"dense final seed={seed}")
+
+
+def test_ensemble_parity_sparse_scheduled():
+    ticks = 60
+    p = _sparse_params(N)
+    schedules = [sample_schedule(s, N) for s in SEEDS]
+    states = init_ensemble_sparse(
+        N, SEEDS, slot_budget=p.slot_budget, user_gossip_slots=2
+    )
+    fin, traces = run_ensemble_sparse_ticks(
+        p, states, stack_universes(schedules), ticks
+    )
+    conv_b = np.asarray(ensemble_sparse_convergence(fin))
+    for b, seed in enumerate(SEEDS):
+        st1 = init_sparse_full_view(
+            N, slot_budget=p.slot_budget, seed=seed, user_gossip_slots=2
+        )
+        st1, tr1 = run_sparse_ticks(p, st1, schedules[b], ticks)
+        for k in tr1:
+            assert np.array_equal(
+                np.asarray(traces[k])[b], np.asarray(tr1[k])
+            ), (k, seed)
+        for field in ("slab", "view_T", "alive", "epoch", "rng"):
+            assert np.array_equal(
+                np.asarray(getattr(fin, field))[b],
+                np.asarray(getattr(st1, field)),
+            ), (field, seed)
+        # The batched convergence reduction matches the single-run wrapper.
+        assert conv_b[b] == sparse_convergence(st1), seed
+
+
+def test_ensemble_knobs_identity_parity():
+    """Identity knob points (mult=1, full fan-out) thread as traced data yet
+    change NOTHING: traces equal the knobs=None run on both engines."""
+    ticks, b_count = 30, 2
+    p = small_params(N)
+    sm = seeds_mask(N, [0])
+    plans = stack_universes(
+        FaultPlan.clean(N).with_loss(10.0) for _ in range(b_count)
+    )
+    knobs = stack_universes(make_knobs(p) for _ in range(b_count))
+    states = init_ensemble_dense(N, range(b_count), user_gossip_slots=2)
+    _, tr_none = run_ensemble_ticks(p, states, plans, sm, ticks)
+    _, tr_knob = run_ensemble_ticks(p, states, plans, sm, ticks, knobs=knobs)
+    for k in tr_none:
+        assert np.array_equal(np.asarray(tr_none[k]), np.asarray(tr_knob[k])), k
+
+    sp = _sparse_params(N)
+    sknobs = stack_universes(make_knobs(sp.base) for _ in range(b_count))
+    sts_a = init_ensemble_sparse(
+        N, range(b_count), slot_budget=sp.slot_budget, user_gossip_slots=2
+    )
+    sts_b = init_ensemble_sparse(
+        N, range(b_count), slot_budget=sp.slot_budget, user_gossip_slots=2
+    )
+    _, str_none = run_ensemble_sparse_ticks(sp, sts_a, plans, ticks)
+    _, str_knob = run_ensemble_sparse_ticks(
+        sp, sts_b, plans, ticks, knobs=sknobs
+    )
+    for k in str_none:
+        assert np.array_equal(np.asarray(str_none[k]), np.asarray(str_knob[k])), k
+
+
+def test_ensemble_knobs_change_behavior():
+    """Non-identity knobs actually bite: capping fan-out to 1 channel cuts
+    gossip sends; the knob lattice is per-universe (universe 0 stays
+    identity and bit-equal to the unknobbed run)."""
+    ticks = 60
+    p = small_params(N)
+    sm = seeds_mask(N, [0])
+    # A converged cluster under a clean plan has no rumors to gossip, so
+    # the fan-out cap would have nothing to cut — use a kill/loss schedule
+    # to generate rumor traffic.
+    plans = stack_universes(sample_schedule(0, N) for _ in range(2))
+    knobs = stack_universes(
+        [make_knobs(p), make_knobs(p, suspicion_mult=0.5, fanout_cap=1)]
+    )
+    states = init_ensemble_dense(N, [0, 0], user_gossip_slots=2)
+    _, tr = run_ensemble_ticks(p, states, plans, sm, ticks, knobs=knobs)
+    _, tr_ref = run_ensemble_ticks(p, states, plans, sm, ticks)
+    g = np.asarray(tr["msgs_gossip"])
+    assert np.array_equal(g[0], np.asarray(tr_ref["msgs_gossip"])[0])
+    assert g[0].sum() > 0
+    assert g[1].sum() < g[0].sum()
+
+
+# -- 2. zero recompiles across a sweep ---------------------------------------
+
+
+def test_no_recompile_across_dense_sweep():
+    """8 sweep calls — different seeds, schedules and knob values every
+    time — land on the executable the first call compiled."""
+    b_count, ticks = 4, 25
+    p = small_params(N)
+    sm = seeds_mask(N, [0])
+
+    def batch(i):
+        states = init_ensemble_dense(
+            N, range(i, i + b_count), user_gossip_slots=2
+        )
+        plans = stack_universes(
+            sample_schedule(s, N) for s in range(i, i + b_count)
+        )
+        knobs = stack_universes(
+            make_knobs(p, suspicion_mult=1.0 + 0.05 * i + 0.1 * j)
+            for j in range(b_count)
+        )
+        return states, plans, knobs
+
+    states, plans, knobs = batch(0)
+    run_ensemble_ticks(p, states, plans, sm, ticks, knobs=knobs)
+    compiled = jit_cache_size(run_ensemble_ticks)
+    assert compiled > 0
+    for i in range(1, 8):
+        states, plans, knobs = batch(i)
+        run_ensemble_ticks(p, states, plans, sm, ticks, knobs=knobs)
+    assert jit_cache_size(run_ensemble_ticks) == compiled
+
+
+def test_no_recompile_across_sparse_sweep():
+    b_count, ticks = 4, 25
+    p = _sparse_params(N)
+
+    def batch(i):
+        states = init_ensemble_sparse(
+            N, range(i, i + b_count), slot_budget=p.slot_budget,
+            user_gossip_slots=2,
+        )
+        plans = stack_universes(
+            sample_schedule(s, N) for s in range(i, i + b_count)
+        )
+        return states, plans
+
+    states, plans = batch(0)
+    run_ensemble_sparse_ticks(p, states, plans, ticks)
+    compiled = jit_cache_size(run_ensemble_sparse_ticks)
+    assert compiled > 0
+    for i in range(1, 8):
+        states, plans = batch(i)
+        run_ensemble_sparse_ticks(p, states, plans, ticks)
+    assert jit_cache_size(run_ensemble_sparse_ticks) == compiled
+
+
+# -- 3. universe-axis sharding -----------------------------------------------
+
+
+def test_sharded_ensemble_matches_unsharded():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8 virtual devices (tests/conftest.py)")
+    b_count, ticks = 8, 30
+    p = small_params(N)
+    sm = seeds_mask(N, [0])
+    states = init_ensemble_dense(N, range(b_count), user_gossip_slots=2)
+    plans = stack_universes(sample_schedule(s, N) for s in range(b_count))
+    _, tr_ref = run_ensemble_ticks(p, states, plans, sm, ticks)
+    mesh = make_universe_mesh()
+    sh_states = shard_ensemble(states, mesh)
+    sh_plans = shard_ensemble(plans, mesh)
+    _, tr_sh = run_ensemble_ticks(p, sh_states, sh_plans, sm, ticks)
+    for k in tr_ref:
+        assert np.array_equal(np.asarray(tr_ref[k]), np.asarray(tr_sh[k])), k
+
+
+# -- 4. population statistics + batched certifier ----------------------------
+
+
+def test_first_tick_where_and_quantiles():
+    mask = np.array(
+        [[False, True, True], [False, False, False], [True, False, True]]
+    )
+    ft = np.asarray(first_tick_where(mask))
+    assert ft.tolist() == [1, -1, 0]
+    q = np.asarray(
+        masked_quantiles(np.array([5.0, 99.0, 1.0]), np.array([True, False, True]))
+    )
+    # Valid population {5, 1}: nearest-rank p50=1, p90=p99=5.
+    assert q.tolist() == [1.0, 5.0, 5.0]
+    empty = np.asarray(
+        masked_quantiles(np.array([5.0]), np.array([False]))
+    )
+    assert np.isnan(empty).all()
+
+
+def test_population_stats_convergence_semantics():
+    """Re-convergence time: first tick from which the universe STAYS
+    converged; -1 when still broken at the end; 0 when never disturbed."""
+    conv = np.array(
+        [
+            [1.0, 1.0, 0.5, 1.0, 1.0],  # dips, recovers at tick 3
+            [1.0, 1.0, 1.0, 1.0, 1.0],  # never disturbed
+            [1.0, 0.9, 0.9, 0.9, 0.9],  # never recovers
+        ],
+        np.float32,
+    )
+    dead = np.zeros((3, 5), np.int32)
+    dead[0, 2] = 1
+    att = np.ones((3, 5), np.int32) * 7
+    stats = {
+        k: np.asarray(v)
+        for k, v in population_stats(
+            {"convergence": conv, "verdicts_dead": dead, "link_attempts": att}
+        ).items()
+    }
+    assert stats["convergence_time"].tolist() == [3, 0, -1]
+    assert stats["frac_converged"] == pytest.approx(2 / 3)
+    # Never-recovered universes sort to T at the CDF tail.
+    assert stats["convergence_time_sorted"].tolist() == [0, 3, 5]
+    assert stats["first_verdicts_dead_tick"].tolist() == [2, -1, -1]
+    assert stats["link_attempts_total"].tolist() == [35, 35, 35]
+    assert stats["link_attempts_env"].tolist() == [35.0, 35.0, 35.0]
+    assert stats["link_attempts_tick_env"].shape == (3, 5)
+
+
+def _clean_population(b_count=3, ticks=50):
+    z = np.zeros((b_count, ticks), np.int64)
+    return {
+        "link_attempts": z + 10,
+        "link_delivered": z + 10,
+        "fault_blocked": z.copy(),
+        "fault_lost": z.copy(),
+        "pings": z + 4,
+        "acks": z + 4,
+        "suspicions_raised": z.copy(),
+        "verdicts_dead": z.copy(),
+        "inc_max": z.copy(),
+        "epoch_max": z.copy(),
+        "plan_dirty": np.zeros((b_count, ticks), bool),
+        "kills_fired": z.copy(),
+        "restarts_fired": z.copy(),
+    }
+
+
+def test_certify_population_flags_only_bad_universe():
+    params = chaos_params(N)
+    traces = _clean_population()
+    ok = certify_population(params, traces)
+    assert ok["ok"].tolist() == [True, True, True]
+    assert all(s is not None for s in ok["summaries"])
+    traces["link_delivered"][1, 20] = 9  # break C1 in universe 1 only
+    cert = certify_population(params, traces)
+    assert cert["ok"].tolist() == [True, False, True]
+    assert cert["violations"][1]["invariant"] == "C1-conservation"
+    assert cert["summaries"][0] is not None and cert["summaries"][1] is None
+
+
+def test_ensemble_report_rows():
+    params = chaos_params(N)
+    traces = _clean_population()
+    traces["convergence"] = np.ones((3, 50), np.float32)
+    report = ensemble_report(params, traces)
+    assert report["certification"]["ok"].all()
+    rows = report["rows"]
+    assert [r["kind"] for r in rows] == ["ensemble_population"] + [
+        "ensemble_universe"
+    ] * 3
+    assert rows[0]["universes"] == 3 and rows[0]["pass_rate"] == 1.0
+    assert rows[0]["frac_converged"] == 1.0
+    assert all(rows[1 + b]["universe"] == b for b in range(3))
+    # The whole report serializes through the schema-versioned exporters.
+    for row in rows:
+        jsonl_line(row)
+    assert "scalecube_ensemble_population_pass_rate" in prometheus_text(rows)
+
+
+def test_batched_sparse_summary_matches_per_universe():
+    ticks, b_count = 20, 2
+    p = _sparse_params(N)
+    plans = stack_universes(
+        FaultPlan.clean(N).with_loss(15.0) for _ in range(b_count)
+    )
+    states = init_ensemble_sparse(
+        N, range(b_count), slot_budget=p.slot_budget, user_gossip_slots=2
+    )
+    fin, traces = run_ensemble_sparse_ticks(p, states, plans, ticks)
+    batched = sparse_summary(fin, traces=traces)
+    assert batched["n"] == N and batched["slot_budget"] == p.slot_budget
+    for b in range(b_count):
+        single = sparse_summary(
+            index_universe(fin, b), traces=index_universe(traces, b)
+        )
+        for k, v in single.items():
+            got = batched[k][b] if np.ndim(batched[k]) else batched[k]
+            assert got == v, (k, b)
+
+
+# -- 5. re-routed harnesses --------------------------------------------------
+
+
+def test_chaos_soak_ensemble_equals_loop():
+    """THE re-route pin: the vmapped seed matrix reproduces the host-driven
+    loop result-for-result (same dicts, same seed-major order) on both
+    engines."""
+    seeds = (0, 1)
+    loop = chaos_soak(seeds, 24)
+    ens = chaos_soak(seeds, 24, ensemble=True)
+    assert loop == ens
+    assert [r["ok"] for r in ens] == [True] * len(ens)
+
+
+def test_sweep_cli_smoke(tmp_path):
+    from scalecube_cluster_tpu.experiments.sweep import main
+
+    out = tmp_path / "sweep.jsonl"
+    prom = tmp_path / "sweep.prom"
+    rc = main(
+        [
+            "--seeds", "2",
+            "--n", "16",
+            "--ticks", "30",
+            "--engines", "dense",
+            "--suspicion-mults", "1.0,1.5",
+            "--fanout-caps", "none",
+            "--out", str(out),
+            "--prom", str(prom),
+        ]
+    )
+    assert rc == 0
+    lines = out.read_text().splitlines()
+    # 1 aggregate row + seeds×mults universe rows.
+    assert len(lines) == 1 + 4
+    import json
+
+    rows = [json.loads(line) for line in lines]
+    assert rows[0]["kind"] == "ensemble_population"
+    assert {r["kind"] for r in rows[1:]} == {"ensemble_universe"}
+    assert all(r["ok"] for r in rows[1:])
+    assert "scalecube_ensemble_population" in prom.read_text()
