@@ -35,9 +35,11 @@ Usage: ``python bench.py`` (driver mode — one JSON line),
 ``python bench.py --child <engine> <n>`` (internal single-config worker),
 ``python bench.py --telemetry [out.jsonl] [n]`` (flight-recorder run: counter
 totals + detection-latency histograms as schema-versioned JSONL + Prometheus),
-or ``python bench.py --ensemble <B> [n]`` (vmapped multi-universe rung,
+``python bench.py --ensemble <B> [n]`` (vmapped multi-universe rung,
 sim/ensemble.py: B universes stepped in one compiled call; the reported
-aggregate is universes × member·rounds/s).
+aggregate is universes × member·rounds/s), or ``python bench.py --rapid
+[n]`` (the Rapid consistent-membership engine rung, sim/rapid.py — the
+measured price of strong consistency next to the SWIM numbers).
 """
 
 from __future__ import annotations
@@ -238,6 +240,46 @@ def _measure_ensemble(
         "n_members": n_members,
         "universes": b_count,
         "engine": "dense-ensemble",
+    }
+
+
+def _measure_rapid(n_members: int = 1024, chunk: int = 40, reps: int = 4) -> dict:
+    """The ``--rapid [n]`` rung: the consistent-membership engine
+    (sim/rapid.py) under the bench's standard uniform-5%-loss plan,
+    ``collect=False``, timed exactly like the SWIM rungs (warmup + compile,
+    then reps × chunk scanned ticks synced by an element fetch off the
+    large [N, N] member-mask buffer). Same member·rounds/s metric,
+    schema-stamped — so PERF.md can put the price of strong consistency
+    (O(N²·k) alarm/vote broadcasts per tick) next to the SWIM numbers
+    rather than leaving it a qualitative claim."""
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.rapid import (
+        RapidParams,
+        init_rapid_full_view,
+        run_rapid_ticks,
+    )
+
+    params = RapidParams(n=n_members)
+    state = init_rapid_full_view(params)
+    plan = FaultPlan.uniform(loss_percent=5.0)
+
+    state, _ = run_rapid_ticks(params, state, plan, chunk, collect=False)
+    bool(state.member_mask[0, 0])
+
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        state, _ = run_rapid_ticks(params, state, plan, chunk, collect=False)
+        bool(state.member_mask[0, 0])
+    dt = time.perf_counter() - t0
+    value = n_members * (reps * chunk / dt)
+    return {
+        "metric": "member_gossip_rounds_per_sec",
+        "value": round(value, 1),
+        "unit": "member·rounds/s",
+        "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+        "n_members": n_members,
+        "engine": "rapid",
+        "k_observers": params.k,
     }
 
 
@@ -525,6 +567,21 @@ if __name__ == "__main__":
         out = _measure_ensemble(b_count, n_arg)
         print(
             jsonl_line(make_row("bench_ensemble", out, run_metadata(seed=0))),
+            flush=True,
+        )
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--rapid":
+        try:
+            from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+            enable_repo_jax_cache()
+        except Exception:
+            pass
+        from scalecube_cluster_tpu.obs.export import jsonl_line, make_row, run_metadata
+
+        n_arg = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+        out = _measure_rapid(n_arg)
+        print(
+            jsonl_line(make_row("bench_rapid", out, run_metadata(seed=0))),
             flush=True,
         )
     elif len(sys.argv) >= 2 and sys.argv[1] == "--telemetry":

@@ -8,6 +8,14 @@ to replay the exact trajectory. Exit status is the number of violations.
 
     python -m scalecube_cluster_tpu.experiments.chaos --cpu --seeds 25
     python -m scalecube_cluster_tpu.experiments.chaos --n 64 --engines sparse
+    python -m scalecube_cluster_tpu.experiments.chaos --engines rapid
+    python -m scalecube_cluster_tpu.experiments.chaos --race --seeds 12
+
+``--engines rapid`` soaks the Rapid consistent-membership engine
+(sim/rapid.py) under the same schedule matrix, certified against C1-C7 AND
+R1-R4. ``--race`` runs the SWIM-vs-Rapid comparison instead: both engines
+on IDENTICAL seed/schedule matrices as one vmapped ensemble call each
+(testlib/chaos.py::chaos_race), one side-by-side row per seed.
 
 ``--out FILE`` appends each trial as schema-versioned JSONL (obs/export.py),
 so soak results can be committed/diffed like the experiment grid's.
@@ -28,7 +36,19 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--engines",
         default="dense,sparse",
-        help="comma list from {dense,sparse}",
+        help="comma list from {dense,sparse,rapid}",
+    )
+    ap.add_argument(
+        "--race",
+        action="store_true",
+        help="SWIM-vs-Rapid race: both protocols over identical "
+        "seed/schedule matrices, one paired row per seed",
+    )
+    ap.add_argument(
+        "--swim-engine",
+        default="sparse",
+        choices=("dense", "sparse"),
+        help="which SWIM engine races Rapid (--race only)",
     )
     ap.add_argument("--out", default=None, help="append JSONL rows to FILE")
     ap.add_argument(
@@ -55,10 +75,39 @@ def main(argv=None) -> int:
         make_row,
         run_metadata,
     )
-    from scalecube_cluster_tpu.testlib.chaos import chaos_soak
+    from scalecube_cluster_tpu.testlib.chaos import chaos_race, chaos_soak
 
     engines = tuple(e for e in args.engines.split(",") if e)
     seeds = range(args.seed_start, args.seed_start + args.seeds)
+
+    if args.race:
+        rows = chaos_race(seeds, args.n, swim_engine=args.swim_engine)
+        for r in rows:
+            status = "ok" if r["ok"] else "FAIL"
+            print(
+                f"{status} seed={r['seed']} variant={r['variant']} "
+                f"digest={r['digest']} | swim[{r['swim_engine']}] "
+                f"susp={r['swim_suspicions']} dead={r['swim_verdicts_dead']} "
+                f"| rapid vc={r['rapid_view_changes']} "
+                f"views={r['rapid_max_view_id']}"
+            )
+            if not r["ok"]:
+                for side in ("swim", "rapid"):
+                    if not r[side]["ok"]:
+                        print(f"  {side}: {r[side]['reproducer']} :: "
+                              f"{r[side]['error']}")
+        failures = [r for r in rows if not r["ok"]]
+        if args.out:
+            meta = run_metadata(n=args.n)
+            append_jsonl(
+                args.out, [make_row("chaos_race", r, meta) for r in rows]
+            )
+        print(
+            json.dumps(
+                {"races": len(rows), "violations": len(failures)}
+            )
+        )
+        return len(failures)
 
     def emit(r: dict) -> None:
         if r["ok"]:

@@ -26,6 +26,13 @@ from __future__ import annotations
 #                      (FaultPlan.block / NetworkEmulator blockOutbound)
 #   fault_lost         membership-plane messages dropped by probabilistic
 #                      link loss (FaultPlan.loss / emulator loss_percent)
+#   view_changes       members committing/adopting a new membership
+#                      configuration (Rapid engine, sim/rapid.py; SWIM has
+#                      no consistent views — its engines emit constant 0)
+#   alarms_raised      observer edges newly crossing the L-watermark into
+#                      the alarming state (Rapid; 0 for SWIM)
+#   cut_detected       members whose cut detector turned stable and locked
+#                      a vote this tick (Rapid; 0 for SWIM)
 SHARED_COUNTERS: tuple[str, ...] = (
     "pings",
     "ping_reqs",
@@ -39,6 +46,9 @@ SHARED_COUNTERS: tuple[str, ...] = (
     "msgs_gossip",
     "fault_blocked",
     "fault_lost",
+    "view_changes",
+    "alarms_raised",
+    "cut_detected",
 )
 
 # Emitted by the sparse engine only — they measure the compact working-set

@@ -40,6 +40,15 @@ from scalecube_cluster_tpu.sim.state import (
 from scalecube_cluster_tpu.sim.tick import sim_tick
 from scalecube_cluster_tpu.sim.run import run_chunked, run_ticks, run_until
 from scalecube_cluster_tpu.sim.knobs import Knobs, make_knobs
+from scalecube_cluster_tpu.sim.rapid import (
+    RapidParams,
+    RapidState,
+    init_ensemble_rapid,
+    init_rapid_full_view,
+    rapid_tick,
+    run_ensemble_rapid_ticks,
+    run_rapid_ticks,
+)
 from scalecube_cluster_tpu.sim.ensemble import (
     ensemble_size,
     ensemble_sparse_convergence,
@@ -58,9 +67,16 @@ __all__ = [
     "FaultPlan",
     "FaultSchedule",
     "Knobs",
+    "RapidParams",
+    "RapidState",
     "ScheduleBuilder",
     "SimParams",
     "SimState",
+    "init_ensemble_rapid",
+    "init_rapid_full_view",
+    "rapid_tick",
+    "run_ensemble_rapid_ticks",
+    "run_rapid_ticks",
     "ensemble_size",
     "ensemble_sparse_convergence",
     "index_universe",

@@ -1,0 +1,600 @@
+"""Rapid-style consistent membership as a second scanned protocol engine.
+
+"Stable and Consistent Membership at Scale with Rapid" (arXiv:1803.03620)
+replaces SWIM's lone failure detector + eventually-consistent gossip with
+three device-friendly ingredients, each of which maps onto one array op:
+
+1. **k-ring multi-observer monitoring** — every subject ``s`` is probed by
+   its ``k`` ring successors ``(s+1..s+k) mod n``. The observer topology is
+   a PRECOMPUTED STATIC gather pattern (:func:`observer_matrix`, ``[N, k]``
+   int32), so a whole probe round is two ``link_pass`` draws over the same
+   index matrix — no per-node selection state like the SWIM probe cursor.
+2. **almost-everywhere cut detection** — each observer keeps a per-edge
+   consecutive-miss counter and raises an ALARM once the edge has failed
+   ``low_watermark`` (L) probes in a row — the stability filter that makes a
+   flapping link invisible (a link that flaps for fewer ticks than L never
+   alarms; the chaos matrix's square-wave scenarios pin this, R4 in
+   testlib/invariants.py). Alarms are broadcast; every member tallies them
+   per subject with ``jax.ops.segment_sum`` over the ``[N·k]`` flattened
+   edge axis. A subject with ``high_watermark`` (H) or more alarming
+   observers is a STABLE cut candidate; a subject stuck between 1 and H
+   alarms holds the detector UNSTABLE, delaying any proposal until the
+   whole correlated failure has surfaced — which is what batches a mass
+   kill into ONE view change instead of n dribbled verdicts.
+3. **batched view changes via a fast-path quorum** — a member whose
+   detector is stable (and nowhere unstable) LOCKS its full cut as a vote
+   bitmap — once per configuration, Fast-Paxos style, so a member never
+   votes two different batches in the same view — and broadcasts the
+   locked vote every tick. A receiver counts only votes from members in
+   its exact configuration (same ``view_id`` AND same view digest) and
+   commits when at least ``quorum_num/quorum_den`` (default 3/4) of its
+   view size delivered BIT-IDENTICAL votes (threshold agreement over whole
+   proposals — Rapid's fast path, no leader, no host round trip).
+   Vote-once + same-config counting + a >1/2 threshold make two different
+   batches committing for one view id structurally impossible (R1/R3);
+   there is no classic-Paxos fallback, so a vote split inside one
+   configuration parks the view until membership events (restart, join
+   re-admission) clear it — consistency over liveness, Rapid's tradeoff.
+   Committing bumps the member's ``view_id`` and applies the batch
+   (removes + joins) atomically.
+
+Laggards and restarted processes catch up through a view-sync broadcast
+(every ``sync_period_ticks``): a member adopts the highest ``view_id``
+configuration it receives that still contains itself. Restarted processes
+are re-admitted symmetrically: observers count consecutive SUCCESSFUL
+probes of a non-member and raise join alarms through the same
+watermark/tally/quorum pipeline.
+
+The engine is a drop-in sibling of ``sim_tick``/``sparse_tick``: it runs
+behind the same :class:`~scalecube_cluster_tpu.sim.faults.FaultPlan` /
+:class:`~scalecube_cluster_tpu.sim.schedule.FaultSchedule` timelines, the
+same :class:`~scalecube_cluster_tpu.sim.knobs.Knobs` threading
+(``suspicion_mult`` scales the L watermark; ``fanout_cap`` has no Rapid
+analog — there is no push-gossip fan-out — and is ignored), and the same
+``SHARED_COUNTERS`` trace schema (obs/counters.py), so the ensemble engine,
+the population statistics and the chaos harness work unchanged. Counters
+with no Rapid event (``ping_reqs``, ``suspicions_raised``,
+``gossip_infections``, ``inc_max``) are emitted as constant zeros, exactly
+like the SWIM engines zero-emit ``view_changes``/``alarms_raised``/
+``cut_detected``. Consistency-plane traces (``view_id``/``view_digest``/
+``view_size``/``alive_mask``, all ``[N]`` per tick) feed the R1–R4
+certifier (testlib/invariants.py::certify_rapid_traces).
+
+Scale note: alarm/proposal/sync broadcasts are O(N²·k) and O(N²) per tick —
+this engine is a consistency instrument for the chaos-race scales (tens to
+a few hundred members), not a 32k-member throughput engine; the SWIM sparse
+engine keeps that job.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.tree_util import register_dataclass
+
+from scalecube_cluster_tpu.ops import merge as merge_ops
+from scalecube_cluster_tpu.sim.faults import FaultPlan, _edge_lookup, link_pass
+from scalecube_cluster_tpu.sim.knobs import _SUSP_MAX, Knobs
+from scalecube_cluster_tpu.sim.schedule import (
+    FaultSchedule,
+    events_at,
+    plan_at,
+    plan_dirty_at,
+)
+from scalecube_cluster_tpu.sim.tick import _acct_add, _acct_zero, _link_acct
+
+
+@dataclass(frozen=True)
+class RapidParams:
+    """Static protocol constants of an ``n``-member Rapid cluster.
+
+    Frozen + hashable — a static jit argument exactly like
+    :class:`~scalecube_cluster_tpu.sim.params.SimParams`; shapes depend only
+    on ``n`` and ``k``.
+    """
+
+    n: int
+    #: Observers per subject — the ring successors (s+1..s+k) mod n. The
+    #: paper uses an expander built from k ring permutations; the single
+    #: k-successor ring keeps the gather pattern static and contiguous
+    #: while preserving the multi-observer property the watermarks need.
+    k: int = 8
+    #: L: consecutive FAILED probes of an in-view subject before the edge
+    #: alarms (and consecutive SUCCESSFUL probes of a non-member before a
+    #: join alarm). The flap filter: a link that recovers within L probes
+    #: never surfaces (R4).
+    low_watermark: int = 4
+    #: H: alarming observers required to make a subject a stable cut
+    #: candidate; 1..H-1 alarms hold the detector unstable.
+    high_watermark: int = 6
+    #: Probe cadence in ticks (the FD period).
+    fd_period_ticks: int = 2
+    #: View-sync broadcast cadence in ticks (the catch-up channel).
+    sync_period_ticks: int = 5
+    #: Fast-path commit threshold as a fraction of the committer's view
+    #: size: ``ceil(quorum_num / quorum_den * view_size)`` identical
+    #: proposals. Must exceed 1/2 so two different batches can never both
+    #: commit for one view id (R3).
+    quorum_num: int = 3
+    quorum_den: int = 4
+
+    def __post_init__(self):
+        if not 1 <= self.k < self.n:
+            raise ValueError(f"need 1 <= k < n, got k={self.k} n={self.n}")
+        if not 1 <= self.high_watermark <= self.k:
+            raise ValueError(
+                f"need 1 <= high_watermark <= k, got H={self.high_watermark}"
+                f" k={self.k}"
+            )
+        if self.low_watermark < 1:
+            raise ValueError("low_watermark must be >= 1")
+        if not 0 < self.quorum_num <= self.quorum_den:
+            raise ValueError("quorum must be a fraction in (0, 1]")
+        if 2 * self.quorum_num <= self.quorum_den:
+            raise ValueError(
+                "quorum must exceed 1/2 (single-majority safety, R3)"
+            )
+        if self.fd_period_ticks < 1 or self.sync_period_ticks < 1:
+            raise ValueError("periods must be >= 1 tick")
+
+
+@register_dataclass
+@dataclass
+class RapidState:
+    """Complete state of an N-member Rapid cluster (arrays over members)."""
+
+    #: Row m = m's current view configuration (True: subject in the view).
+    member_mask: jax.Array  # [N, N] bool
+    #: Configuration number of the view each member holds.
+    view_id: jax.Array  # [N] int32
+    #: Consecutive failed probes on edge (subject s, observer slot j) —
+    #: owned by observer ``observer_matrix[s, j]``; resets on success.
+    edge_fail: jax.Array  # [N, k] int32
+    #: Consecutive successful probes of a NON-member (join detection).
+    edge_join: jax.Array  # [N, k] int32
+    #: Row m = the cut batch m has VOTED in its current configuration
+    #: (locked on first detector stability, cleared on every view change).
+    vote_rm: jax.Array  # [N, N] bool
+    vote_add: jax.Array  # [N, N] bool
+    #: Member m has locked a vote in its current configuration.
+    voted: jax.Array  # [N] bool
+    #: Restart generation (same semantics as SimState.epoch).
+    epoch: jax.Array  # [N] int32
+    #: Ground truth: process is up (fault-control plane).
+    alive: jax.Array  # [N] bool
+    tick: jax.Array  # [] int32
+    rng: jax.Array  # PRNG key
+
+    def replace(self, **changes) -> "RapidState":
+        return dataclasses.replace(self, **changes)
+
+
+def observer_matrix(n: int, k: int) -> jax.Array:
+    """``[N, k]`` int32: observers of subject ``s`` are its ring successors
+    ``(s + 1 + j) % n`` — the static gather pattern of the whole monitoring
+    topology (host-built numpy constant, baked at trace time)."""
+    s = np.arange(n, dtype=np.int64)[:, None]
+    j = np.arange(k, dtype=np.int64)[None, :]
+    return jnp.asarray((s + 1 + j) % n, jnp.int32)
+
+
+def _digest_weights(n: int) -> np.ndarray:
+    """Per-subject pseudo-random uint32 weights for the membership digest
+    (splitmix-style avalanche so subset SUMS don't collide the way linear
+    weights would)."""
+    x = np.arange(1, n + 1, dtype=np.uint64)
+    x = (x * np.uint64(0x9E3779B97F4A7C15)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    x ^= x >> np.uint64(31)
+    x = (x * np.uint64(0xBF58476D1CE4E5B9)) & np.uint64(0xFFFFFFFFFFFFFFFF)
+    x ^= x >> np.uint64(27)
+    return (x & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def view_digest(member_mask: jax.Array) -> jax.Array:
+    """``[...,]`` int32 content digest of each member's view bitmap (R1/R3
+    compare digests instead of O(N) rows per trace tick). Wrapping uint32
+    sum of per-subject avalanche weights, bitcast to int32."""
+    n = member_mask.shape[-1]
+    w = jnp.asarray(_digest_weights(n))
+    d = jnp.sum(
+        jnp.where(member_mask, w, jnp.uint32(0)), axis=-1, dtype=jnp.uint32
+    )
+    return lax.bitcast_convert_type(d, jnp.int32)
+
+
+def rapid_low_watermark(params: RapidParams, knobs: Knobs | None):
+    """The effective L watermark: the static constant without knobs
+    (bit-identical legacy graph), else scaled by ``suspicion_mult`` — the
+    Rapid analog of the SWIM suspicion-timeout knob (sim/knobs.py)."""
+    if knobs is None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+        return params.low_watermark
+    scaled = jnp.round(
+        params.low_watermark * knobs.suspicion_mult
+    ).astype(jnp.int32)
+    return jnp.clip(scaled, 1, _SUSP_MAX)
+
+
+def init_rapid_full_view(params: RapidParams, seed: int = 0) -> RapidState:
+    """Post-bootstrap steady state: every member holds configuration 0 =
+    the full membership (the Rapid seed view), no alarms pending."""
+    n = params.n
+    return RapidState(
+        member_mask=jnp.ones((n, n), bool),
+        view_id=jnp.zeros((n,), jnp.int32),
+        edge_fail=jnp.zeros((n, params.k), jnp.int32),
+        edge_join=jnp.zeros((n, params.k), jnp.int32),
+        vote_rm=jnp.zeros((n, n), bool),
+        vote_add=jnp.zeros((n, n), bool),
+        voted=jnp.zeros((n,), bool),
+        epoch=jnp.zeros((n,), jnp.int32),
+        alive=jnp.ones((n,), bool),
+        tick=jnp.zeros((), jnp.int32),
+        rng=jax.random.PRNGKey(seed),
+    )
+
+
+def apply_events_rapid(
+    params: RapidParams,
+    state: RapidState,
+    kill_mask: jax.Array,
+    restart_mask: jax.Array,
+) -> RapidState:
+    """In-scan scripted kill/restart, the Rapid twin of
+    sim/schedule.py::apply_events_dense (same top-of-tick convention, no RNG
+    consumed). A restart is a fresh identity: epoch bump, view reset to the
+    bootstrap configuration 0 (it catches up through view sync), and every
+    per-edge counter it owns — or that is about it — cleared."""
+    n = params.n
+    any_ev = jnp.any(kill_mask | restart_mask)
+
+    def apply(st: RapidState) -> RapidState:
+        obs = observer_matrix(n, params.k)
+        new_epoch = jnp.where(
+            restart_mask,
+            jnp.minimum(st.epoch + 1, merge_ops.EPOCH_MAX),
+            st.epoch,
+        )
+        row = restart_mask[:, None]
+        mm = jnp.where(row, True, st.member_mask)
+        reset_edges = restart_mask[obs] | restart_mask[:, None]
+        return st.replace(
+            alive=(st.alive & ~kill_mask) | restart_mask,
+            epoch=new_epoch,
+            member_mask=mm | jnp.eye(n, dtype=bool),
+            view_id=jnp.where(restart_mask, 0, st.view_id),
+            edge_fail=jnp.where(reset_edges, 0, st.edge_fail),
+            edge_join=jnp.where(reset_edges, 0, st.edge_join),
+            vote_rm=jnp.where(row, False, st.vote_rm),
+            vote_add=jnp.where(row, False, st.vote_add),
+            voted=st.voted & ~restart_mask,
+        )
+
+    return lax.cond(any_ev, apply, lambda s: s, state)
+
+
+def rapid_tick(
+    params: RapidParams,
+    state: RapidState,
+    plan: FaultPlan,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """One Rapid round: probe → alarm broadcast → segment_sum tally →
+    watermark cut detection → proposal broadcast → fast-path quorum commit →
+    view sync. Pure function of (state, plan); all messaging rides
+    ``link_pass`` with the four-way conservation accounting the certifier
+    replays (attempts == delivered + blocked + lost)."""
+    n, k = params.n, params.k
+    t = state.tick + 1
+    rng_next, k_probe, k_ack, k_alarm, k_prop, k_sync = jax.random.split(
+        state.rng, 6
+    )
+    obs = observer_matrix(n, k)  # [N, k] observer of (subject, slot)
+    subj = jnp.arange(n, dtype=jnp.int32)[:, None]  # [N, 1] subject index
+    col = jnp.arange(n, dtype=jnp.int32)
+    eye = jnp.eye(n, dtype=bool)
+    alive = state.alive
+    mm = state.member_mask
+    low = rapid_low_watermark(params, knobs)
+
+    # ---- 1. k-ring probe round (fd cadence) ------------------------------
+    fd_tick = (t % params.fd_period_ticks) == 0
+    in_view = mm[obs, subj]  # [N, k]: observer has this subject in view
+    probe_active = fd_tick & alive[obs]
+    ping_blk = _edge_lookup(plan.block, obs, subj)
+    ping_pass = link_pass(k_probe, plan, obs, subj)
+    ack_active = probe_active & ping_pass & alive[:, None]
+    ack_blk = _edge_lookup(plan.block, subj, obs)
+    ack_pass = link_pass(k_ack, plan, subj, obs)
+    probe_ok = ack_active & ack_pass
+    acct = _acct_add(
+        _link_acct(probe_active, ping_blk, ping_pass),
+        _link_acct(ack_active, ack_blk, ack_pass),
+    )
+    pings = jnp.sum(probe_active, dtype=jnp.int32)
+    acks = jnp.sum(probe_ok, dtype=jnp.int32)
+    msgs_fd = pings + jnp.sum(ack_active, dtype=jnp.int32)
+
+    # Per-edge consecutive counters: misses arm remove-alarms for members,
+    # successes arm join-alarms for non-members; the opposite regime and
+    # non-probe ticks freeze (a view change flips the regime and zeroes).
+    edge_fail = jnp.where(
+        probe_active & in_view,
+        jnp.where(probe_ok, 0, state.edge_fail + 1),
+        jnp.where(in_view, state.edge_fail, 0),
+    )
+    edge_join = jnp.where(
+        probe_active & ~in_view,
+        jnp.where(probe_ok, state.edge_join + 1, 0),
+        jnp.where(~in_view, state.edge_join, 0),
+    )
+    alarmed = in_view & alive[obs] & (edge_fail >= low)
+    join_alarm = ~in_view & alive[obs] & (edge_join >= low)
+    alarms_raised = jnp.sum(
+        alarmed & (state.edge_fail < low), dtype=jnp.int32
+    ) + jnp.sum(join_alarm & (state.edge_join < low), dtype=jnp.int32)
+
+    # ---- 2. alarm broadcast ---------------------------------------------
+    # Observer obs[s, j] tells EVERYONE about its alarmed edge each tick it
+    # stays alarmed (latched state, so one lost broadcast never loses the
+    # cut). Receivers keep their own copy only of what was delivered.
+    any_alarm = alarmed | join_alarm  # [N, k]
+    src_a = obs[None, :, :]  # [1, N, k] broadcast over receivers
+    dst_a = col[:, None, None]  # [N, 1, 1]
+    send_a = any_alarm[None, :, :] & (dst_a != src_a)
+    blk_a = _edge_lookup(plan.block, src_a, dst_a)
+    pass_a = link_pass(k_alarm, plan, src_a, dst_a)
+    acct = _acct_add(acct, _link_acct(send_a, blk_a, pass_a))
+    msgs_gossip = jnp.sum(send_a, dtype=jnp.int32)
+    heard = (send_a & pass_a) | (any_alarm[None, :, :] & (dst_a == src_a))
+    heard = heard & alive[:, None, None]  # dead receivers process nothing
+    recv_rm = heard & alarmed[None, :, :]
+    recv_add = heard & join_alarm[None, :, :]
+
+    # ---- 3. cut detection: segment_sum tally + H/L stability filter ------
+    seg_ids = jnp.asarray(np.repeat(np.arange(n), k), jnp.int32)
+
+    def _tally(r):  # [N, k] bool -> [N] int32 alarms per subject
+        return jax.ops.segment_sum(
+            r.reshape(-1).astype(jnp.int32), seg_ids, num_segments=n
+        )
+
+    tally_rm = jax.vmap(_tally)(recv_rm)  # [N(receiver), N(subject)]
+    tally_add = jax.vmap(_tally)(recv_add)
+    h = params.high_watermark
+    stable_rm = (tally_rm >= h) & mm
+    stable_add = (tally_add >= h) & ~mm
+    unstable = ((tally_rm >= 1) & (tally_rm < h) & mm) | (
+        (tally_add >= 1) & (tally_add < h) & ~mm
+    )
+    # Vote-once-per-configuration (Fast Paxos): the first tick a member's
+    # detector is stable (>=1 stable candidate, no unstable subject) locks
+    # its cut as THE vote it will broadcast until its view changes. A later,
+    # larger cut cannot re-vote — that is what makes two different batches
+    # committing in one configuration impossible.
+    newly_voting = (
+        alive
+        & ~state.voted
+        & jnp.any(stable_rm | stable_add, axis=1)
+        & ~jnp.any(unstable, axis=1)
+    )
+    vote_rm = jnp.where(newly_voting[:, None], stable_rm, state.vote_rm)
+    vote_add = jnp.where(newly_voting[:, None], stable_add, state.vote_add)
+    voted = state.voted | newly_voting
+    cut_detected = jnp.sum(newly_voting, dtype=jnp.int32)
+    proposing = alive & voted
+
+    # ---- 4. vote broadcast + fast-path quorum ----------------------------
+    # Rapid's fast path: commit when >= quorum IDENTICAL votes arrive from
+    # members of the SAME configuration (view_id + digest must match the
+    # receiver's — a vote is meaningless against a different base view).
+    # Whole-batch identity (not per-subject voting) is what makes committed
+    # views bit-equal across members — the R1 agreement property.
+    dg = view_digest(mm)
+    same_cfg = (state.view_id[:, None] == state.view_id[None, :]) & (
+        dg[:, None] == dg[None, :]
+    )
+    src_p = col[None, :]
+    dst_p = col[:, None]
+    send_p = proposing[None, :] & (dst_p != src_p)
+    blk_p = _edge_lookup(plan.block, src_p, dst_p)
+    pass_p = link_pass(k_prop, plan, src_p, dst_p)
+    acct = _acct_add(acct, _link_acct(send_p, blk_p, pass_p))
+    recv_p = (send_p & pass_p) | (proposing[None, :] & eye)
+    recv_p = recv_p & alive[:, None] & same_cfg
+    same = jnp.all(vote_rm[:, None, :] == vote_rm[None, :, :], axis=-1) & jnp.all(
+        vote_add[:, None, :] == vote_add[None, :, :], axis=-1
+    )
+    same = same & proposing[:, None] & proposing[None, :]  # [m2, m] identical
+    cnt = recv_p.astype(jnp.int32) @ same.astype(jnp.int32)  # [recv, m]
+    view_size = jnp.sum(mm, axis=1, dtype=jnp.int32)
+    thr = (
+        params.quorum_num * view_size + params.quorum_den - 1
+    ) // params.quorum_den
+    valid = recv_p & (cnt >= thr[:, None])
+    # Deterministic winner per receiver: max support, then lowest index.
+    score = jnp.where(valid, cnt * (n + 1) + (n - 1 - col[None, :]), -1)
+    winner = jnp.argmax(score, axis=1)
+    batch_rm = vote_rm[winner] & jnp.any(valid, axis=1)[:, None]
+    batch_add = vote_add[winner] & jnp.any(valid, axis=1)[:, None]
+    # A member never applies a batch evicting itself: it stays on its old
+    # configuration (safe: different view id, so R1 groups it apart) until
+    # the join pipeline re-admits it.
+    commit = alive & jnp.any(valid, axis=1) & ~batch_rm[col, col]
+    batch_rm = batch_rm & commit[:, None]
+    batch_add = batch_add & commit[:, None]
+    view_changes = jnp.sum(commit, dtype=jnp.int32)
+    verdicts_dead = jnp.sum(batch_rm, dtype=jnp.int32)
+    verdicts_alive = jnp.sum(batch_add, dtype=jnp.int32)
+    mm2 = ((mm & ~batch_rm) | batch_add) | eye
+    vid2 = state.view_id + commit.astype(jnp.int32)
+
+    # ---- 5. view sync: laggards adopt the highest configuration ----------
+    sync_tick = (t % params.sync_period_ticks) == 0
+    send_s = sync_tick & alive[None, :] & (dst_p != src_p)
+    blk_s = _edge_lookup(plan.block, src_p, dst_p)
+    pass_s = link_pass(k_sync, plan, src_p, dst_p)
+    acct = _acct_add(acct, _link_acct(send_s, blk_s, pass_s))
+    msgs_sync = jnp.sum(send_p, dtype=jnp.int32) + jnp.sum(
+        send_s, dtype=jnp.int32
+    )
+    avail = (send_s & pass_s) | eye
+    sync_score = jnp.where(
+        avail & alive[None, :], vid2[None, :] * (n + 1) + (n - 1 - col[None, :]), -1
+    )
+    best = jnp.argmax(sync_score, axis=1)  # [N] best sender per receiver
+    cand_mask = mm2[best]  # [N, N] the adopted rows
+    includes_self = cand_mask[col, col]
+    adopt = alive & (vid2[best] > vid2) & includes_self
+    mm3 = jnp.where(adopt[:, None], cand_mask, mm2) | eye
+    vid3 = jnp.where(adopt, vid2[best], vid2)
+
+    # Every view change (commit or adoption) starts a fresh configuration:
+    # the old locked vote is void and the member may vote once again.
+    view_changed = commit | adopt
+    new_state = state.replace(
+        member_mask=mm3,
+        view_id=vid3,
+        edge_fail=edge_fail,
+        edge_join=edge_join,
+        vote_rm=jnp.where(view_changed[:, None], False, vote_rm),
+        vote_add=jnp.where(view_changed[:, None], False, vote_add),
+        voted=voted & ~view_changed,
+        tick=t,
+        rng=rng_next,
+    )
+    if not collect:
+        return new_state, {"tick": t}
+
+    # ---- metrics (SHARED_COUNTERS schema + consistency-plane traces) -----
+    n_alive = jnp.sum(alive, dtype=jnp.int32)
+    match = (mm3 == alive[None, :]) | eye
+    viewer_conv = jnp.mean(match, axis=1)
+    convergence = jnp.sum(viewer_conv * alive) / jnp.maximum(n_alive, 1)
+    zero = jnp.zeros((), jnp.int32)
+    metrics = {
+        "tick": t,
+        "convergence": convergence,
+        "n_alive": n_alive,
+        # Rapid-plane counters (also zero-emitted by the SWIM engines).
+        "view_changes": view_changes,
+        "alarms_raised": alarms_raised,
+        "cut_detected": cut_detected,
+        # Shared schema; events without a Rapid analog are constant zero.
+        "pings": pings,
+        "ping_reqs": zero,
+        "acks": acks,
+        "suspicions_raised": zero,
+        "verdicts_dead": verdicts_dead,
+        "verdicts_alive": verdicts_alive,
+        "gossip_infections": zero,
+        "msgs_fd": msgs_fd,
+        "msgs_sync": msgs_sync,
+        "msgs_gossip": msgs_gossip,
+        "link_attempts": acct[0],
+        "link_delivered": acct[1],
+        "fault_blocked": acct[2],
+        "fault_lost": acct[3],
+        # Monotonicity gauges (inc_max has no Rapid analog: constant 0).
+        "inc_max": zero,
+        "epoch_max": jnp.max(state.epoch),
+        # Consistency plane, per member — the R1-R4 certifier's input.
+        "view_id": vid3,
+        "view_digest": view_digest(mm3),
+        "view_size": jnp.sum(mm3, axis=1, dtype=jnp.int32),
+        "alive_mask": alive,
+    }
+    return new_state, metrics
+
+
+def scan_rapid_ticks(
+    params: RapidParams,
+    state: RapidState,
+    plan: FaultPlan | FaultSchedule,
+    n_ticks: int,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """UNJITTED scan body of :func:`run_rapid_ticks` — the piece the
+    ensemble twin vmaps directly (same pattern as sim/run.py::scan_ticks)."""
+    scheduled = isinstance(plan, FaultSchedule)
+
+    def step(carry: RapidState, _):
+        if scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
+            t = carry.tick + 1  # the global tick about to execute
+            kill_m, restart_m = events_at(plan, t, params.n)
+            carry = apply_events_rapid(params, carry, kill_m, restart_m)
+            plan_t = plan_at(plan, t)
+        else:
+            plan_t = plan
+        new_state, metrics = rapid_tick(
+            params, carry, plan_t, collect=collect, knobs=knobs
+        )
+        if scheduled and collect:  # tpulint: disable=R1 -- both are trace-time constants (pytree type + static argname)
+            metrics = dict(metrics)
+            metrics["plan_dirty"] = plan_dirty_at(plan, t)
+            metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
+            metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+        return new_state, metrics
+
+    return lax.scan(step, state, None, length=n_ticks)
+
+
+@partial(jax.jit, static_argnums=(0, 3), static_argnames=("collect",))
+def run_rapid_ticks(
+    params: RapidParams,
+    state: RapidState,
+    plan: FaultPlan | FaultSchedule,
+    n_ticks: int,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Run ``n_ticks`` Rapid rounds; returns ``(final_state, traces)`` with
+    every trace leaf carrying a leading ``n_ticks`` axis. Accepts a fixed
+    :class:`FaultPlan` or a :class:`FaultSchedule` (scheduled runs apply
+    scripted kill/restart at the top of each tick and add the
+    ``plan_dirty``/``kills_fired``/``restarts_fired`` gauges, exactly like
+    the SWIM runners)."""
+    return scan_rapid_ticks(
+        params, state, plan, n_ticks, collect=collect, knobs=knobs
+    )
+
+
+def init_ensemble_rapid(
+    params: RapidParams, init_seeds
+) -> RapidState:
+    """Stacked :func:`init_rapid_full_view` states, one per RNG seed."""
+    from scalecube_cluster_tpu.sim.ensemble import stack_universes
+
+    return stack_universes(
+        init_rapid_full_view(params, seed=int(s)) for s in init_seeds
+    )
+
+
+@partial(jax.jit, static_argnums=(0, 3), static_argnames=("collect",))
+def run_ensemble_rapid_ticks(
+    params: RapidParams,
+    states: RapidState,
+    plans: FaultPlan | FaultSchedule,
+    n_ticks: int,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """B Rapid universes, one compiled call — the Rapid twin of
+    sim/ensemble.py::run_ensemble_ticks: ``states``/``plans``/``knobs`` are
+    stacked pytrees (leading axis B), the executable is keyed on
+    (n, B, n_ticks, plan treedef), and universe b is bit-identical to the
+    equivalent single run."""
+
+    def one(st, pl, kn):
+        return scan_rapid_ticks(
+            params, st, pl, n_ticks, collect=collect, knobs=kn
+        )
+
+    return jax.vmap(one)(states, plans, knobs)

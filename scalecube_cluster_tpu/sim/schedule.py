@@ -319,21 +319,29 @@ class ScheduleBuilder:
         )
         flap_any = np.array([bool(m.any()) for m in flap])
 
-        by_tick_node: dict[tuple[int, int], int] = {}
+        by_tick_node: dict[tuple[int, int], set[int]] = {}
         restarts_per_node: dict[int, int] = {}
         for tick, node, kind in self._events:
             if tick < 1:
                 raise ValueError(f"event tick {tick} precedes the first tick")
             if not 0 <= node < self.n:
                 raise ValueError(f"event node {node} outside [0, {self.n})")
-            if (tick, node) in by_tick_node:
+            kinds = by_tick_node.setdefault((tick, node), set())
+            if kind in kinds:
                 raise ValueError(
-                    f"node {node} has two events at tick {tick}"
-                    " (kill+restart the same tick is ambiguous)"
+                    f"node {node} has duplicate {'restart' if kind else 'kill'}"
+                    f" events at tick {tick}"
                 )
-            by_tick_node[(tick, node)] = kind
+            kinds.add(kind)
             if kind == EV_RESTART:
                 restarts_per_node[node] = restarts_per_node.get(node, 0) + 1
+        # A kill and a restart on the same (tick, node) is a legal bounce
+        # with PINNED semantics: every apply_events_* computes
+        # ``alive = (alive & ~kill) | restart``, so the restart wins and the
+        # node comes out of the tick alive at the bumped epoch, regardless
+        # of the order the events were added or sorted into ev_* slots. The
+        # restart still spends epoch budget (counted above) and still
+        # resets the node's protocol state.
         e0 = np.broadcast_to(np.asarray(epoch0, np.int32), (self.n,))
         for node, count in restarts_per_node.items():
             if int(e0[node]) + count > merge_ops.EPOCH_MAX:

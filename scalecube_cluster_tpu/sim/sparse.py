@@ -1532,6 +1532,11 @@ def sparse_tick(
         # Monotonicity witnesses for the invariant certifier.
         "inc_max": jnp.max(inc_self),
         "epoch_max": jnp.max(state.epoch),
+        # Consistent-membership counters (Rapid engine, sim/rapid.py): SWIM
+        # has no view commits, so the schema slots are constant zero here.
+        "view_changes": jnp.zeros((), jnp.int32),
+        "alarms_raised": jnp.zeros((), jnp.int32),
+        "cut_detected": jnp.zeros((), jnp.int32),
     }
     return new_state, metrics
 

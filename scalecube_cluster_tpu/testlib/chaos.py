@@ -34,6 +34,13 @@ from scalecube_cluster_tpu.sim.ensemble import (
 )
 from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.rapid import (
+    RapidParams,
+    init_ensemble_rapid,
+    init_rapid_full_view,
+    run_ensemble_rapid_ticks,
+    run_rapid_ticks,
+)
 from scalecube_cluster_tpu.sim.run import run_ticks
 from scalecube_cluster_tpu.sim.schedule import FaultSchedule, ScheduleBuilder
 from scalecube_cluster_tpu.sim.sparse import (
@@ -43,10 +50,13 @@ from scalecube_cluster_tpu.sim.sparse import (
 )
 from scalecube_cluster_tpu.sim.state import init_full_view, seeds_mask
 from scalecube_cluster_tpu.testlib.invariants import (
+    RAPID_REQUIRED_KEYS,
     REQUIRED_KEYS,
     InvariantViolation,
     certify_heal,
     certify_population,
+    certify_rapid_population,
+    certify_rapid_traces,
     certify_traces,
     heal_bound,
 )
@@ -63,6 +73,28 @@ DISTURB_LEN_LO, DISTURB_LEN_HI = 40, 60
 DISTURB_END_MAX = DISTURB_START_HI + DISTURB_LEN_HI
 
 ENGINES = ("dense", "sparse")
+#: All engines chaos understands — the SWIM pair plus the Rapid
+#: consistent-membership engine (sim/rapid.py). Rapid trials run the SAME
+#: sampled schedules and are certified against C1-C7 AND R1-R4.
+ALL_ENGINES = ("dense", "sparse", "rapid")
+#: Scenario-variant names, indexed by the draw in :func:`sample_schedule`.
+VARIANTS = ("loss", "partition", "flap")
+
+
+def rapid_chaos_params(n: int) -> RapidParams:
+    """Rapid constants matched to :func:`chaos_params`' cadence: the same
+    2-tick FD period, k-ring width clipped for tiny clusters, and the
+    default 4/6 watermarks — so a flap that stays up 4 of every 8 ticks
+    (the chaos flap variant) can never string L consecutive misses."""
+    k = min(8, n - 1)
+    return RapidParams(
+        n=n,
+        k=k,
+        low_watermark=4,
+        high_watermark=min(6, k),
+        fd_period_ticks=2,
+        sync_period_ticks=5,
+    )
 
 
 def chaos_params(n: int) -> SimParams:
@@ -88,10 +120,14 @@ def trial_ticks(params: SimParams) -> int:
     return DISTURB_END_MAX + heal_bound(params) + 10
 
 
-def sample_schedule(seed: int, n: int) -> FaultSchedule:
+def sample_schedule(seed: int, n: int, with_meta: bool = False):
     """Draw one chaos schedule from ``seed``: clean warm-up, one disturbance
     segment (loss / partition / flap, uniformly chosen), kill+restart pairs
-    inside the window, then clean through the end of the run."""
+    inside the window, then clean through the end of the run.
+
+    ``with_meta=True`` additionally returns a dict naming the drawn scenario
+    (``variant``/``disturb_start``/``disturb_end``) — the race harness keys
+    its per-scenario comparison on it."""
     rng = np.random.default_rng(seed)
     d0 = int(rng.integers(DISTURB_START_LO, DISTURB_START_HI + 1))
     d1 = d0 + int(rng.integers(DISTURB_LEN_LO, DISTURB_LEN_HI + 1))
@@ -132,7 +168,14 @@ def sample_schedule(seed: int, n: int) -> FaultSchedule:
         k_tick = d0 + 1 + 2 * i
         r_tick = int(rng.integers(k_tick + 5, d1))
         b.kill(k_tick, int(node)).restart(r_tick, int(node))
-    return b.build()
+    schedule = b.build()
+    if with_meta:
+        return schedule, {
+            "variant": VARIANTS[variant],
+            "disturb_start": d0,
+            "disturb_end": d1,
+        }
+    return schedule
 
 
 def sparse_convergence(state) -> float:
@@ -170,7 +213,15 @@ def run_scheduled(
         )
         state, traces = run_sparse_ticks(sp, state, schedule, n_ticks)
         return state, traces, sparse_convergence(state)
-    raise ValueError(f"unknown engine {engine!r}; expected one of {ENGINES}")
+    if engine == "rapid":
+        rp = rapid_chaos_params(n)
+        state = init_rapid_full_view(rp, seed=seed)
+        state, traces = run_rapid_ticks(rp, state, schedule, n_ticks)
+        conv = float(jax.device_get(traces["convergence"][-1]))
+        return state, traces, conv
+    raise ValueError(
+        f"unknown engine {engine!r}; expected one of {ALL_ENGINES}"
+    )
 
 
 def reproducer_line(seed: int, n: int, engine: str, ticks: int, digest: str) -> str:
@@ -199,6 +250,12 @@ def chaos_trial(seed: int, n: int, engine: str) -> dict:
     try:
         _, traces, conv = run_scheduled(engine, params, schedule, ticks)
         summary = certify_traces(params, traces)
+        if engine == "rapid":
+            # The consistency plane gets its own oracle on top of C1-C7.
+            summary = {
+                **summary,
+                **certify_rapid_traces(rapid_chaos_params(n), traces),
+            }
         certify_heal(params, summary, conv)
     except InvariantViolation as e:
         result.update(ok=False, violation=e.invariant, error=str(e))
@@ -247,10 +304,36 @@ def chaos_ensemble(seeds, n: int, engine: str) -> list[dict]:
         pull["conv"] = ensemble_sparse_convergence(states)
         host = jax.device_get(pull)
         conv = np.asarray(host.pop("conv"))
+    elif engine == "rapid":
+        rp = rapid_chaos_params(n)
+        states = init_ensemble_rapid(rp, [0] * b_count)
+        _, traces = run_ensemble_rapid_ticks(rp, states, plans, ticks)
+        keys = dict.fromkeys(
+            (*REQUIRED_KEYS, *RAPID_REQUIRED_KEYS, "convergence")
+        )
+        host = jax.device_get({k: traces[k] for k in keys})
+        conv = np.asarray(host.pop("convergence"))[:, -1]
     else:
-        raise ValueError(f"unknown engine {engine!r}; expected one of {ENGINES}")
+        raise ValueError(
+            f"unknown engine {engine!r}; expected one of {ALL_ENGINES}"
+        )
 
     cert = certify_population(params, host, final_convergence=conv)
+    if engine == "rapid":
+        # Merge the R1-R4 verdicts: a universe passes only if BOTH oracles
+        # pass; a SWIM-side violation (more fundamental accounting) wins
+        # the report when both fire.
+        rcert = certify_rapid_population(rapid_chaos_params(n), host)
+        for b in range(b_count):
+            if cert["ok"][b] and not rcert["ok"][b]:
+                cert["ok"][b] = False
+                cert["violations"][b] = rcert["violations"][b]
+                cert["summaries"][b] = None
+            elif cert["ok"][b]:
+                cert["summaries"][b] = {
+                    **cert["summaries"][b],
+                    **rcert["summaries"][b],
+                }
     results = []
     for b, seed in enumerate(seeds):
         digest = schedules[b].digest()
@@ -277,6 +360,53 @@ def chaos_ensemble(seeds, n: int, engine: str) -> list[dict]:
             )
         results.append(result)
     return results
+
+
+def chaos_race(seeds, n: int, swim_engine: str = "sparse") -> list[dict]:
+    """SWIM vs Rapid on IDENTICAL seed/schedule matrices — the protocol
+    comparison the ensemble engine was built for. Both engines run as one
+    vmapped :func:`chaos_ensemble` call over the same sampled
+    :class:`FaultSchedule` pytree (same seeds, same digests, same trial
+    length), so every row pairs a SWIM trial with the Rapid trial of the
+    *same* timeline, bit-reproducible from the shared CHAOS-REPRO digest.
+
+    Each paired row reports the churn comparison the acceptance criterion
+    pins: SWIM's eventually-consistent plane (``suspicions_raised`` /
+    ``verdicts_dead``) next to Rapid's consistent plane (``view_changes`` /
+    ``alarms_raised``), plus the drawn scenario variant. On flap scenarios
+    Rapid's L-watermark must yield ZERO flap-induced view changes (R4) —
+    any view change in a Rapid row comes from the scripted kill/restart
+    pairs, never from the square-wave link."""
+    seeds = [int(s) for s in seeds]
+    swim = chaos_ensemble(seeds, n, swim_engine)
+    rapid = chaos_ensemble(seeds, n, "rapid")
+    rows = []
+    for s_row, r_row, seed in zip(swim, rapid, seeds):
+        assert s_row["digest"] == r_row["digest"], "race rows must pair"
+        _, meta = sample_schedule(seed, n, with_meta=True)
+        rows.append(
+            {
+                "seed": seed,
+                "n": n,
+                "digest": s_row["digest"],
+                "ticks": s_row["ticks"],
+                "variant": meta["variant"],
+                "ok": bool(s_row["ok"] and r_row["ok"]),
+                "swim_engine": swim_engine,
+                "swim_ok": s_row["ok"],
+                "swim_suspicions": s_row.get("suspicions_raised"),
+                "swim_verdicts_dead": s_row.get("verdicts_dead"),
+                "swim_convergence": s_row.get("final_convergence"),
+                "rapid_ok": r_row["ok"],
+                "rapid_view_changes": r_row.get("view_changes"),
+                "rapid_alarms_raised": r_row.get("alarms_raised"),
+                "rapid_max_view_id": r_row.get("max_view_id"),
+                "rapid_convergence": r_row.get("final_convergence"),
+                "swim": s_row,
+                "rapid": r_row,
+            }
+        )
+    return rows
 
 
 def chaos_soak(

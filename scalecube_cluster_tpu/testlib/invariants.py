@@ -28,6 +28,24 @@ C7  Convergence within a computed bound after heal — once the timeline goes
     :func:`heal_bound` ticks (checked by the caller with the engine's
     convergence measure; the certifier computes the deadline).
 
+The Rapid engine (sim/rapid.py) is certified against CONSISTENCY
+properties SWIM never promises, replayed from its per-member view traces
+(``view_id`` / ``view_digest`` / ``view_size`` / ``alive_mask``):
+
+R1  View agreement — all live members holding the same view id hold
+    bit-identical membership (equal view digests).
+R2  View monotonicity — each member's view id never decreases while the
+    member stays alive (a scripted restart legitimately resets it to the
+    bootstrap configuration 0).
+R3  No split-brain — for any view id, at most ONE digest group may
+    constitute a majority of its own claimed view size. Checked BEFORE R1
+    so a genuine two-majority split reports the more severe code (a
+    split-brain tamper also disagrees, but "R3-split-brain" names it).
+R4  Stability — no view change before the network has missed probes on at
+    least ``low_watermark`` distinct ticks: the L-watermark means a link
+    must fail that many consecutive probes before it can even alarm, so a
+    flap shorter than L can never surface as a view change.
+
 Violations raise :class:`InvariantViolation` with the failing tick and
 values — the chaos harness wraps that into a one-line seeded reproducer.
 """
@@ -261,6 +279,157 @@ def certify_population(
             if final_convergence is not None:
                 certify_heal(params, summary, float(final_convergence[b]))
             summaries[b] = summary
+        except InvariantViolation as e:
+            ok[b] = False
+            violations[b] = {"invariant": e.invariant, "error": str(e)}
+    return {"ok": ok, "violations": violations, "summaries": summaries}
+
+
+#: Trace keys a certified Rapid trajectory must carry (sim/rapid.py with
+#: collect=True; the per-member view traces are the consistency plane).
+RAPID_REQUIRED_KEYS = (
+    "view_id",
+    "view_digest",
+    "view_size",
+    "alive_mask",
+    "view_changes",
+    "alarms_raised",
+    "cut_detected",
+    "pings",
+    "acks",
+)
+
+#: Keys whose trace leaves carry a per-member axis ([T, N], not [T]).
+_RAPID_MEMBER_KEYS = ("view_id", "view_digest", "view_size", "alive_mask")
+
+
+def _get_rapid(traces: dict, key: str) -> np.ndarray:
+    if key not in traces:
+        raise InvariantViolation(
+            "schema", f"certified Rapid traces must carry {key!r} "
+            f"(collect=True run of sim/rapid.py); got keys {sorted(traces)}"
+        )
+    arr = np.asarray(traces[key])
+    if key in _RAPID_MEMBER_KEYS:
+        if arr.ndim != 2:
+            raise InvariantViolation(
+                "schema",
+                f"{key!r} must be a [T, N] per-member trace; got {arr.shape}",
+            )
+        return arr
+    return arr.reshape(-1)
+
+
+def certify_rapid_traces(params, traces: dict) -> dict:
+    """Certify one Rapid trajectory's traces (R1-R4). ``params`` is the
+    run's :class:`~scalecube_cluster_tpu.sim.rapid.RapidParams` (the
+    L-watermark parameterizes R4). Returns a summary dict on success;
+    raises :class:`InvariantViolation` at the first breach.
+
+    Check order is R3, R1, R2, R4 — see the module docstring for why
+    split-brain outranks plain disagreement.
+    """
+    vid = _get_rapid(traces, "view_id")
+    dig = _get_rapid(traces, "view_digest")
+    vsz = _get_rapid(traces, "view_size")
+    alv = _get_rapid(traces, "alive_mask").astype(bool)
+    vc = _get_rapid(traces, "view_changes")
+    pings = _get_rapid(traces, "pings")
+    acks = _get_rapid(traces, "acks")
+    ticks = vid.shape[0]
+    if ticks == 0:
+        raise InvariantViolation("schema", "empty trace")
+
+    # R3 no split-brain, then R1 agreement — per tick, per view id, among
+    # live members only (a dead process's frozen view claims nothing).
+    for t in range(ticks):
+        live = np.flatnonzero(alv[t])
+        if live.size == 0:
+            continue
+        for view in np.unique(vid[t, live]):
+            grp = live[vid[t, live] == view]
+            digests, first, counts = np.unique(
+                dig[t, grp], return_index=True, return_counts=True
+            )
+            claimed = vsz[t, grp][first]  # one claimed size per digest group
+            majorities = int((2 * counts > claimed).sum())
+            if majorities > 1:
+                raise InvariantViolation(
+                    "R3-split-brain",
+                    f"tick {t}: view id {int(view)} has {majorities} "
+                    f"majority digest groups (sizes {counts.tolist()} of "
+                    f"claimed views {claimed.tolist()})",
+                )
+            if digests.size > 1:
+                raise InvariantViolation(
+                    "R1-agreement",
+                    f"tick {t}: {grp.size} live members share view id "
+                    f"{int(view)} but split over {digests.size} digests "
+                    f"(counts {counts.tolist()})",
+                )
+
+    # R2 per-member view-id monotonicity while continuously alive.
+    if ticks > 1:
+        fell = (vid[1:] < vid[:-1]) & alv[1:] & alv[:-1]
+        if fell.any():
+            t, m = map(int, np.argwhere(fell)[0])
+            raise InvariantViolation(
+                "R2-monotone",
+                f"tick {t + 1}: member {m} view id dropped "
+                f"{int(vid[t, m])} -> {int(vid[t + 1, m])} without a "
+                "restart (member alive across both ticks)",
+            )
+
+    # R4 stability: the first view change needs >= L prior missed-probe
+    # ticks — the alarm counter cannot cross the L-watermark any faster.
+    low = int(params.low_watermark)
+    vc_ticks = np.flatnonzero(vc > 0)
+    first_vc = int(vc_ticks[0]) if vc_ticks.size else -1
+    if vc_ticks.size:
+        miss_ticks = int((pings[: first_vc + 1] > acks[: first_vc + 1]).sum())
+        if miss_ticks < low:
+            raise InvariantViolation(
+                "R4-stability",
+                f"tick {first_vc}: view changed after only {miss_ticks} "
+                f"missed-probe ticks (< L watermark {low}) — a flap "
+                "shorter than L must never surface as a view change",
+            )
+
+    return {
+        "ticks": int(ticks),
+        "view_changes": int(vc.sum()),
+        "alarms_raised": int(_get_rapid(traces, "alarms_raised").sum()),
+        "cut_detected": int(_get_rapid(traces, "cut_detected").sum()),
+        "max_view_id": int(vid[-1].max()),
+        "first_view_change_tick": first_vc,
+    }
+
+
+def certify_rapid_population(params, traces: dict) -> dict:
+    """Batched R1-R4 certifier over an ensemble Rapid run: every trace leaf
+    carries a leading universe axis (scalars ``[B, T]``, member traces
+    ``[B, T, N]``); universe b is certified exactly as a single run. Never
+    raises — returns the same ``{"ok", "violations", "summaries"}``
+    structure as :func:`certify_population`."""
+    missing = [k for k in RAPID_REQUIRED_KEYS if k not in traces]
+    if missing:
+        raise InvariantViolation(
+            "schema", f"population traces must carry {missing!r}"
+        )
+    lead = np.asarray(traces["view_changes"])
+    if lead.ndim != 2:
+        raise InvariantViolation(
+            "schema",
+            f"population traces need a [B, T] universe axis; got {lead.shape}",
+        )
+    b_count = lead.shape[0]
+    ok = np.ones(b_count, bool)
+    violations: list = [None] * b_count
+    summaries: list = [None] * b_count
+    for b in range(b_count):
+        tb = {k: np.asarray(traces[k])[b] for k in RAPID_REQUIRED_KEYS}
+        try:
+            summaries[b] = certify_rapid_traces(params, tb)
         except InvariantViolation as e:
             ok[b] = False
             violations[b] = {"invariant": e.invariant, "error": str(e)}

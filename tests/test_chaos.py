@@ -283,6 +283,56 @@ def test_tampered_real_run_caught():
     assert e.value.invariant == "C1-conservation"
 
 
+# -- 5. same-tick kill+restart: pinned restart-wins semantics ---------------
+
+
+@pytest.mark.parametrize("order", ["kill-first", "restart-first"])
+def test_same_tick_kill_restart_restart_wins(order):
+    """A kill and a restart scheduled on the same (tick, node) used to be
+    rejected as ambiguous; the semantics are now pinned — the restart wins
+    (``alive = (alive & ~kill) | restart`` in every apply_events_*) — and
+    the outcome is independent of the order the events were added."""
+    n, ticks, node, t_ev = 16, 30, 5, 9
+    p = small_params(n)
+    sm = seeds_mask(n, [0])
+    b = ScheduleBuilder(n).add_segment(0, FaultPlan.clean(n))
+    if order == "kill-first":
+        b.kill(t_ev, node).restart(t_ev, node)
+    else:
+        b.restart(t_ev, node).kill(t_ev, node)
+    schedule = b.build()
+    st, tr = run_ticks(p, init_full_view(n, 2), schedule, sm, ticks)
+    assert bool(np.asarray(st.alive)[node]), "restart must win the bounce"
+    assert int(np.asarray(st.epoch)[node]) == 1, "bounce still spends epoch"
+    # Both events fire on the scheduled tick (trace row t_ev - 1 = tick t_ev).
+    assert int(np.asarray(tr["kills_fired"])[t_ev - 1]) == 1
+    assert int(np.asarray(tr["restarts_fired"])[t_ev - 1]) == 1
+
+
+def test_same_tick_kill_restart_order_bit_identical():
+    """The frozen schedule (and therefore the whole trajectory) is identical
+    whichever way the colliding events were inserted — build() sorts."""
+    n, t_ev, node = 16, 9, 5
+    a = (
+        ScheduleBuilder(n).add_segment(0, FaultPlan.clean(n))
+        .kill(t_ev, node).restart(t_ev, node).build()
+    )
+    b = (
+        ScheduleBuilder(n).add_segment(0, FaultPlan.clean(n))
+        .restart(t_ev, node).kill(t_ev, node).build()
+    )
+    assert a.digest() == b.digest()
+
+
+def test_duplicate_same_kind_event_still_rejected():
+    b = (
+        ScheduleBuilder(16).add_segment(0, FaultPlan.clean(16))
+        .kill(5, 3).kill(5, 3)
+    )
+    with pytest.raises(ValueError, match="duplicate"):
+        b.build()
+
+
 def test_heal_certifier_rejects_partial_convergence():
     params = chaos_params(CHAOS_N)
     summary = certify_traces(params, _clean_traces(heal_bound(params) + 5))

@@ -1,0 +1,343 @@
+"""Rapid consistent-membership engine (sim/rapid.py) + R1-R4 certifier.
+
+Five layers:
+
+1. Clean/positive — a scheduled clean run and a kill→restart view cycle
+   both pass the C1-C7 AND R1-R4 certifiers; the zero-event schedule is
+   bit-identical to the fixed-FaultPlan run (the scheduled step perturbs
+   nothing when no event is armed).
+2. Stability (the headline property) — a flap-only schedule with NO kills
+   yields ZERO Rapid view changes and ZERO alarms while SWIM on the very
+   same schedule racks up suspicions: the R4 acceptance criterion, pinned.
+3. Knobs — identity knobs are bit-identical to knobs=None; scaling the
+   L-watermark up delays the first removal commit.
+4. Ensemble — universe 0 of the vmapped twin is bit-equal to the solo run,
+   and a second same-shape schedule batch reuses the executable (zero
+   recompiles, utils/jaxcache.py::jit_cache_size).
+5. Negative — four doctored trace tampers are each caught by the R1-R4
+   certifier with the right invariant id (the certifier actually bites).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.sim import (
+    FaultPlan,
+    Knobs,
+    ScheduleBuilder,
+    init_ensemble_rapid,
+    init_full_view,
+    init_rapid_full_view,
+    run_ensemble_rapid_ticks,
+    run_rapid_ticks,
+    run_ticks,
+)
+import jax.numpy as jnp
+
+from scalecube_cluster_tpu.sim.ensemble import stack_universes
+from scalecube_cluster_tpu.sim.rapid import observer_matrix, view_digest
+from scalecube_cluster_tpu.sim.state import seeds_mask
+from scalecube_cluster_tpu.testlib.chaos import (
+    chaos_params,
+    rapid_chaos_params,
+    sample_schedule,
+    trial_ticks,
+)
+from scalecube_cluster_tpu.testlib.invariants import (
+    InvariantViolation,
+    certify_rapid_population,
+    certify_rapid_traces,
+    certify_traces,
+)
+from scalecube_cluster_tpu.utils.jaxcache import jit_cache_size
+
+SCHED_ONLY = {"plan_dirty", "kills_fired", "restarts_fired"}
+
+N = 16
+
+
+def _clean_schedule(n, extra=None):
+    b = ScheduleBuilder(n).add_segment(0, FaultPlan.clean(n))
+    if extra:
+        extra(b)
+    return b.build()
+
+
+def _assert_traces_equal(a, b, context):
+    keys = (set(a) & set(b)) - SCHED_ONLY
+    assert keys, (context, sorted(a), sorted(b))
+    for k in sorted(keys):
+        assert np.array_equal(np.asarray(a[k]), np.asarray(b[k])), (context, k)
+
+
+# -- 1. clean + view-cycle positives -----------------------------------------
+
+
+def test_clean_run_certifies_with_zero_view_changes():
+    rp = rapid_chaos_params(N)
+    state = init_rapid_full_view(rp)
+    state, traces = run_rapid_ticks(rp, state, _clean_schedule(N), 60)
+    summary = certify_rapid_traces(rp, traces)
+    assert summary["view_changes"] == 0
+    assert summary["alarms_raised"] == 0
+    assert summary["max_view_id"] == 0
+    assert float(np.asarray(traces["convergence"])[-1]) == 1.0
+    # The SWIM accounting plane (C1-C6) holds on Rapid traces too — the
+    # engine emits the full SHARED_COUNTERS schema.
+    certify_traces(chaos_params(N), traces)
+
+
+def test_zero_event_schedule_matches_fixed_plan():
+    rp = rapid_chaos_params(N)
+    _, tr_plan = run_rapid_ticks(
+        rp, init_rapid_full_view(rp), FaultPlan.clean(N), 40
+    )
+    _, tr_sched = run_rapid_ticks(
+        rp, init_rapid_full_view(rp), _clean_schedule(N), 40
+    )
+    _assert_traces_equal(tr_plan, tr_sched, "rapid zero-event schedule")
+
+
+def test_kill_restart_view_cycle():
+    """A scripted kill must surface as a committed removal (view change on
+    every surviving member), the restart as a committed re-add, and the
+    run must end re-converged on one shared view at the same id."""
+    rp = rapid_chaos_params(N)
+    victim = 3
+    sched = _clean_schedule(
+        N, lambda b: b.kill(10, victim).restart(40, victim)
+    )
+    state, traces = run_rapid_ticks(rp, init_rapid_full_view(rp), sched, 100)
+    summary = certify_rapid_traces(rp, traces)
+    assert summary["view_changes"] > 0
+    assert summary["max_view_id"] >= 2  # removal commit + re-add commit
+    assert summary["cut_detected"] > 0
+    vid = np.asarray(traces["view_id"])
+    assert np.all(vid[-1] == vid[-1][0]), "all members end at one view id"
+    dig = np.asarray(traces["view_digest"])
+    assert np.all(dig[-1] == dig[-1][0]), "…and one shared configuration"
+    assert float(np.asarray(traces["convergence"])[-1]) == 1.0
+    assert bool(np.asarray(state.alive)[victim])
+    assert int(np.asarray(state.epoch)[victim]) == 1
+    # The removal cut needs L consecutive misses at the FD cadence before
+    # any alarm can cross the watermark: the kill tick's own probe is the
+    # first miss, so the commit can't precede kill + (L-1)*fd.
+    vc_ticks = np.flatnonzero(np.asarray(traces["view_changes"]) > 0)
+    first_commit_tick = int(vc_ticks[0]) + 1  # trace row i = tick i+1
+    assert first_commit_tick >= 10 + (rp.low_watermark - 1) * rp.fd_period_ticks
+
+
+def test_same_tick_kill_restart_bounce_on_rapid():
+    """The pinned restart-wins bounce semantics (tests/test_chaos.py) hold
+    on the Rapid event applier too: the node stays alive at epoch 1 and the
+    run stays certified."""
+    rp = rapid_chaos_params(N)
+    sched = _clean_schedule(N, lambda b: b.kill(9, 5).restart(9, 5))
+    state, traces = run_rapid_ticks(rp, init_rapid_full_view(rp), sched, 60)
+    certify_rapid_traces(rp, traces)
+    assert bool(np.asarray(state.alive)[5])
+    assert int(np.asarray(state.epoch)[5]) == 1
+
+
+# -- 2. stability: the SWIM-vs-Rapid headline --------------------------------
+
+
+def _flap_schedule(n):
+    """Square-wave flap across a minority/majority cut — links down 4 of
+    every 8 ticks between ticks 10 and 50, NO kills (the chaos flap variant
+    minus its kill/restart pairs)."""
+    m = max(1, n // 4)
+    cross = np.zeros((n, n), bool)
+    cross[:m, m:] = True
+    cross[m:, :m] = True
+    clean = FaultPlan.clean(n)
+    return (
+        ScheduleBuilder(n)
+        .add_segment(0, clean)
+        .add_segment(10, clean, flap_mask=cross, flap_period=8, flap_on=4)
+        .add_segment(50, clean)
+        .build()
+    )
+
+
+def test_flap_only_rapid_silent_while_swim_suspects():
+    """R4 in vivo: a flap shorter than L consecutive FD misses must never
+    surface as a Rapid view change — while SWIM's per-probe suspicion
+    machinery fires on the very same schedule. This is the paper's
+    stable-failure-detection claim, pinned as an executable test."""
+    sched = _flap_schedule(N)
+
+    rp = rapid_chaos_params(N)
+    _, rtraces = run_rapid_ticks(rp, init_rapid_full_view(rp), sched, 70)
+    rsum = certify_rapid_traces(rp, rtraces)
+    assert rsum["view_changes"] == 0, "flap must not drive a view change"
+    assert rsum["alarms_raised"] == 0, "flap must not even cross L"
+    assert rsum["max_view_id"] == 0
+
+    sp = chaos_params(N)
+    sstate = init_full_view(N, sp.user_gossip_slots)
+    _, straces = run_ticks(sp, sstate, sched, seeds_mask(N, [0]), 70)
+    assert int(np.asarray(straces["suspicions_raised"]).sum()) > 0, (
+        "the comparison is vacuous if SWIM doesn't churn on this flap"
+    )
+
+
+# -- 3. knobs -----------------------------------------------------------------
+
+
+def _identity_knobs():
+    return Knobs(
+        suspicion_mult=jnp.asarray(1.0, jnp.float32),
+        fanout_cap=jnp.asarray(3, jnp.int32),  # ignored by Rapid
+    )
+
+
+def test_identity_knobs_bit_identical():
+    rp = rapid_chaos_params(N)
+    sched = _clean_schedule(N, lambda b: b.kill(10, 3))
+    _, base = run_rapid_ticks(rp, init_rapid_full_view(rp), sched, 50)
+    _, knobbed = run_rapid_ticks(
+        rp, init_rapid_full_view(rp), sched, 50, knobs=_identity_knobs()
+    )
+    _assert_traces_equal(base, knobbed, "identity knobs")
+
+
+def test_suspicion_mult_scales_l_watermark():
+    """suspicion_mult=3 triples the L-watermark, so the removal commit for
+    a scripted kill lands strictly later than at the default L."""
+    rp = rapid_chaos_params(N)
+    sched = _clean_schedule(N, lambda b: b.kill(10, 3))
+    _, base = run_rapid_ticks(rp, init_rapid_full_view(rp), sched, 80)
+    slow_knobs = Knobs(
+        suspicion_mult=jnp.asarray(3.0, jnp.float32),
+        fanout_cap=jnp.asarray(3, jnp.int32),
+    )
+    _, slow = run_rapid_ticks(
+        rp, init_rapid_full_view(rp), sched, 80, knobs=slow_knobs
+    )
+    t_base = certify_rapid_traces(rp, base)["first_view_change_tick"]
+    # The certifier's R4 uses the static L; certify the slow run's summary
+    # fields by hand (its effective watermark is 3L).
+    slow_vc = np.flatnonzero(np.asarray(slow["view_changes"]) > 0)
+    assert t_base >= 0, "default run must commit the removal"
+    assert slow_vc.size > 0, "scaled run must still commit eventually"
+    assert int(slow_vc[0]) > t_base, "3x watermark must delay the commit"
+
+
+# -- 4. ensemble twin ---------------------------------------------------------
+
+
+def test_ensemble_parity_and_zero_recompile():
+    rp = rapid_chaos_params(N)
+    ticks = 60
+    seeds = (0, 1, 2)
+    plans = stack_universes([sample_schedule(s, N) for s in seeds])
+    states = init_ensemble_rapid(rp, [0] * len(seeds))
+    _, etraces = run_ensemble_rapid_ticks(rp, states, plans, ticks)
+
+    # Universe 0 is bit-equal to the solo run of the same schedule.
+    _, solo = run_rapid_ticks(
+        rp, init_rapid_full_view(rp), sample_schedule(seeds[0], N), ticks
+    )
+    host_e = jax.device_get(etraces)
+    u0 = {k: np.asarray(v)[0] for k, v in host_e.items()}
+    _assert_traces_equal(solo, u0, "rapid ensemble universe 0")
+
+    # Every universe passes the batched R1-R4 certifier.
+    verdict = certify_rapid_population(rp, host_e)
+    assert bool(np.all(verdict["ok"])), verdict["violations"]
+
+    # A second same-shape batch reuses the compiled executable.
+    compiled = jit_cache_size(run_ensemble_rapid_ticks)
+    plans2 = stack_universes([sample_schedule(s, N) for s in (3, 4, 5)])
+    run_ensemble_rapid_ticks(rp, states, plans2, ticks)
+    assert jit_cache_size(run_ensemble_rapid_ticks) == compiled, (
+        "same-shape schedule batch must not recompile the ensemble"
+    )
+
+
+# -- 5. negatives: the R1-R4 certifier bites ----------------------------------
+
+
+def _doctored_traces(n=8, ticks=40):
+    """A synthetic clean Rapid trajectory: one configuration (digest 123)
+    at view id 0, everyone alive, no probes missed, no view changes."""
+    return {
+        "view_id": np.zeros((ticks, n), np.int32),
+        "view_digest": np.full((ticks, n), 123, np.int32),
+        "view_size": np.full((ticks, n), n, np.int32),
+        "alive_mask": np.ones((ticks, n), bool),
+        "view_changes": np.zeros(ticks, np.int32),
+        "alarms_raised": np.zeros(ticks, np.int32),
+        "cut_detected": np.zeros(ticks, np.int32),
+        "pings": np.zeros(ticks, np.int32),
+        "acks": np.zeros(ticks, np.int32),
+    }
+
+
+def _tamper_r1(tr):
+    # One live deviant digest at the shared view id: disagreement, but the
+    # deviant's singleton group claims no majority — plain R1.
+    tr["view_digest"][20, 3] = 456
+
+
+def _tamper_r2(tr):
+    # A one-tick view-id excursion: the drop back at t=11 while alive both
+    # ticks is a monotonicity breach.
+    tr["view_id"][10, 4] = 1
+
+
+def _tamper_r3(tr):
+    # Two digest camps at the same view id, each a majority of the view
+    # size it claims: textbook split-brain.
+    n = tr["view_digest"].shape[1]
+    tr["view_digest"][15, n // 2:] = 456
+    tr["view_size"][15, :] = n // 2
+
+
+def _tamper_r4(tr):
+    # A view change with zero missed-probe ticks behind it: faster than
+    # any alarm could cross the L-watermark.
+    tr["view_changes"][5] = 1
+
+
+@pytest.mark.parametrize(
+    "tamper,expected",
+    [
+        (_tamper_r1, "R1-agreement"),
+        (_tamper_r2, "R2-monotone"),
+        (_tamper_r3, "R3-split-brain"),
+        (_tamper_r4, "R4-stability"),
+    ],
+    ids=["R1", "R2", "R3", "R4"],
+)
+def test_certifier_catches_tampered_traces(tamper, expected):
+    rp = rapid_chaos_params(8)
+    tr = _doctored_traces()
+    # The untampered fixture is clean — each tamper is the sole cause.
+    certify_rapid_traces(rp, tr)
+    tamper(tr)
+    with pytest.raises(InvariantViolation) as e:
+        certify_rapid_traces(rp, tr)
+    assert e.value.invariant == expected
+
+
+def test_digest_is_membership_sensitive():
+    """view_digest separates every single-member flip from the full view —
+    the nonlinear per-subject weights make subset sums collide-resistant
+    (a plain popcount digest would alias any same-size views)."""
+    n = 32
+    full = jnp.ones((n, n), bool)
+    base = np.asarray(view_digest(full))
+    for j in range(n):
+        flipped = full.at[:, j].set(False)
+        assert np.asarray(view_digest(flipped))[0] != base[0]
+
+
+def test_observer_matrix_is_a_k_ring():
+    obs = np.asarray(observer_matrix(8, 3))
+    assert obs.shape == (8, 3)
+    # Subject s is watched by the k successors on the ring — never itself.
+    for s in range(8):
+        assert list(obs[s]) == [(s + 1 + j) % 8 for j in range(3)]
