@@ -11,10 +11,43 @@ golden-file test in tests/test_obs.py pins the wire format — bump
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 
 SCHEMA_VERSION = 1
+
+#: Committed tpulint census golden (repo-anchored from this module's path —
+#: export must work from any CWD and must never import jax or tools.lint).
+_CENSUS_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
+    "artifacts",
+    "jax_census.json",
+)
+_CENSUS_STAMP_CACHE: dict | None = None
+
+
+def _census_stamp() -> dict:
+    """``{"lint_schema", "census_digest"}`` from the committed census golden.
+
+    Ties every exported measurement row to the exact executable surface
+    tpulint tier 2 verified (artifacts/jax_census.json): a bench row whose
+    digest differs from HEAD's census was measured on drifted code. Empty
+    when the golden is absent (fresh checkout before the first
+    ``--census-update``) — rows simply omit the stamp.
+    """
+    global _CENSUS_STAMP_CACHE
+    if _CENSUS_STAMP_CACHE is None:
+        try:
+            with open(_CENSUS_PATH) as fh:
+                data = json.load(fh)
+            _CENSUS_STAMP_CACHE = {
+                "lint_schema": int(data["census_schema"]),
+                "census_digest": str(data["digest"])[:12],
+            }
+        except Exception:
+            _CENSUS_STAMP_CACHE = {}
+    return dict(_CENSUS_STAMP_CACHE)
 
 # Row keys reserved by the exporter itself; payloads may not override them.
 _RESERVED = ("schema", "kind")
@@ -31,7 +64,9 @@ def run_metadata(
 
     ``platform`` is only auto-detected when jax is *already imported* — the
     bench driver process must never initialize a backend (its children own
-    the accelerator), so detection here is passive.
+    the accelerator), so detection here is passive. ``lint_schema`` and
+    ``census_digest`` are stamped from the committed tpulint census golden
+    when present (see :func:`_census_stamp`).
     """
     if commit is None:
         try:
@@ -52,7 +87,7 @@ def run_metadata(
                 platform = "unknown"
         else:
             platform = "unknown"
-    meta: dict = {"commit": commit, "platform": platform}
+    meta: dict = {"commit": commit, "platform": platform, **_census_stamp()}
     if n is not None:
         meta["n"] = int(n)
     if slot_budget is not None:

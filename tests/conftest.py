@@ -45,6 +45,31 @@ import inspect  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session")
+def semantic_result():
+    """One shared tpulint tier-2 run (traces all shipped entries, ~30 s).
+
+    Both the census gate in test_tpulint.py and the positive pins in
+    test_tpulint_semantic.py consume this single trace, so the suite pays
+    the tracing cost once. Skips (never errors) when jax is unavailable —
+    tools/lint itself must stay importable without it.
+    """
+    from pathlib import Path
+
+    from tools.lint.semantic import jax_unavailable_reason, run_semantic
+
+    reason = jax_unavailable_reason()
+    if reason is not None:  # pragma: no cover - env-dependent
+        pytest.skip(f"semantic tier unavailable: {reason}")
+    assert jax.default_backend() == "cpu", (
+        "semantic tracing must stay on CPU (conftest pins jax_platforms)"
+    )
+    repo = Path(__file__).resolve().parent.parent
+    return run_semantic(
+        root=repo, census_path=repo / "artifacts" / "jax_census.json"
+    )
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _free_compiled_executables_between_modules():
     """Release each module's jitted executables at module teardown.

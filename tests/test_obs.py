@@ -112,6 +112,9 @@ def test_make_row_reserved_keys_and_precedence():
 
 def test_run_metadata_explicit_fields():
     meta = run_metadata(n=32, slot_budget=64, seed=3, platform="cpu", commit="abc1234")
+    # The census stamp is auto-detected from the committed tpulint golden;
+    # split it off so the explicit fields can be compared exactly.
+    stamp = {k: meta.pop(k) for k in ("lint_schema", "census_digest") if k in meta}
     assert meta == {
         "commit": "abc1234",
         "platform": "cpu",
@@ -120,7 +123,28 @@ def test_run_metadata_explicit_fields():
         "seed": 3,
     }
     # Optional fields stay absent when not given.
-    assert set(run_metadata(platform="cpu", commit="x")) == {"commit", "platform"}
+    assert set(run_metadata(platform="cpu", commit="x")) - set(stamp) == {
+        "commit",
+        "platform",
+    }
+
+
+def test_run_metadata_census_stamp_matches_golden():
+    """Rows are tied to the executable surface tier-2 verified: the stamp
+    must mirror artifacts/jax_census.json exactly (when committed)."""
+    census_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts",
+        "jax_census.json",
+    )
+    meta = run_metadata(platform="cpu", commit="x")
+    if not os.path.exists(census_path):
+        assert "census_digest" not in meta and "lint_schema" not in meta
+        return
+    with open(census_path) as fh:
+        golden = json.load(fh)
+    assert meta["lint_schema"] == golden["census_schema"]
+    assert meta["census_digest"] == golden["digest"][:12]
 
 
 def test_prometheus_text(tmp_path):

@@ -46,6 +46,42 @@ def test_repo_lints_clean():
     assert result.gated == [], "\n".join(f.render() for f in result.gated)
 
 
+def test_semantic_tier_gates_and_census_matches(semantic_result):
+    """Tier 2 (R6-R9, K1, R10) over the real traced entries: zero gated
+    findings AND zero drift against the committed artifacts/jax_census.json.
+    Uses the shared session trace from conftest (one ~30 s run per suite);
+    skips with a reason when jax is absent."""
+    assert semantic_result.skipped is None
+    assert semantic_result.gated == [], "\n".join(
+        f.render() for f in semantic_result.gated
+    )
+    assert semantic_result.diff == [], "census drifted:\n" + "\n".join(
+        semantic_result.diff
+    )
+    assert semantic_result.census is not None
+
+
+def test_lint_importable_without_jax():
+    """tools.lint (both tiers' frontends) must import in a jax-less
+    interpreter — the obs/ lazy-import discipline. Checked by inspecting
+    module-level imports rather than a subprocess (jax is already loaded
+    in the test process)."""
+    import ast
+
+    for mod in ("tools/lint/semantic/__init__.py", "tools/lint/kernelcheck.py"):
+        tree = ast.parse((REPO / mod).read_text())
+        top_level = {
+            n.names[0].name.split(".")[0]
+            for n in tree.body
+            if isinstance(n, (ast.Import,))
+        } | {
+            (n.module or "").split(".")[0]
+            for n in tree.body
+            if isinstance(n, ast.ImportFrom)
+        }
+        assert "jax" not in top_level, f"{mod} imports jax at module scope"
+
+
 # ------------------------------------------------------- per-rule detectors
 
 
@@ -103,8 +139,13 @@ def test_cli_exit_codes(tmp_path):
     clean = str(FIXTURES / "r1_neg.py")
     dirty = str(FIXTURES / "r1_pos.py")
     json_out = str(tmp_path / "report.json")
-    assert lint_main([clean, "--no-json", "--baseline", "none"]) == 0
-    assert lint_main([dirty, "--json", json_out, "--baseline", "none"]) == 1
+    # --no-semantic: exit-code plumbing is tier-1's to test; the semantic
+    # tier has its own gate test above and re-tracing here would double
+    # the suite's tracing bill.
+    assert lint_main([clean, "--no-json", "--baseline", "none",
+                      "--no-semantic"]) == 0
+    assert lint_main([dirty, "--json", json_out, "--baseline", "none",
+                      "--no-semantic"]) == 1
     assert Path(json_out).exists()
 
 

@@ -1,0 +1,352 @@
+"""tpulint tier-2 tests: jaxpr rules R6-R9, the Pallas kernel audit (K1),
+and the executable census (R10).
+
+Mirrors the tier-1 contract in tests/test_tpulint.py:
+  1. every semantic detector is demonstrated by a fixture that trips exactly
+     it (each rule carries its weight),
+  2. the sanctioned library idioms (clamp-into-range, -1-sentinel drops,
+     donated-but-dead scalars) stay silent — soundness, not vibes,
+  3. the shipped entries + kernels pin clean against the committed census
+     (the shared session trace from conftest, run once per suite).
+
+Everything traces tiny abstract shapes on CPU; no kernel executes.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import pytest
+
+from tools.lint.semantic import jax_unavailable_reason
+
+if jax_unavailable_reason() is not None:  # pragma: no cover - env-dependent
+    pytest.skip(
+        f"semantic tier needs jax: {jax_unavailable_reason()}",
+        allow_module_level=True,
+    )
+
+import jax
+import jax.numpy as jnp
+from jax import lax, tree_util
+
+from tools.lint import kernelcheck
+from tools.lint.semantic import census as census_mod
+from tools.lint.semantic import rules as rules_mod
+from tools.lint.semantic.entries import TracedEntry
+from tools.lint.semantic.interval import find_oob
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _entry(fn, *args, donate_argnums=(), state_argnum=None, **kwargs):
+    """Wrap a tiny fixture function the way entries.build_entries would."""
+    jitted = jax.jit(fn, donate_argnums=donate_argnums)
+    traced = jitted.trace(*args, **kwargs)
+    return TracedEntry(
+        name=f"fixture.{fn.__name__}",
+        path="tests/test_tpulint_semantic.py",
+        line=1,
+        fn=fn,
+        args=args,
+        kwargs=kwargs,
+        closed=traced.jaxpr,
+        out_info=traced.out_info,
+        traced=traced,
+        donate_argnums=donate_argnums,
+        state_argnum=state_argnum,
+        state_out=(lambda out: out) if state_argnum is not None else None,
+    )
+
+
+# ---------------------------------------------------------------------- R6
+
+
+def test_r6_weak_typed_scan_carry_flags():
+    def leaky(x):
+        c, _ = lax.scan(lambda c, _: (c + 1.0, None), 0.0, None, length=4)
+        return x + c
+
+    findings = rules_mod.check_r6(_entry(leaky, jnp.zeros((), jnp.float32)),
+                                  tree_util)
+    assert any("weak-typed" in f.message for f in findings), findings
+
+
+def test_r6_explicit_dtype_carry_clean():
+    def stable(x):
+        c, _ = lax.scan(
+            lambda c, _: (c + jnp.int32(1), None),
+            jnp.zeros((), jnp.int32),
+            None,
+            length=4,
+        )
+        return x + c
+
+    assert rules_mod.check_r6(
+        _entry(stable, jnp.zeros((), jnp.int32)), tree_util
+    ) == []
+
+
+def test_r6_state_treedef_roundtrip_flags():
+    def drops_field(state):
+        return {"a": state["a"] + 1}  # silently loses the "b" leaf
+
+    entry = _entry(
+        drops_field,
+        {"a": jnp.zeros(4, jnp.int32), "b": jnp.zeros(4, jnp.int32)},
+        state_argnum=0,
+    )
+    findings = rules_mod.check_r6(entry, tree_util)
+    assert any("treedef" in f.message for f in findings), findings
+
+
+# ---------------------------------------------------------------------- R7
+
+
+def test_r7_exact_oob_iota_gather_flags():
+    """iota+2 gathered with mode='clip' provably clamps: the classic silent
+    wrong answer on TPU."""
+
+    def bad(x):
+        return jnp.take(x, lax.iota(jnp.int32, 8) + 2, mode="clip")
+
+    oob = find_oob(jax.jit(bad).trace(jnp.zeros(8, jnp.float32)).jaxpr)
+    assert len(oob) == 1 and "provably reaches index 9" in oob[0].message
+
+
+def test_r7_fully_oob_dynamic_slice_flags():
+    def bad(x):
+        return lax.dynamic_slice(x, (jnp.int32(9),), (2,))
+
+    oob = find_oob(jax.jit(bad).trace(jnp.zeros(8, jnp.float32)).jaxpr)
+    assert len(oob) == 1 and "entirely outside" in oob[0].message
+
+
+def test_r7_fully_oob_scatter_flags():
+    def bad(x):
+        return x.at[jnp.array([100, 101])].set(1.0, mode="drop")
+
+    oob = find_oob(jax.jit(bad).trace(jnp.zeros(8, jnp.float32)).jaxpr)
+    assert len(oob) == 1 and "every update is silently dropped" in oob[0].message
+
+
+def test_r7_sanctioned_idioms_stay_silent():
+    """The library's clamp / sentinel patterns must not flag (soundness:
+    an over-approximated interval poking out of range proves nothing)."""
+
+    def fine(x, i, s):
+        a = x[jnp.clip(i, 0, 7)]  # explicit clamp
+        b = x[jnp.where(s >= 0, s, 0)]  # -1-sentinel guard
+        c = x.at[jnp.where(s >= 0, s, -1)].set(0.0, mode="drop")  # drop
+        return a + b + c.sum()
+
+    oob = find_oob(
+        jax.jit(fine)
+        .trace(jnp.zeros(8, jnp.float32), jnp.int32(0), jnp.int32(-1))
+        .jaxpr
+    )
+    assert oob == []
+
+
+# ---------------------------------------------------------------------- R8
+
+
+def test_r8_callback_in_scan_flags():
+    def chatty(x):
+        def body(c, _):
+            jax.debug.print("tick {}", c)
+            return c + 1, None
+
+        c, _ = lax.scan(body, x, None, length=3)
+        return c
+
+    findings = rules_mod.check_r8(_entry(chatty, jnp.zeros((), jnp.int32)))
+    assert any("inside a lax.scan body" in f.message for f in findings)
+
+
+def test_r8_callback_outside_loop_clean():
+    def fine(x):
+        jax.debug.print("once {}", x)
+        return x + 1
+
+    assert rules_mod.check_r8(_entry(fine, jnp.zeros((), jnp.int32))) == []
+
+
+# ---------------------------------------------------------------------- R9
+
+
+def test_r9_dropped_donation_flags():
+    """A donated buffer returned under a different dtype cannot alias —
+    the donation silently becomes a copy."""
+
+    def widens(x):
+        return x.astype(jnp.float32)
+
+    findings, aliases = rules_mod.check_r9(
+        _entry(widens, jnp.zeros((128,), jnp.bfloat16), donate_argnums=(0,)),
+        tree_util,
+    )
+    assert aliases == []
+    assert len(findings) == 1 and "silently copied" in findings[0].message
+
+
+def test_r9_roundtrip_donation_clean():
+    def updates(x):
+        return x + 1
+
+    findings, aliases = rules_mod.check_r9(
+        _entry(updates, jnp.zeros((128,), jnp.float32), donate_argnums=(0,)),
+        tree_util,
+    )
+    assert findings == [] and aliases == [0]
+
+
+def test_r9_dead_donated_scalar_discounted():
+    """The writeback_free pattern: a donated scalar overwritten with a
+    constant is dead-arg-eliminated by XLA — no buffer, no copy, no R9."""
+
+    def frees(state):
+        return {"a": state["a"] + 1, "valid": jnp.zeros((), bool)}
+
+    findings, aliases = rules_mod.check_r9(
+        _entry(
+            frees,
+            {"a": jnp.zeros(8, jnp.int32), "valid": jnp.ones((), bool)},
+            donate_argnums=(0,),
+        ),
+        tree_util,
+    )
+    assert findings == [], findings
+    assert len(aliases) == 1  # "a" still aliases
+
+
+# ------------------------------------------------------------------ K1 audit
+
+
+def _capture(fn, *arrays):
+    captured: list = []
+    with kernelcheck.capture_pallas_calls(captured):
+        fn(*arrays)
+    assert captured, "probe did not reach pallas_call"
+    report = kernelcheck.AuditReport()
+    for call in captured:
+        kernelcheck.audit_call(call, path="fixture", line=1, report=report)
+    return report
+
+
+def _tiny_kernel(x_ref, o_ref):  # pragma: no cover - never executes
+    o_ref[...] = x_ref[...]
+
+
+def _pallas_fixture(index_map_out, block_out=(8, 128), grid=(4,)):
+    from jax.experimental import pallas as pl
+
+    def run(x):
+        return pl.pallas_call(
+            _tiny_kernel,
+            grid=grid,
+            in_specs=[pl.BlockSpec((8, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec(block_out, index_map_out),
+            out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+        )(x)
+
+    return run
+
+
+def test_k1_oob_index_map_flags():
+    report = _capture(
+        _pallas_fixture(lambda i: (i + 1, 0)), jnp.zeros((32, 128), jnp.float32)
+    )
+    assert any("index map out of bounds" in f.message for f in report.findings)
+
+
+def test_k1_coverage_gap_flags():
+    report = _capture(
+        _pallas_fixture(lambda i: (0, 0)), jnp.zeros((32, 128), jnp.float32)
+    )
+    assert any("does not cover the output" in f.message for f in report.findings)
+
+
+def test_k1_revisited_tile_flags():
+    # 0,1,0,1: tile 0 revisited after the grid moved away — a clobber.
+    report = _capture(
+        _pallas_fixture(lambda i: (i % 2, 0)), jnp.zeros((32, 128), jnp.float32)
+    )
+    assert any("revisited" in f.message for f in report.findings)
+
+
+def test_k1_bad_layout_flags():
+    from jax.experimental import pallas as pl
+
+    def run(x):
+        return pl.pallas_call(
+            _tiny_kernel,
+            grid=(4,),
+            in_specs=[pl.BlockSpec((7, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((8, 128), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((32, 128), jnp.float32),
+        )(x)
+
+    report = _capture(run, jnp.zeros((28, 128), jnp.float32))
+    assert any("sublane tile" in f.message for f in report.findings)
+
+
+def test_k1_clean_spec_silent():
+    report = _capture(
+        _pallas_fixture(lambda i: (i, 0)), jnp.zeros((32, 128), jnp.float32)
+    )
+    assert report.findings == []
+    assert report.specs_checked == 2
+
+
+# ------------------------------------------------------------------- census
+
+
+def test_census_drift_detected(tmp_path):
+    old = census_mod.build_census(
+        {"e": {"jaxpr_digest": "aaa", "n_eqns": 3,
+               "primitives": {"add": 2, "mul": 1}, "carry_treedef": "",
+               "donated_leaves": 0, "alias_outputs": [], "path": "x.py"}},
+        jax.__version__,
+    )
+    new = census_mod.build_census(
+        {"e": {"jaxpr_digest": "bbb", "n_eqns": 4,
+               "primitives": {"add": 2, "mul": 1, "gather": 1},
+               "carry_treedef": "", "donated_leaves": 0,
+               "alias_outputs": [], "path": "x.py"}},
+        jax.__version__,
+    )
+    findings, diff = census_mod.compare(old, new, tmp_path / "census.json")
+    assert [f.rule for f in findings] == ["R10"]
+    assert any("gather: 0 -> 1" in line for line in diff)
+
+
+def test_census_missing_golden_flags(tmp_path):
+    new = census_mod.build_census({}, jax.__version__)
+    findings, _ = census_mod.compare(
+        census_mod.load_census(tmp_path / "absent.json"), new,
+        tmp_path / "absent.json",
+    )
+    assert [f.rule for f in findings] == ["R10"]
+    assert "unpinned" in findings[0].message
+
+
+# ------------------------------------- the shipped surface (shared trace)
+
+
+def test_shipped_entries_semantically_clean(semantic_result):
+    """Positive pin: the library's real entry points carry zero semantic
+    findings and match the committed census byte-for-byte."""
+    assert semantic_result.skipped is None
+    assert semantic_result.entries_traced >= 10
+    assert semantic_result.gated == [], "\n".join(
+        f.render() for f in semantic_result.gated
+    )
+    assert semantic_result.diff == [], "\n".join(semantic_result.diff)
+
+
+def test_shipped_kernels_audited(semantic_result):
+    kr = semantic_result.kernel_report
+    assert kr is not None and kr.calls_audited == 3
+    assert kr.specs_checked >= 20
+    assert [f for f in kr.findings] == []

@@ -56,6 +56,23 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("--list-rules", action="store_true")
     ap.add_argument("-q", "--quiet", action="store_true", help="hide baselined findings")
+    ap.add_argument(
+        "--no-semantic",
+        action="store_true",
+        help="skip tier 2 (jaxpr rules R6-R9, kernel audit K1, census R10)",
+    )
+    ap.add_argument(
+        "--census",
+        default="artifacts/jax_census.json",
+        metavar="PATH",
+        help="executable census golden (default: artifacts/jax_census.json)",
+    )
+    ap.add_argument(
+        "--census-update",
+        action="store_true",
+        help="re-pin the census golden from this run's traces "
+        "(mirrors --write-baseline; drift findings are skipped)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -65,17 +82,38 @@ def main(argv: list[str] | None = None) -> int:
 
     try:
         baseline = None if args.baseline == "none" else Path(args.baseline)
+        disable = tuple(r for r in args.disable.split(",") if r)
+        select = tuple(r for r in args.select.split(",") if r) or None
         result = run_lint(
             args.paths,
-            disable=tuple(r for r in args.disable.split(",") if r),
-            select=tuple(r for r in args.select.split(",") if r) or None,
+            disable=disable,
+            select=select,
             baseline=baseline,
         )
         if args.write_baseline and baseline is not None:
             write_baseline(result, baseline)
+
+        semantic = None
+        if not args.no_semantic:
+            from tools.lint.semantic import run_semantic
+
+            semantic = run_semantic(
+                census_path=args.census,
+                update=args.census_update,
+                disable=disable,
+                select=select,
+            )
+            if args.census_update and semantic.census is not None:
+                from tools.lint.semantic.census import write_census
+
+                write_census(semantic.census, Path(args.census))
+                print(f"census re-pinned: {args.census}")
+            result.findings.extend(semantic.findings)
+            result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
         if not args.no_json:
-            write_json(result, Path(args.json))
-        print(render_text(result, quiet=args.quiet))
+            write_json(result, Path(args.json), semantic=semantic)
+        print(render_text(result, quiet=args.quiet, semantic=semantic))
         return 1 if result.gated else 0
     except Exception:
         traceback.print_exc()

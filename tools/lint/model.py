@@ -25,6 +25,24 @@ RULES = {
     "jit position; donated buffer read after donation)",
     "R5": "dtype contract drift: a pytree-dataclass field rebuilt with a "
     "dtype that disagrees with its canonical constructor",
+    # -- semantic tier (tools/lint/semantic/): rules over the traced jaxprs
+    #    of the shipped jit entry points, not over Python source.
+    "R6": "scan-carry instability: weak-typed or 64-bit carry avals, "
+    "carry aval drift across the scan body, or an entry returning a state "
+    "whose treedef/leaf avals differ from the state it was given",
+    "R7": "provably out-of-bounds index: interval analysis shows a "
+    "gather/dynamic_slice/scatter operand can index outside the operand "
+    "(TPU clamps silently — OOB is a wrong answer, not a crash)",
+    "R8": "host effect inside a traced loop: pure_callback/io_callback/"
+    "debug_callback primitive in a lax.scan/cond/while body",
+    "R9": "donation broken: a buffer the entry declares donated never "
+    "appears in the lowered computation's input-output alias map",
+    "R10": "executable census drift: the traced jaxpr of a shipped entry "
+    "point differs from the committed artifacts/jax_census.json golden "
+    "(regenerate deliberately with --census-update)",
+    "K1": "Pallas BlockSpec hazard: index map out of bounds, output tiles "
+    "clobbered across grid steps, grid*block not covering the operand, or "
+    "tile dims off the per-dtype (sublane,128) layout",
 }
 
 #: Path segments that put a file in advisory scope: findings are reported

@@ -48,7 +48,7 @@ def write_baseline(result: LintResult, baseline_path: Path) -> None:
     )
 
 
-def write_json(result: LintResult, path: Path) -> None:
+def write_json(result: LintResult, path: Path, semantic=None) -> None:
     path.parent.mkdir(parents=True, exist_ok=True)
     payload = {
         "files_checked": result.files_checked,
@@ -56,10 +56,25 @@ def write_json(result: LintResult, path: Path) -> None:
         "advisory_count": len(result.advisory),
         "findings": [f.to_json() for f in result.findings],
     }
+    if semantic is not None:
+        payload["semantic"] = {
+            "skipped": semantic.skipped,
+            "entries_traced": semantic.entries_traced,
+            "census_digest": (
+                semantic.census["digest"] if semantic.census else None
+            ),
+            "census_diff": semantic.diff,
+        }
     path.write_text(json.dumps(payload, indent=2) + "\n")
 
 
-def render_text(result: LintResult, quiet: bool = False) -> str:
+def render_text(
+    result: LintResult,
+    quiet: bool = False,
+    semantic=None,
+) -> str:
+    """Console report. ``semantic`` is the tier-2 SemanticResult (or None
+    when the semantic tier was not requested)."""
     lines: list[str] = []
     gated = result.gated
     advisory = result.advisory
@@ -70,11 +85,31 @@ def render_text(result: LintResult, quiet: bool = False) -> str:
         lines.append(f.render())
     if lines:
         lines.append("")
+    if semantic is not None and semantic.diff:
+        lines.append("census drift (committed golden vs this trace):")
+        lines.extend(semantic.diff)
+        lines.append("")
     lines.append(
         f"tpulint: {result.files_checked} files, "
         f"{len(gated)} gated finding(s), "
         f"{len(advisory)} advisory ({len(new_advisory)} new since baseline)"
     )
+    if semantic is not None:
+        if semantic.skipped:
+            lines.append(f"semantic: {semantic.skipped}")
+        else:
+            kr = semantic.kernel_report
+            kernel = (
+                f"{kr.calls_audited} kernel call(s), "
+                f"{kr.specs_checked} BlockSpec(s), "
+                f"{kr.any_space_windows} manual-DMA window(s) unchecked"
+                if kr is not None
+                else "kernel audit not run"
+            )
+            lines.append(
+                f"semantic: {semantic.entries_traced} entries traced, "
+                f"census digest {semantic.census['digest'][:12]}…, {kernel}"
+            )
     if gated:
         lines.append("gate: FAIL (fix the finding or suppress with "
                      "'# tpulint: disable=R<n> -- justification')")

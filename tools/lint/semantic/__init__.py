@@ -1,0 +1,179 @@
+"""tpulint tier 2 — semantic verification over traced jaxprs.
+
+Tier 1 (tools/lint/rules.py) reads Python source; this tier reads what XLA
+will actually compile. It traces every registered entry point
+(tools/lint/semantic/entries.py) on CPU under ``JAX_PLATFORMS=cpu``, runs
+R6-R9 over the closed jaxprs and lowered modules, audits the shipped Pallas
+BlockSpecs (tools/lint/kernelcheck.py, K1), and pins the whole executable
+surface as a schema-versioned census (R10, artifacts/jax_census.json).
+
+This package is importable WITHOUT jax (the obs/ lazy-import discipline):
+jax is imported only inside :func:`run_semantic`, and its absence degrades
+to a skipped tier with a recorded reason, never an ImportError.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.lint.model import Finding, is_advisory_path
+from tools.lint.pragmas import parse_pragmas, suppressed_lines
+
+__all__ = ["run_semantic", "SemanticResult", "DEFAULT_CENSUS", "jax_unavailable_reason"]
+
+#: Committed census golden (repo-anchored, like tools/lint/baseline.json).
+DEFAULT_CENSUS = Path(__file__).resolve().parents[3] / "artifacts" / "jax_census.json"
+
+
+def jax_unavailable_reason() -> str | None:
+    """None when jax can be imported; otherwise a human-readable reason."""
+    import importlib.util
+
+    try:
+        if importlib.util.find_spec("jax") is None:
+            return "jax is not installed"
+    except (ImportError, ValueError):
+        return "jax is not importable"
+    return None
+
+
+def _import_jax():
+    if "jax" not in sys.modules:
+        # CPU guard: tracing must never grab a TPU. Env var is honoured at
+        # first import; when jax is already imported the embedding process
+        # (pytest conftest) owns the platform choice.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    return jax
+
+
+@dataclass
+class SemanticResult:
+    findings: list[Finding] = field(default_factory=list)
+    census: dict | None = None  # this run's rebuilt census
+    diff: list[str] = field(default_factory=list)  # drift vs the golden
+    skipped: str | None = None  # reason when the tier didn't run
+    entries_traced: int = 0
+    kernel_report: object = None  # kernelcheck.AuditReport
+
+    @property
+    def gated(self) -> list[Finding]:
+        return [f for f in self.findings if not f.advisory and not f.baselined]
+
+
+def _filter_findings(
+    findings: list[Finding],
+    root: Path,
+    disable: tuple[str, ...],
+    select: tuple[str, ...] | None,
+) -> list[Finding]:
+    pragma_cache: dict[str, dict[int, frozenset[str]]] = {}
+
+    def suppressed(f: Finding) -> bool:
+        if f.path not in pragma_cache:
+            full = root / f.path
+            try:
+                source = full.read_text()
+            except OSError:
+                pragma_cache[f.path] = {}
+            else:
+                pragmas, _ = parse_pragmas(source, f.path)
+                pragma_cache[f.path] = suppressed_lines(pragmas, source)
+        return f.rule in pragma_cache[f.path].get(f.line, frozenset())
+
+    kept = []
+    for f in findings:
+        if f.rule in disable:
+            continue
+        if select is not None and f.rule not in select:
+            continue
+        if suppressed(f):
+            continue
+        f.advisory = is_advisory_path(f.path)
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept
+
+
+def run_semantic(
+    *,
+    root: str | Path | None = None,
+    census_path: str | Path | None = None,
+    update: bool = False,
+    disable: tuple[str, ...] = (),
+    select: tuple[str, ...] | None = None,
+) -> SemanticResult:
+    """Run the semantic tier. Pure besides reading the census golden —
+    writing an updated census is the caller's move (mirrors run_lint vs
+    --write-baseline).
+
+    Args:
+      update: census-regeneration mode — skip drift findings (the caller is
+        about to re-pin the golden from :attr:`SemanticResult.census`).
+    """
+    root = Path(root or os.getcwd()).resolve()
+    census_path = Path(census_path or DEFAULT_CENSUS)
+    disable = tuple(r.upper() for r in disable)
+    select = tuple(r.upper() for r in select) if select is not None else None
+
+    reason = jax_unavailable_reason()
+    if reason is not None:
+        return SemanticResult(skipped=f"semantic tier skipped: {reason}")
+
+    jax = _import_jax()
+    from jax import tree_util
+
+    from tools.lint import kernelcheck
+    from tools.lint.semantic import census as census_mod
+    from tools.lint.semantic import entries as entries_mod
+    from tools.lint.semantic import rules as rules_mod
+
+    result = SemanticResult()
+    entries, failures = entries_mod.build_entries(str(root))
+    result.entries_traced = len(entries)
+    for spec, err in failures:
+        result.findings.append(
+            Finding(
+                rule="R10",
+                path="tools/lint/semantic/entries.py",
+                line=1,
+                message=f"[{spec.name}] entry failed to trace: "
+                f"{type(err).__name__}: {err}",
+                hint="the executable surface the docs promise doesn't "
+                "build; fix the library (or the entry's probe inputs)",
+            )
+        )
+
+    rows: dict[str, dict] = {}
+    for entry in entries:
+        result.findings.extend(rules_mod.check_r6(entry, tree_util))
+        result.findings.extend(rules_mod.check_r7(entry, str(root)))
+        result.findings.extend(rules_mod.check_r8(entry))
+        r9_findings, alias_outputs = rules_mod.check_r9(entry, tree_util)
+        result.findings.extend(r9_findings)
+        rows[entry.name] = census_mod.entry_row(
+            entry, tree_util, alias_outputs, str(root)
+        )
+
+    kernel_report = kernelcheck.audit_shipped(str(root))
+    result.kernel_report = kernel_report
+    result.findings.extend(kernel_report.findings)
+
+    result.census = census_mod.build_census(rows, jax.__version__)
+    if not update:
+        try:
+            display = census_path.relative_to(root)
+        except ValueError:
+            display = census_path
+        drift, diff = census_mod.compare(
+            census_mod.load_census(census_path), result.census, display
+        )
+        result.findings.extend(drift)
+        result.diff = diff
+
+    result.findings = _filter_findings(result.findings, root, disable, select)
+    return result

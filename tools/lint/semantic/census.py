@@ -1,0 +1,184 @@
+"""The executable census: what actually compiles, pinned as a golden.
+
+``artifacts/jax_census.json`` records, per registered entry point, a digest
+of the traced jaxpr, its recursive primitive histogram, the state pytree's
+treedef, and the donation alias map from the lowered module. The file is
+committed; tier-1 rebuilds the census and fails on ANY drift (R10) — so "the
+sparse tick gained a gather" or "donation silently stopped aliasing" becomes
+a reviewed diff, never a surprise on the TPU bill.
+
+Regeneration mirrors the advisory baseline flow::
+
+    python -m tools.lint --census-update
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from tools.lint.model import Finding
+from tools.lint.semantic import jaxprs
+from tools.lint.semantic.entries import TracedEntry
+
+#: Bump when the census wire format changes shape (also stamped into
+#: obs/export.py schema rows as ``lint_schema``).
+CENSUS_SCHEMA = 1
+
+
+def entry_row(
+    entry: TracedEntry, tree_util, alias_outputs: list[int], root: str
+) -> dict:
+    hist = jaxprs.primitive_histogram(entry.closed)
+    state_treedef = ""
+    if entry.state_argnum is not None:
+        state_treedef = str(
+            tree_util.tree_structure(entry.args[entry.state_argnum])
+        )
+    return {
+        "jaxpr_digest": jaxprs.jaxpr_digest(entry.closed, strip=(root,)),
+        "n_eqns": sum(hist.values()),
+        "primitives": hist,
+        "carry_treedef": state_treedef,
+        "donated_leaves": (
+            sum(
+                len(tree_util.tree_leaves(entry.args[a]))
+                for a in entry.donate_argnums
+            )
+            if entry.donate_argnums
+            else 0
+        ),
+        "alias_outputs": alias_outputs,
+        "path": entry.path,
+    }
+
+
+def build_census(rows: dict[str, dict], jax_version: str) -> dict:
+    digest = hashlib.sha256(
+        json.dumps(
+            {name: row["jaxpr_digest"] for name, row in sorted(rows.items())},
+            sort_keys=True,
+        ).encode()
+    ).hexdigest()
+    return {
+        "census_schema": CENSUS_SCHEMA,
+        "jax_version": jax_version,
+        "digest": digest,
+        "entries": dict(sorted(rows.items())),
+    }
+
+
+def load_census(path: Path) -> dict | None:
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def write_census(census: dict, path: Path) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(census, indent=2, sort_keys=True) + "\n")
+
+
+def _hist_diff(old: dict, new: dict) -> list[str]:
+    lines = []
+    for prim in sorted(set(old) | set(new)):
+        o, n = old.get(prim, 0), new.get(prim, 0)
+        if o != n:
+            lines.append(f"    {prim}: {o} -> {n}")
+    return lines
+
+
+def compare(
+    old: dict | None, new: dict, census_path: Path
+) -> tuple[list[Finding], list[str]]:
+    """Drift between the committed census and this run's rebuild.
+
+    Returns (R10 findings, human-readable diff lines for the text report).
+    """
+    hint = (
+        f"review the drift, then 'python -m tools.lint --census-update' to "
+        f"re-pin {census_path}"
+    )
+    if old is None:
+        f = Finding(
+            rule="R10",
+            path=str(census_path),
+            line=1,
+            message="census golden missing or unreadable — the executable "
+            "surface is unpinned",
+            hint=hint,
+        )
+        return [f], ["census golden missing: full rebuild required"]
+
+    findings: list[Finding] = []
+    diff: list[str] = []
+    if old.get("census_schema") != new["census_schema"]:
+        findings.append(
+            Finding(
+                rule="R10",
+                path=str(census_path),
+                line=1,
+                message=f"census schema changed: "
+                f"{old.get('census_schema')} -> {new['census_schema']}",
+                hint=hint,
+            )
+        )
+    if old.get("jax_version") != new["jax_version"]:
+        diff.append(
+            f"  jax version: {old.get('jax_version')} -> {new['jax_version']}"
+        )
+    old_entries = old.get("entries", {})
+    new_entries = new["entries"]
+    for name in sorted(set(old_entries) | set(new_entries)):
+        o, n = old_entries.get(name), new_entries.get(name)
+        if o is None:
+            findings.append(
+                Finding(
+                    rule="R10",
+                    path=n.get("path") or str(census_path),
+                    line=1,
+                    message=f"[{name}] entry is new since the committed census",
+                    hint=hint,
+                )
+            )
+            diff.append(f"  + {name} ({n['n_eqns']} eqns)")
+            continue
+        if n is None:
+            findings.append(
+                Finding(
+                    rule="R10",
+                    path=o.get("path") or str(census_path),
+                    line=1,
+                    message=f"[{name}] entry vanished from the census",
+                    hint=hint,
+                )
+            )
+            diff.append(f"  - {name} (was {o['n_eqns']} eqns)")
+            continue
+        if o.get("jaxpr_digest") == n["jaxpr_digest"] and o.get(
+            "alias_outputs"
+        ) == n["alias_outputs"]:
+            continue
+        findings.append(
+            Finding(
+                rule="R10",
+                path=n.get("path") or str(census_path),
+                line=1,
+                message=f"[{name}] traced executable drifted from the "
+                f"committed census ({o.get('n_eqns')} -> {n['n_eqns']} eqns)",
+                hint=hint,
+            )
+        )
+        diff.append(f"  ~ {name}: {o.get('n_eqns')} -> {n['n_eqns']} eqns")
+        diff.extend(_hist_diff(o.get("primitives", {}), n["primitives"]))
+        if o.get("alias_outputs") != n["alias_outputs"]:
+            diff.append(
+                f"    alias_outputs: {o.get('alias_outputs')} -> "
+                f"{n['alias_outputs']}"
+            )
+        if o.get("carry_treedef") != n["carry_treedef"]:
+            diff.append("    carry treedef changed")
+    return findings, diff

@@ -1,0 +1,376 @@
+"""Registry of the jit entry points the semantic tier traces.
+
+Each :class:`EntrySpec` builds tiny-but-legal inputs for one shipped
+executable family (the shapes only need to satisfy the engine's structural
+constraints — n % 32 == 0 and S % 128 == 0 for the sparse core, a
+128-multiple lane count for the dense Pallas paths — because every R6-R9
+property is shape-generic) and traces it with the AOT API
+(``jit_fn.trace(...)``), which resolves static argnums the same way the
+runtime call would. Tracing is CPU-only abstract evaluation: no kernel runs,
+no device memory moves.
+
+The registry is the census's table of contents: entry names are the keys of
+``artifacts/jax_census.json``, so adding/removing an entry here is itself a
+reviewed census diff.
+"""
+
+from __future__ import annotations
+
+import inspect
+import warnings
+from dataclasses import dataclass, field
+from typing import Callable
+
+#: Probe shapes. Small on purpose — tracing cost scales with graph size, not
+#: array size, but init-state construction is real host work.
+N = 64
+S = 128
+B = 2
+T = 4
+N_DENSE_PALLAS = 128  # dense Pallas delivery wants an m with a 128-divisor
+
+
+@dataclass
+class TracedEntry:
+    """One traced entry point plus everything the rule pack needs."""
+
+    name: str
+    path: str  # repo-relative source file of the jitted function
+    line: int
+    fn: Callable
+    args: tuple
+    kwargs: dict
+    closed: object  # ClosedJaxpr
+    out_info: object  # pytree of ShapeDtypeStruct
+    traced: object  # jax AOT Traced (lazy .lower() for R9)
+    donate_argnums: tuple[int, ...] = ()
+    state_argnum: int | None = None
+    state_out: Callable | None = None  # out_info -> the returned state pytree
+
+
+@dataclass(frozen=True)
+class EntrySpec:
+    name: str
+    build: Callable[[], tuple]  # () -> (fn, args, kwargs, meta-dict)
+    meta: dict = field(default_factory=dict)
+
+
+def _state_first(out):
+    return out[0]
+
+
+def _identity(out):
+    return out
+
+
+# --------------------------------------------------------------------- specs
+def _dense_inputs(n=N, schedule=False):
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.params import SimParams
+    from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
+    from scalecube_cluster_tpu.sim.state import init_full_view, seeds_mask
+
+    params = SimParams(n=n)
+    state = init_full_view(n, params.user_gossip_slots)
+    if schedule:
+        plan = (
+            ScheduleBuilder(n)
+            .add_segment(0, FaultPlan.uniform())
+            .add_segment(2, FaultPlan.uniform(loss_percent=10.0))
+            .kill(2, 1)
+            .restart(3, 1)
+            .build()
+        )
+    else:
+        plan = FaultPlan.uniform()
+    return params, state, plan, seeds_mask(n, [0])
+
+
+def _build_run_ticks(schedule=False):
+    from scalecube_cluster_tpu.sim.run import run_ticks
+
+    params, state, plan, seeds = _dense_inputs(schedule=schedule)
+    return (
+        run_ticks,
+        (params, state, plan, seeds, T),
+        {"collect": True},
+        {"state_argnum": 1, "state_out": _state_first},
+    )
+
+
+def _build_run_ticks_pallas():
+    import dataclasses
+
+    from scalecube_cluster_tpu.sim.run import run_ticks
+
+    params, state, plan, seeds = _dense_inputs(n=N_DENSE_PALLAS)
+    params = dataclasses.replace(params, pallas_delivery=True)
+    return (
+        run_ticks,
+        (params, state, plan, seeds, T),
+        {"collect": True},
+        {"state_argnum": 1, "state_out": _state_first},
+    )
+
+
+def _sparse_inputs(pallas_core, schedule=False):
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
+    from scalecube_cluster_tpu.sim.sparse import SparseParams, init_sparse_full_view
+
+    params = SparseParams.for_n(N, slot_budget=S, pallas_core=pallas_core)
+    state = init_sparse_full_view(
+        N, slot_budget=S, user_gossip_slots=params.base.user_gossip_slots
+    )
+    if schedule:
+        plan = (
+            ScheduleBuilder(N)
+            .add_segment(0, FaultPlan.uniform())
+            .add_segment(2, FaultPlan.uniform(loss_percent=10.0))
+            .kill(2, 1)
+            .restart(3, 1)
+            .build()
+        )
+    else:
+        plan = FaultPlan.uniform()
+    return params, state, plan
+
+
+def _build_run_sparse_ticks(pallas_core, schedule=False):
+    from scalecube_cluster_tpu.sim.sparse import run_sparse_ticks
+
+    params, state, plan = _sparse_inputs(pallas_core, schedule=schedule)
+    return (
+        run_sparse_ticks,
+        (params, state, plan, T),
+        {"collect": True},
+        {"donate_argnums": (1,), "state_argnum": 1, "state_out": _state_first},
+    )
+
+
+def _build_writeback_free():
+    from scalecube_cluster_tpu.sim.sparse import writeback_free
+
+    params, state, _ = _sparse_inputs(pallas_core=False)
+    return (
+        writeback_free,
+        (params, state),
+        {},
+        {"donate_argnums": (1,), "state_argnum": 1, "state_out": _identity},
+    )
+
+
+def _build_run_ensemble_ticks(knobbed=False):
+    from scalecube_cluster_tpu.sim.ensemble import (
+        init_ensemble_dense,
+        knob_grid,
+        run_ensemble_ticks,
+        stack_universes,
+    )
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.params import SimParams
+    from scalecube_cluster_tpu.sim.state import seeds_mask
+
+    params = SimParams(n=N)
+    if knobbed:
+        # The seed×config sweep grid (experiments/sweep.py): knobs are
+        # traced per-universe data, one executable for the whole lattice.
+        knobs = knob_grid(params, suspicion_mults=(1.0, 1.5), fanout_caps=(None, 2))
+        b = 4
+    else:
+        knobs = None
+        b = B
+    states = init_ensemble_dense(
+        N, list(range(b)), user_gossip_slots=params.user_gossip_slots
+    )
+    plans = stack_universes(FaultPlan.uniform() for _ in range(b))
+    return (
+        run_ensemble_ticks,
+        (params, states, plans, seeds_mask(N, [0]), T),
+        {"collect": True, "knobs": knobs},
+        {"state_argnum": 1, "state_out": _state_first},
+    )
+
+
+def _build_run_ensemble_sparse_ticks(chaos=False):
+    from scalecube_cluster_tpu.sim.ensemble import (
+        init_ensemble_sparse,
+        run_ensemble_sparse_ticks,
+        stack_universes,
+    )
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import SparseParams
+
+    if chaos:
+        # The chaos soak surface (testlib/chaos.py::chaos_ensemble): sampled
+        # fixed-shape schedules stacked into one plan pytree.
+        from scalecube_cluster_tpu.testlib.chaos import chaos_params, sample_schedule
+
+        base = chaos_params(N)
+        params = SparseParams(
+            base=base, slot_budget=max(64, 4 * N), alloc_cap=16
+        )
+        plans = stack_universes(sample_schedule(s, N) for s in range(B))
+    else:
+        base = None
+        params = SparseParams.for_n(N, slot_budget=S)
+        plans = stack_universes(FaultPlan.uniform() for _ in range(B))
+    states = init_ensemble_sparse(
+        N,
+        [0] * B,
+        slot_budget=params.slot_budget,
+        user_gossip_slots=params.base.user_gossip_slots,
+    )
+    return (
+        run_ensemble_sparse_ticks,
+        (params, states, plans, T),
+        {"collect": True},
+        {"donate_argnums": (1,), "state_argnum": 1, "state_out": _state_first},
+    )
+
+
+def _build_ensemble_writeback_free():
+    from scalecube_cluster_tpu.sim.ensemble import (
+        ensemble_writeback_free,
+        init_ensemble_sparse,
+    )
+    from scalecube_cluster_tpu.sim.sparse import SparseParams
+
+    params = SparseParams.for_n(N, slot_budget=S)
+    states = init_ensemble_sparse(
+        N, [0] * B, slot_budget=S,
+        user_gossip_slots=params.base.user_gossip_slots,
+    )
+    return (
+        ensemble_writeback_free,
+        (params, states),
+        {},
+        {"donate_argnums": (1,), "state_argnum": 1, "state_out": _identity},
+    )
+
+
+def _build_run_rapid_ticks():
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.rapid import (
+        RapidParams,
+        init_rapid_full_view,
+        run_rapid_ticks,
+    )
+
+    params = RapidParams(n=N)
+    state = init_rapid_full_view(params)
+    return (
+        run_rapid_ticks,
+        (params, state, FaultPlan.uniform(), T),
+        {"collect": True},
+        {"state_argnum": 1, "state_out": _state_first},
+    )
+
+
+def _build_run_ensemble_rapid_ticks():
+    from scalecube_cluster_tpu.sim.ensemble import stack_universes
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.rapid import (
+        RapidParams,
+        init_ensemble_rapid,
+        run_ensemble_rapid_ticks,
+    )
+
+    params = RapidParams(n=N)
+    states = init_ensemble_rapid(params, list(range(B)))
+    plans = stack_universes(FaultPlan.uniform() for _ in range(B))
+    return (
+        run_ensemble_rapid_ticks,
+        (params, states, plans, T),
+        {"collect": True},
+        {"state_argnum": 1, "state_out": _state_first},
+    )
+
+
+ENTRY_SPECS: tuple[EntrySpec, ...] = (
+    EntrySpec("sim.run.run_ticks[plan]", lambda: _build_run_ticks(False)),
+    EntrySpec("sim.run.run_ticks[schedule]", lambda: _build_run_ticks(True)),
+    EntrySpec("sim.run.run_ticks[pallas_delivery]", _build_run_ticks_pallas),
+    EntrySpec(
+        "sim.sparse.run_sparse_ticks[xla]",
+        lambda: _build_run_sparse_ticks(False),
+    ),
+    EntrySpec(
+        "sim.sparse.run_sparse_ticks[pallas]",
+        lambda: _build_run_sparse_ticks(True),
+    ),
+    EntrySpec(
+        "sim.sparse.run_sparse_ticks[schedule]",
+        lambda: _build_run_sparse_ticks(True, schedule=True),
+    ),
+    EntrySpec("sim.sparse.writeback_free", _build_writeback_free),
+    EntrySpec(
+        "sim.ensemble.run_ensemble_ticks",
+        lambda: _build_run_ensemble_ticks(False),
+    ),
+    EntrySpec(
+        "sim.ensemble.run_ensemble_ticks[sweep_grid]",
+        lambda: _build_run_ensemble_ticks(True),
+    ),
+    EntrySpec(
+        "sim.ensemble.run_ensemble_sparse_ticks",
+        lambda: _build_run_ensemble_sparse_ticks(False),
+    ),
+    EntrySpec(
+        "sim.ensemble.run_ensemble_sparse_ticks[chaos]",
+        lambda: _build_run_ensemble_sparse_ticks(True),
+    ),
+    EntrySpec("sim.ensemble.ensemble_writeback_free", _build_ensemble_writeback_free),
+    EntrySpec("sim.rapid.run_rapid_ticks", _build_run_rapid_ticks),
+    EntrySpec("sim.rapid.run_ensemble_rapid_ticks", _build_run_ensemble_rapid_ticks),
+)
+
+
+def _fn_location(fn, root: str) -> tuple[str, int]:
+    target = inspect.unwrap(fn)
+    target = getattr(target, "__wrapped__", target)
+    try:
+        path = inspect.getsourcefile(target) or ""
+        line = target.__code__.co_firstlineno
+    except (TypeError, AttributeError):
+        return "", 0
+    if path.startswith(root):
+        path = path[len(root) :].lstrip("/")
+    return path, line
+
+
+def trace_entry(spec: EntrySpec, root: str) -> TracedEntry:
+    """Build inputs and trace one entry (CPU abstract eval only)."""
+    fn, args, kwargs, meta = spec.build()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        traced = fn.trace(*args, **kwargs)
+    path, line = _fn_location(fn, root)
+    return TracedEntry(
+        name=spec.name,
+        path=path,
+        line=line,
+        fn=fn,
+        args=args,
+        kwargs=kwargs,
+        closed=traced.jaxpr,
+        out_info=traced.out_info,
+        traced=traced,
+        donate_argnums=tuple(meta.get("donate_argnums", ())),
+        state_argnum=meta.get("state_argnum"),
+        state_out=meta.get("state_out"),
+    )
+
+
+def build_entries(root: str):
+    """Trace every registered entry. Returns ``(entries, failures)`` where
+    ``failures`` is a list of ``(spec, exception)`` — a failure to trace is
+    itself a gated finding (the executable the docs promise doesn't build)."""
+    entries: list[TracedEntry] = []
+    failures: list[tuple[EntrySpec, Exception]] = []
+    for spec in ENTRY_SPECS:
+        try:
+            entries.append(trace_entry(spec, root))
+        except Exception as e:  # surfaced as R10 by the orchestrator
+            failures.append((spec, e))
+    return entries, failures

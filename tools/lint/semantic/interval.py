@@ -1,0 +1,557 @@
+"""Interval analysis over closed jaxprs — the R7 engine.
+
+One abstract value per array: ``(lo, hi, exact)`` Python-int bounds on every
+element, or ``None`` for unknown (top). The interpreter walks equations in
+order, recursing through ``pjit``/``scan``/``cond``/``while`` with mapped
+environments (jnp indexing hides its gathers inside an inner pjit, and the
+whole simulation lives inside a scan body, so recursion is not optional).
+
+Soundness contract: a finding is emitted only for *provable* out-of-bounds.
+Intervals over-approximate, so "the interval pokes outside the legal range"
+is NOT proof — the attained values might all be legal (clamp idioms,
+sentinel-guarded selects). Two situations are proof:
+
+  * the whole interval is outside the legal range (every possible value is
+    out of bounds), or
+  * the interval is *exact* — both extremes are provably attained by some
+    element (iota/constant heritage through monotone ops) — and an extreme
+    lies outside the range.
+
+Exactness is set for constants and iota, preserved by element-preserving
+reshapes and by monotone ops against a degenerate (single-point) interval,
+and dropped on joins, element-dropping ops, and genuinely binary arithmetic.
+Transfers that could wrap in the array dtype degrade to unknown instead of
+reporting a wrapped range, so modular RNG arithmetic (splitmix etc.) cannot
+manufacture false positives. Scan carries enter the body as unknown, which
+over-approximates every iteration at once.
+
+TPU context (why this is a gate, not a style nit): XLA clamps OOB gather /
+dynamic_slice starts and drops OOB scatter updates — the program keeps
+running and returns numbers, they are just the wrong numbers.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+#: Abstract value: ``(lo, hi, exact)`` or None (unknown top). ``exact`` means
+#: both extremes are attained by some element at runtime, which upgrades a
+#: partial overlap with the illegal range from "possible" to "provable".
+Interval = "tuple[int, int, bool] | None"
+
+#: Element-preserving primitives: every input element survives into the
+#: output (possibly duplicated), so range AND exactness carry through.
+_EXACT_PASSTHROUGH = frozenset(
+    {
+        "broadcast_in_dim",
+        "reshape",
+        "squeeze",
+        "expand_dims",
+        "transpose",
+        "rev",
+        "copy",
+        "stop_gradient",
+        "sort",
+        "device_put",
+        "sharding_constraint",
+        "optimization_barrier",
+    }
+)
+
+#: Range-preserving but element-dropping primitives: the output stays inside
+#: the input's range, yet the extremes may no longer be attained.
+_RANGE_PASSTHROUGH = frozenset(
+    {"slice", "reduce_min", "reduce_max", "cummax", "cummin"}
+)
+
+#: Sub-jaxprs we deliberately do not enter: Pallas kernel bodies operate on
+#: Refs with their own indexing model (tools/lint/kernelcheck.py audits the
+#: BlockSpecs instead).
+_NO_RECURSE = frozenset({"pallas_call", "custom_partitioning"})
+
+
+@dataclass
+class OOB:
+    """One provable out-of-bounds index, pre-Finding."""
+
+    primitive: str
+    message: str
+    path: str = ""  # repo-relative source of the offending op, best effort
+    line: int = 0
+
+
+def _iv(lo: int, hi: int, exact: bool) -> Interval:
+    """Normalise: a single-point interval is always exact (the one value in
+    range is the value every element takes)."""
+    return (lo, hi, True if lo == hi else exact)
+
+
+def _deg(iv) -> bool:
+    return iv is not None and iv[0] == iv[1]
+
+
+def _dtype_range(dtype) -> tuple[int, int] | None:
+    dtype = np.dtype(dtype)
+    if dtype.kind == "b":
+        return (0, 1)
+    if dtype.kind in "iu":
+        info = np.iinfo(dtype)
+        return (int(info.min), int(info.max))
+    return None
+
+
+def _fit(lo: int, hi: int, dtype, exact: bool = False) -> Interval:
+    """Clamp a computed range into the dtype; degrade to unknown when the
+    exact result cannot be represented (it would wrap at runtime)."""
+    rng = _dtype_range(dtype)
+    if rng is None or lo > hi:
+        return None
+    if lo < rng[0] or hi > rng[1]:
+        return None
+    return _iv(lo, hi, exact)
+
+
+def _join(a: Interval, b: Interval) -> Interval:
+    """Union of two abstract values. Exactness survives only when it is
+    still provable: an operand's attained extreme is an extreme of the join."""
+    if a is None or b is None:
+        return None
+    lo, hi = min(a[0], b[0]), max(a[1], b[1])
+    exact = (
+        (a[2] or b[2])
+        and (a[2] or (b[0] <= a[0] and a[1] <= b[1]))
+        and (b[2] or (a[0] <= b[0] and b[1] <= a[1]))
+    )
+    return _iv(lo, hi, exact)
+
+
+def _strip_exact(iv: Interval) -> Interval:
+    return None if iv is None else _iv(iv[0], iv[1], False)
+
+
+def _const_interval(value) -> Interval:
+    arr = np.asarray(value)
+    if arr.size == 0:
+        return None
+    if arr.dtype.kind not in "biu":
+        return None
+    # min/max of a concrete array are attained by definition
+    return _iv(int(arr.min()), int(arr.max()), True)
+
+
+def _eqn_location(eqn, root: str) -> tuple[str, int]:
+    """Best-effort (repo-relative path, line) of the traced user code."""
+    try:  # private API, guarded: lint quality-of-life only
+        from jax._src import source_info_util
+
+        for frame in source_info_util.user_frames(eqn.source_info):
+            fname = frame.file_name
+            if fname.startswith(root):
+                rel = fname[len(root) :].lstrip("/")
+                return rel, frame.start_line
+    except Exception:
+        pass
+    return "", 0
+
+
+class _Interp:
+    def __init__(self, root: str):
+        self.root = root
+        self.oob: list[OOB] = []
+
+    # ---- environment helpers -------------------------------------------
+    def read(self, env: dict, atom) -> Interval:
+        if hasattr(atom, "val"):  # Literal
+            return _const_interval(atom.val)
+        return env.get(atom)
+
+    def run_closed(self, closed, in_intervals: list, context: tuple):
+        jaxpr = closed.jaxpr
+        env: dict = {}
+        for var, const in zip(jaxpr.constvars, closed.consts):
+            env[var] = _const_interval(const)
+        for var, iv in zip(jaxpr.invars, in_intervals):
+            env[var] = iv
+        self.run_jaxpr(jaxpr, env, context)
+        return [env.get(v) if not hasattr(v, "val") else _const_interval(v.val)
+                for v in jaxpr.outvars]
+
+    # ---- the interpreter ------------------------------------------------
+    def run_jaxpr(self, jaxpr, env: dict, context: tuple) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            ins = [self.read(env, v) for v in eqn.invars]
+            outs = self.transfer(eqn, name, ins, context)
+            for var, iv in zip(eqn.outvars, outs):
+                env[var] = iv
+
+    def transfer(self, eqn, name: str, ins: list, context: tuple) -> list:
+        n_out = len(eqn.outvars)
+        top = [None] * n_out
+
+        def one(iv: Interval) -> list:
+            return [iv] + [None] * (n_out - 1)
+
+        out_aval = getattr(eqn.outvars[0], "aval", None)
+        dtype = getattr(out_aval, "dtype", None)
+
+        if name in _EXACT_PASSTHROUGH:
+            return one(ins[0])
+        if name in _RANGE_PASSTHROUGH:
+            iv = ins[0]
+            return one(None if iv is None else _iv(iv[0], iv[1], False))
+        if name == "iota":
+            dim = eqn.params["dimension"]
+            size = eqn.params["shape"][dim]
+            if size <= 0:
+                return top
+            return one(_fit(0, size - 1, eqn.params["dtype"], exact=True))
+        if name in ("add", "sub", "mul"):
+            a, b = ins[0], ins[1]
+            if a is None or b is None or dtype is None:
+                return top
+            if name == "add":
+                lo, hi = a[0] + b[0], a[1] + b[1]
+            elif name == "sub":
+                lo, hi = a[0] - b[1], a[1] - b[0]
+            else:
+                prods = [x * y for x in a[:2] for y in b[:2]]
+                lo, hi = min(prods), max(prods)
+            # monotone against a single point keeps extremes attained
+            exact = (a[2] and _deg(b)) or (b[2] and _deg(a))
+            return one(_fit(lo, hi, dtype, exact=exact))
+        if name == "max":
+            a, b = ins[0], ins[1]
+            if a is None or b is None:
+                return top
+            exact = (a[2] and _deg(b)) or (b[2] and _deg(a))
+            return one(_iv(max(a[0], b[0]), max(a[1], b[1]), exact))
+        if name == "min":
+            a, b = ins[0], ins[1]
+            if a is None or b is None:
+                return top
+            exact = (a[2] and _deg(b)) or (b[2] and _deg(a))
+            return one(_iv(min(a[0], b[0]), min(a[1], b[1]), exact))
+        if name == "clamp":
+            lo_iv, x, hi_iv = ins[0], ins[1], ins[2]
+            if lo_iv is None or hi_iv is None:
+                return top
+            if x is None:
+                rng = _dtype_range(dtype)
+                if rng is None:
+                    return top
+                x = (rng[0], rng[1], False)
+            exact = x[2] and _deg(lo_iv) and _deg(hi_iv)
+            return one(
+                _iv(
+                    min(max(x[0], lo_iv[0]), hi_iv[0]),
+                    min(max(x[1], lo_iv[1]), hi_iv[1]),
+                    exact,
+                )
+            )
+        if name == "rem":
+            x, y = ins[0], ins[1]
+            if y is None or y[0] <= 0:
+                return top
+            bound = y[1] - 1
+            if x is not None and x[0] >= 0:
+                # identity case: x already below the (single) modulus
+                exact = x[2] and _deg(y) and x[1] < y[0]
+                return one(_iv(0, min(x[1], bound), exact))
+            return one(_iv(-bound, bound, False))
+        if name == "div":
+            x, y = ins[0], ins[1]
+            if x is None or y is None or y[0] <= 0 or dtype is None:
+                return top
+            cands = [int(a / b) for a in x[:2] for b in y[:2]]  # lax.div truncates
+            exact = x[2] and _deg(y)  # floor by a constant is monotone
+            return one(_fit(min(cands), max(cands), dtype, exact=exact))
+        if name == "neg":
+            if ins[0] is None or dtype is None:
+                return top
+            return one(_fit(-ins[0][1], -ins[0][0], dtype, exact=ins[0][2]))
+        if name == "abs":
+            if ins[0] is None or dtype is None:
+                return top
+            lo, hi = ins[0][0], ins[0][1]
+            alo = 0 if lo <= 0 <= hi else min(abs(lo), abs(hi))
+            exact = ins[0][2] and not (lo < 0 < hi)  # sign-definite: monotone
+            return one(_fit(alo, max(abs(lo), abs(hi)), dtype, exact=exact))
+        if name == "select_n":
+            joined = ins[1]
+            for iv in ins[2:]:
+                joined = _join(joined, iv)
+            # which branch an element takes is data-dependent: extremes of
+            # the join are not provably attained
+            return one(None if joined is None else _iv(joined[0], joined[1], False))
+        if name == "concatenate":
+            joined = ins[0]
+            for iv in ins[1:]:
+                joined = _join(joined, iv)
+            return one(joined)  # every operand element survives: _join's
+            # exactness rule is precisely right here
+        if name == "pad":
+            # negative padding drops elements, so exactness cannot survive
+            joined = _join(ins[0], ins[1])
+            return one(None if joined is None else _iv(joined[0], joined[1], False))
+        if name in ("eq", "ne", "lt", "le", "gt", "ge", "and", "or", "not",
+                    "xor", "reduce_and", "reduce_or", "is_finite"):
+            if np.dtype(dtype).kind == "b" if dtype is not None else False:
+                return one(_iv(0, 1, False))
+            return top
+        if name == "convert_element_type":
+            if ins[0] is None or dtype is None:
+                return top
+            return one(_fit(ins[0][0], ins[0][1], dtype, exact=ins[0][2]))
+        if name == "reduce_sum":
+            if ins[0] is None or dtype is None:
+                return top
+            in_aval = getattr(eqn.invars[0], "aval", None)
+            if in_aval is None:
+                return top
+            count = 1
+            for ax in eqn.params.get("axes", ()):
+                count *= in_aval.shape[ax]
+            return one(_fit(ins[0][0] * count, ins[0][1] * count, dtype))
+        if name in ("argmax", "argmin"):
+            in_aval = getattr(eqn.invars[0], "aval", None)
+            axes = eqn.params.get("axes", ())
+            if in_aval is None or len(axes) != 1:
+                return top
+            size = in_aval.shape[axes[0]]
+            if size <= 0:
+                return top
+            return one(_fit(0, size - 1, eqn.params.get("index_dtype", dtype)))
+
+        # ---- indexing primitives: bound checks + value-range results ----
+        if name == "gather":
+            return one(self._check_gather(eqn, ins, context))
+        if name == "dynamic_slice":
+            self._check_dynamic_slice(eqn, ins, context)
+            iv = ins[0]
+            return one(None if iv is None else _iv(iv[0], iv[1], False))
+        if name.startswith("scatter"):
+            self._check_scatter(eqn, ins, context)
+            joined = _join(ins[0], ins[-1])
+            return one(None if joined is None else _iv(joined[0], joined[1], False))
+        if name == "dynamic_update_slice":
+            joined = _join(ins[0], ins[1])
+            return one(None if joined is None else _iv(joined[0], joined[1], False))
+
+        # ---- control flow -----------------------------------------------
+        if name == "pjit" or name == "closed_call" or name == "core_call":
+            inner = eqn.params.get("jaxpr")
+            if inner is None or not hasattr(inner, "jaxpr"):
+                return top
+            outs = self.run_closed(inner, ins, context + (name,))
+            return outs[:n_out] + [None] * max(0, n_out - len(outs))
+        if name == "scan":
+            inner = eqn.params["jaxpr"]
+            nc = eqn.params["num_consts"]
+            nk = eqn.params["num_carry"]
+            body_ins = list(ins[:nc]) + [None] * nk + list(ins[nc + nk :])
+            outs = self.run_closed(inner, body_ins, context + ("scan",))
+            # the realised carry is init (0 iters) OR body-out — either way
+            # the join's extremes are not provably attained
+            carries = [
+                _strip_exact(_join(o, i))
+                for o, i in zip(outs[:nk], ins[nc : nc + nk])
+            ]
+            return (carries + outs[nk:])[:n_out] + [None] * max(
+                0, n_out - len(outs)
+            )
+        if name == "while":
+            body = eqn.params["body_jaxpr"]
+            nb = eqn.params["body_nconsts"]
+            ncd = eqn.params["cond_nconsts"]
+            carry_ins = ins[ncd + nb :]
+            body_ins = list(ins[ncd : ncd + nb]) + [None] * len(carry_ins)
+            outs = self.run_closed(body, body_ins, context + ("while",))
+            return [
+                _strip_exact(_join(o, i)) for o, i in zip(outs, carry_ins)
+            ][:n_out] + [
+                None
+            ] * max(0, n_out - len(carry_ins))
+        if name == "cond":
+            branches = eqn.params["branches"]
+            joined: list = None
+            for br in branches:
+                outs = self.run_closed(br, ins[1:], context + ("cond",))
+                if joined is None:
+                    joined = outs
+                else:
+                    joined = [_join(a, b) for a, b in zip(joined, outs)]
+            joined = joined or []
+            # a branch's attained extremes need not be attained (the other
+            # branch may be the one taken) — strip exactness
+            joined = [
+                None if iv is None else _iv(iv[0], iv[1], False) for iv in joined
+            ]
+            return joined[:n_out] + [None] * max(0, n_out - len(joined))
+
+        # Generic fallback: walk any sub-jaxpr with unknown inputs so index
+        # sites inside (custom_jvp bodies etc.) are still visited.
+        if name not in _NO_RECURSE:
+            for val in eqn.params.values():
+                vals = val if isinstance(val, (list, tuple)) else (val,)
+                for v in vals:
+                    if hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):
+                        self.run_closed(
+                            v, [None] * len(v.jaxpr.invars), context + (name,)
+                        )
+        return top
+
+    # ---- bound checks ---------------------------------------------------
+    def _flag(self, eqn, context: tuple, message: str) -> None:
+        path, line = _eqn_location(eqn, self.root)
+        self.oob.append(
+            OOB(primitive=eqn.primitive.name, message=message, path=path, line=line)
+        )
+
+    @staticmethod
+    def _mode_name(eqn) -> str:
+        mode = eqn.params.get("mode")
+        return getattr(mode, "name", str(mode) if mode is not None else "DEFAULT")
+
+    def _verdict(self, iv, allowed: list) -> str | None:
+        """'full' — every possible index is OOB. 'exact' — some attained
+        index is provably OOB. None — no proof (possible-but-unprovable
+        overlap stays silent: that is the soundness contract)."""
+        lo, hi, exact = iv
+        # with several index columns sharing one interval, use the loosest
+        # bound: an attained value outside it is OOB in *every* column
+        if hi < 0 or lo > min(allowed):
+            return "full"
+        if exact and (lo < 0 or hi > max(allowed)):
+            return "exact"
+        return None
+
+    def _check_gather(self, eqn, ins: list, context: tuple) -> Interval:
+        operand_iv, idx_iv = ins[0], ins[1]
+        result = operand_iv
+        if result is not None:  # gathered subset: extremes may be dropped
+            result = _iv(result[0], result[1], False)
+        mode = self._mode_name(eqn)
+        if mode == "FILL_OR_DROP":
+            result = _join(result, _const_interval(eqn.params.get("fill_value"))
+                           if eqn.params.get("fill_value") is not None else None)
+        if idx_iv is None:
+            return result
+        operand_shape = eqn.invars[0].aval.shape
+        dnums = eqn.params["dimension_numbers"]
+        slice_sizes = eqn.params["slice_sizes"]
+        dims = list(dnums.start_index_map)
+        if not dims:
+            return result
+        allowed = [operand_shape[d] - slice_sizes[d] for d in dims]
+        lo, hi, _ = idx_iv
+        verdict = self._verdict(idx_iv, allowed)
+        if mode == "FILL_OR_DROP":
+            # partial OOB is the sanctioned -1-sentinel pattern; only an
+            # all-fill gather is a provable bug
+            if verdict == "full":
+                self._flag(
+                    eqn,
+                    context,
+                    f"gather(mode=FILL_OR_DROP) indices span [{lo}, {hi}], "
+                    f"entirely outside the allowed start range "
+                    f"[0, {allowed[0]}] — every element is fill",
+                )
+        elif verdict == "full":
+            self._flag(
+                eqn,
+                context,
+                f"gather(mode={mode}) indices span [{lo}, {hi}], entirely "
+                f"outside the allowed start range [0, {allowed[0]}] "
+                f"(operand {tuple(operand_shape)}, slice {tuple(slice_sizes)}); "
+                f"TPU clamps silently",
+            )
+        elif verdict == "exact":
+            self._flag(
+                eqn,
+                context,
+                f"gather(mode={mode}) provably reaches index {lo if lo < 0 else hi} "
+                f"outside the allowed start range [0, {max(allowed)}] "
+                f"(operand {tuple(operand_shape)}, slice {tuple(slice_sizes)}); "
+                f"TPU clamps silently",
+            )
+        return result
+
+    def _check_dynamic_slice(self, eqn, ins: list, context: tuple) -> None:
+        operand_shape = eqn.invars[0].aval.shape
+        slice_sizes = eqn.params["slice_sizes"]
+        for d, iv in enumerate(ins[1:]):
+            if iv is None:
+                continue
+            allowed = operand_shape[d] - slice_sizes[d]
+            verdict = self._verdict(iv, [allowed])
+            if verdict is not None:
+                lo, hi, _ = iv
+                detail = (
+                    "entirely outside"
+                    if verdict == "full"
+                    else f"provably reaches start {lo if lo < 0 else hi} outside"
+                )
+                self._flag(
+                    eqn,
+                    context,
+                    f"dynamic_slice start for dim {d} spans [{lo}, {hi}], "
+                    f"{detail} the allowed range [0, {allowed}] (operand "
+                    f"{tuple(operand_shape)}, slice {tuple(slice_sizes)}); "
+                    f"XLA clamps the start silently",
+                )
+
+    def _check_scatter(self, eqn, ins: list, context: tuple) -> None:
+        idx_iv = ins[1]
+        if idx_iv is None:
+            return
+        operand_shape = eqn.invars[0].aval.shape
+        dnums = eqn.params["dimension_numbers"]
+        mode = self._mode_name(eqn)
+        inserted = set(dnums.inserted_window_dims)
+        dims = [d for d in dnums.scatter_dims_to_operand_dims if d in inserted]
+        if not dims:
+            return
+        allowed = [operand_shape[d] - 1 for d in dims]
+        lo, hi, _ = idx_iv
+        verdict = self._verdict(idx_iv, allowed)
+        if mode == "FILL_OR_DROP":
+            # partial OOB with drop semantics is a sanctioned sentinel
+            # pattern (wb_subj uses -1 + mode="drop"); only a fully-OOB
+            # index range — every update dropped — is a provable bug.
+            if verdict == "full":
+                self._flag(
+                    eqn,
+                    context,
+                    f"{eqn.primitive.name}(mode=FILL_OR_DROP) indices span "
+                    f"[{lo}, {hi}], entirely outside [0, {allowed[0]}] — "
+                    f"every update is silently dropped",
+                )
+        elif verdict == "full":
+            self._flag(
+                eqn,
+                context,
+                f"{eqn.primitive.name}(mode={mode}) indices span [{lo}, {hi}], "
+                f"entirely outside [0, {allowed[0]}] (operand "
+                f"{tuple(operand_shape)}); OOB scatter corrupts silently",
+            )
+        elif verdict == "exact":
+            self._flag(
+                eqn,
+                context,
+                f"{eqn.primitive.name}(mode={mode}) provably reaches index "
+                f"{lo if lo < 0 else hi} outside [0, {max(allowed)}] (operand "
+                f"{tuple(operand_shape)}); OOB scatter corrupts silently",
+            )
+
+
+def find_oob(closed_jaxpr, *, root: str = "") -> list[OOB]:
+    """Run the interval interpreter over one entry's closed jaxpr and return
+    every provably out-of-bounds index site."""
+    interp = _Interp(root)
+    interp.run_closed(
+        closed_jaxpr, [None] * len(closed_jaxpr.jaxpr.invars), context=()
+    )
+    return interp.oob

@@ -1,0 +1,93 @@
+"""Jaxpr traversal and fingerprinting for the semantic tier.
+
+Everything here operates on already-traced ``ClosedJaxpr`` objects — no
+tracing, no device work — so the helpers stay cheap enough to run over every
+shipped entry point in tier-1. The walker is the shared substrate: R6-R8 and
+the census both consume the same recursive equation stream instead of each
+re-implementing sub-jaxpr discovery (scan/cond/while bodies and the inner
+``pjit`` wrappers jnp indexing hides gathers behind).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from collections import Counter
+from typing import Iterator
+
+#: Primitives whose params hold sub-jaxprs that count as *loop/branch bodies*
+#: for R8 (host effects inside them are per-tick effects, not per-call ones).
+LOOP_PRIMITIVES = frozenset({"scan", "while", "cond"})
+
+_HEX_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
+
+
+def _sub_jaxprs(params: dict) -> Iterator[object]:
+    """Yield every (Closed)Jaxpr reachable from one equation's params."""
+    for val in params.values():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for v in vals:
+            if hasattr(v, "eqns"):  # raw Jaxpr
+                yield v
+            elif hasattr(v, "jaxpr") and hasattr(v.jaxpr, "eqns"):  # ClosedJaxpr
+                yield v.jaxpr
+
+
+def _raw(jaxpr) -> object:
+    return jaxpr.jaxpr if hasattr(jaxpr, "jaxpr") else jaxpr
+
+
+def walk_eqns(jaxpr, _context: tuple[str, ...] = ()) -> Iterator[tuple]:
+    """Depth-first ``(eqn, context)`` stream over ``jaxpr`` and every
+    sub-jaxpr. ``context`` is the tuple of enclosing primitive names, e.g.
+    ``("scan", "pjit")`` for an equation inside a jitted helper called from
+    a scan body."""
+    for eqn in _raw(jaxpr).eqns:
+        yield eqn, _context
+        for sub in _sub_jaxprs(eqn.params):
+            yield from walk_eqns(sub, _context + (eqn.primitive.name,))
+
+
+def primitive_histogram(jaxpr) -> dict[str, int]:
+    """Recursive primitive counts, sorted by name (census wire format)."""
+    counts: Counter[str] = Counter()
+    for eqn, _ in walk_eqns(jaxpr):
+        counts[eqn.primitive.name] += 1
+    return dict(sorted(counts.items()))
+
+
+def in_loop(context: tuple[str, ...]) -> bool:
+    return any(p in LOOP_PRIMITIVES for p in context)
+
+
+def jaxpr_digest(jaxpr, *, strip: tuple[str, ...] = ()) -> str:
+    """sha256 of the pretty-printed jaxpr with unstable tokens normalised.
+
+    Memory addresses (``<function ... at 0x7f..>`` reprs inside pallas_call
+    params) and any caller-supplied path prefixes are stripped so the digest
+    is stable across processes and checkouts — drift means the *computation*
+    changed, which is exactly what R10 gates.
+    """
+    text = str(jaxpr)
+    text = _HEX_ADDR_RE.sub("0x0", text)
+    for prefix in strip:
+        text = text.replace(prefix, "<repo>")
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+def scan_eqns(jaxpr) -> Iterator[tuple]:
+    """``(eqn, context)`` for every scan equation, recursively."""
+    for eqn, context in walk_eqns(jaxpr):
+        if eqn.primitive.name == "scan":
+            yield eqn, context
+
+
+def scan_carry_avals(eqn) -> tuple[list, list]:
+    """(carry-in avals, carry-out avals) of one scan equation's body."""
+    body = eqn.params["jaxpr"]  # ClosedJaxpr
+    n_consts = eqn.params["num_consts"]
+    n_carry = eqn.params["num_carry"]
+    return (
+        list(body.in_avals[n_consts : n_consts + n_carry]),
+        list(body.out_avals[:n_carry]),
+    )

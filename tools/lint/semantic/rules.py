@@ -1,0 +1,238 @@
+"""R6-R9: the semantic rule pack over traced entry points.
+
+Unlike R1-R5 (Python AST), these rules look at what XLA will actually
+compile: the closed jaxpr of each registered entry (R6-R8) and its lowered
+StableHLO (R9). Findings anchor to the source line of the offending traced
+op when the traceback survives, falling back to the entry function's def
+line — so the existing pragma machinery (``# tpulint: disable=R7 -- why``)
+works unchanged.
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+
+from tools.lint.model import Finding
+from tools.lint.semantic import jaxprs
+from tools.lint.semantic.entries import TracedEntry
+from tools.lint.semantic.interval import find_oob
+
+#: Host-callback primitives: their presence inside a scan/cond/while body
+#: means a device->host round trip EVERY TICK, the exact failure mode the
+#: "no host round trip inside the scan" claim rules out.
+CALLBACK_PRIMITIVES = frozenset(
+    {"pure_callback", "io_callback", "debug_callback", "debug_print"}
+)
+
+_ALIAS_RE = re.compile(r"tf\.aliasing_output\s*=\s*(\d+)")
+
+
+def _finding(rule: str, entry: TracedEntry, message: str, hint: str,
+             path: str = "", line: int = 0) -> Finding:
+    return Finding(
+        rule=rule,
+        path=path or entry.path,
+        line=line or entry.line,
+        message=f"[{entry.name}] {message}",
+        hint=hint,
+    )
+
+
+# ------------------------------------------------------------------- R6
+def check_r6(entry: TracedEntry, tree_util) -> list[Finding]:
+    """Scan-carry stability + entry-level state-pytree round-trip."""
+    findings: list[Finding] = []
+    for eqn, context in jaxprs.scan_eqns(entry.closed):
+        carry_in, carry_out = jaxprs.scan_carry_avals(eqn)
+        for i, (ain, aout) in enumerate(zip(carry_in, carry_out)):
+            if (ain.shape, ain.dtype, getattr(ain, "weak_type", False)) != (
+                aout.shape,
+                aout.dtype,
+                getattr(aout, "weak_type", False),
+            ):
+                findings.append(
+                    _finding(
+                        "R6",
+                        entry,
+                        f"scan carry {i} drifts across the body: "
+                        f"{ain} in vs {aout} out",
+                        "make the body return the carry with the exact "
+                        "input aval (shape, dtype, weak_type)",
+                    )
+                )
+        for i, aval in enumerate(carry_in):
+            if getattr(aval, "weak_type", False):
+                findings.append(
+                    _finding(
+                        "R6",
+                        entry,
+                        f"scan carry {i} is weak-typed ({aval}): a Python "
+                        f"scalar leaked into the carry and will repromote "
+                        f"on the first mixed-dtype op",
+                        "initialise the carry leaf with an explicit dtype "
+                        "(jnp.zeros((), jnp.int32), not 0)",
+                    )
+                )
+            dtype = getattr(aval, "dtype", None)
+            if dtype is not None and np.dtype(dtype).itemsize == 8:
+                findings.append(
+                    _finding(
+                        "R6",
+                        entry,
+                        f"scan carry {i} is 64-bit ({aval}): x64 leaked into "
+                        f"the carry (doubles HBM traffic, not TPU-native)",
+                        "keep carries at 32-bit; check for np scalars or "
+                        "enable_x64 contexts upstream",
+                    )
+                )
+    if entry.state_argnum is not None and entry.state_out is not None:
+        state_in = entry.args[entry.state_argnum]
+        state_out = entry.state_out(entry.out_info)
+        tin = tree_util.tree_structure(state_in)
+        tout = tree_util.tree_structure(state_out)
+        if tin != tout:
+            findings.append(
+                _finding(
+                    "R6",
+                    entry,
+                    f"returned state treedef differs from the input state "
+                    f"(in: {tin}, out: {tout}) — every chunked driver "
+                    f"feeding this back recompiles or crashes",
+                    "return the state with the declared sim/ pytree "
+                    "structure (no dropped/added optional fields)",
+                )
+            )
+        else:
+            for leaf_in, leaf_out in zip(
+                tree_util.tree_leaves(state_in), tree_util.tree_leaves(state_out)
+            ):
+                if (
+                    tuple(leaf_in.shape) != tuple(leaf_out.shape)
+                    or leaf_in.dtype != leaf_out.dtype
+                ):
+                    findings.append(
+                        _finding(
+                            "R6",
+                            entry,
+                            f"state leaf aval drifts across the entry: "
+                            f"{leaf_in.shape}/{leaf_in.dtype} in vs "
+                            f"{leaf_out.shape}/{leaf_out.dtype} out",
+                            "keep returned state leaves bit-compatible with "
+                            "the canonical constructors in sim/",
+                        )
+                    )
+                    break
+    return findings
+
+
+# ------------------------------------------------------------------- R7
+def check_r7(entry: TracedEntry, root: str) -> list[Finding]:
+    findings = []
+    for oob in find_oob(entry.closed, root=root):
+        findings.append(
+            _finding(
+                "R7",
+                entry,
+                oob.message,
+                "clamp/clip/mod the index into range (or mode='drop' with a "
+                "sentinel if partial OOB is the contract)",
+                path=oob.path,
+                line=oob.line,
+            )
+        )
+    return findings
+
+
+# ------------------------------------------------------------------- R8
+def check_r8(entry: TracedEntry) -> list[Finding]:
+    findings = []
+    for eqn, context in jaxprs.walk_eqns(entry.closed):
+        name = eqn.primitive.name
+        if name in CALLBACK_PRIMITIVES and jaxprs.in_loop(context):
+            loop = next(p for p in context if p in jaxprs.LOOP_PRIMITIVES)
+            findings.append(
+                _finding(
+                    "R8",
+                    entry,
+                    f"{name} primitive inside a lax.{loop} body: a host "
+                    f"round trip every iteration",
+                    "move the callback outside the scanned region or record "
+                    "into a traced array and export after the scan",
+                )
+            )
+    return findings
+
+
+# ------------------------------------------------------------------- R9
+_MAIN_SIG_RE = re.compile(r"func\.func public @main\((.*?)\)\s*(?:->|\{)", re.S)
+_ARG_RE = re.compile(r"%arg\d+")
+
+
+def lowered_interface(entry: TracedEntry) -> tuple[list[int], int]:
+    """(aliased output positions, number of kept ``@main`` parameters) of the
+    lowered module. XLA drops runtime arguments whose value is never read
+    (dead-argument elimination) — a donated-but-unused leaf vanishes from the
+    signature entirely, which is NOT a silent copy."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        text = entry.traced.lower().as_text()
+    aliases = sorted(int(m) for m in _ALIAS_RE.findall(text))
+    sig = _MAIN_SIG_RE.search(text)
+    n_args = len(set(_ARG_RE.findall(sig.group(1)))) if sig else -1
+    return aliases, n_args
+
+
+def lowered_alias_outputs(entry: TracedEntry) -> list[int]:
+    """Output positions that alias a donated input in the lowered module."""
+    return lowered_interface(entry)[0]
+
+
+def declared_donated_leaves(entry: TracedEntry, tree_util) -> int:
+    count = 0
+    for argnum in entry.donate_argnums:
+        count += len(tree_util.tree_leaves(entry.args[argnum]))
+    return count
+
+
+def check_r9(
+    entry: TracedEntry, tree_util, alias_outputs: list[int] | None = None
+) -> tuple[list[Finding], list[int]]:
+    """Verify every declared donated buffer materialises as an input-output
+    alias in the lowered computation. Returns (findings, alias map) so the
+    census can record the map without lowering twice."""
+    if not entry.donate_argnums:
+        return [], []
+    declared = declared_donated_leaves(entry, tree_util)
+    if alias_outputs is None:
+        alias_outputs, n_main_args = lowered_interface(entry)
+    else:
+        n_main_args = -1
+    # Dead-argument elimination: XLA removes runtime args it never reads
+    # (e.g. a donated scalar the entry overwrites with a constant). Those
+    # leaves have no buffer in the compiled program, so nothing is copied —
+    # discount them. Conservative in the quiet direction: if a NON-donated
+    # arg was dropped while a donated one lost its alias, the counts cancel.
+    total_runtime_args = len(entry.closed.jaxpr.invars)
+    dropped = max(0, total_runtime_args - n_main_args) if n_main_args >= 0 else 0
+    expected = max(0, declared - dropped)
+    findings = []
+    if len(alias_outputs) < expected:
+        findings.append(
+            _finding(
+                "R9",
+                entry,
+                f"declares {declared} donated buffer leaves "
+                f"({expected} kept after dead-arg elimination) but only "
+                f"{len(alias_outputs)} input-output aliases survive "
+                f"lowering — the missing ones are silently copied "
+                f"(double HBM at the donation site)",
+                "donated leaves must be returned with identical "
+                "shape/dtype; check for dtype conversions or dropped "
+                "outputs on the donated path",
+            )
+        )
+    return findings, alias_outputs
