@@ -37,9 +37,16 @@ Usage: ``python bench.py`` (driver mode — one JSON line),
 totals + detection-latency histograms as schema-versioned JSONL + Prometheus),
 ``python bench.py --ensemble <B> [n]`` (vmapped multi-universe rung,
 sim/ensemble.py: B universes stepped in one compiled call; the reported
-aggregate is universes × member·rounds/s), or ``python bench.py --rapid
+aggregate is universes × member·rounds/s), ``python bench.py --rapid
 [n]`` (the Rapid consistent-membership engine rung, sim/rapid.py — the
-measured price of strong consistency next to the SWIM numbers).
+measured price of strong consistency next to the SWIM numbers), or
+``python bench.py --shard-map <d> [n]`` (the explicit-SPMD engine rung,
+parallel/spmd.py: the sparse tick as a shard_map program over d member
+shards with bucketed cross-shard exchange; rows are stamped with the
+shard count, the resolved bucket capacity and the exchange-round count,
+and both the backend probe attempt and the result row land in
+artifacts/bench_history.jsonl. On a CPU-only box set JAX_PLATFORMS=cpu
+and the rung forces d virtual host devices itself).
 """
 
 from __future__ import annotations
@@ -240,6 +247,75 @@ def _measure_ensemble(
         "n_members": n_members,
         "universes": b_count,
         "engine": "dense-ensemble",
+    }
+
+
+def _measure_shard_map(
+    d: int, n_members: int = 32768, chunk: int = 48, reps: int = 4
+) -> dict:
+    """The ``--shard-map d [n]`` rung: the explicit-SPMD sparse engine
+    (parallel/spmd.py) over a d-shard ``members`` mesh, measured exactly
+    like the sparse rungs (warmup + compile, then reps × chunk scanned
+    ticks synced by an element fetch off the large view_T buffer). The row
+    carries the exchange geometry next to the throughput number — shard
+    count, resolved per-(channel, destination) bucket capacity in sender
+    groups, and exchange rounds per tick — so GSPMD-vs-explicit-SPMD
+    comparisons in PERF.md read straight off bench_history.jsonl rows."""
+    import jax
+
+    from scalecube_cluster_tpu.parallel.mesh import make_mesh
+    from scalecube_cluster_tpu.parallel.spmd import (
+        ShardConfig,
+        _bucket_cap,
+        exchange_rounds_per_tick,
+        run_sparse_ticks_spmd,
+    )
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+        kill_sparse,
+    )
+
+    if len(jax.devices()) < d:
+        raise RuntimeError(
+            f"--shard-map {d} needs {d} devices, found {len(jax.devices())}"
+        )
+    # The explicit engine keeps slot frees IN the scan (the free decision
+    # is one replicated psum, no host boundary needed) — unlike the
+    # GSPMD sparse rung, which runs chunked with host-boundary frees.
+    params = SparseParams.for_n(
+        n_members, in_scan_writeback=True, slot_budget=_rung_slot_budget(n_members)
+    )
+    cfg = ShardConfig(d=d)
+    mesh = make_mesh(jax.devices()[:d])
+    state = kill_sparse(init_sparse_full_view(n_members, params.slot_budget), 7)
+    plan = FaultPlan.uniform(loss_percent=5.0)
+
+    state, _ = run_sparse_ticks_spmd(
+        params, cfg, mesh, state, plan, chunk, collect=False
+    )
+    int(state.view_T[0, 0])
+
+    t0 = time.perf_counter()
+    for rep in range(reps):
+        state, _ = run_sparse_ticks_spmd(
+            params, cfg, mesh, state, plan, chunk, collect=False
+        )
+        int(state.view_T[0, 0])
+    dt = time.perf_counter() - t0
+    value = n_members * (reps * chunk / dt)
+    return {
+        "metric": "member_gossip_rounds_per_sec",
+        "value": round(value, 1),
+        "unit": "member·rounds/s",
+        "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+        "n_members": n_members,
+        "engine": "sparse-shard-map",
+        "slot_budget": params.slot_budget,
+        "shards": d,
+        "bucket_groups": _bucket_cap(params, cfg),
+        "exchange_rounds": exchange_rounds_per_tick(),
     }
 
 
@@ -584,6 +660,56 @@ if __name__ == "__main__":
             jsonl_line(make_row("bench_rapid", out, run_metadata(seed=0))),
             flush=True,
         )
+    elif len(sys.argv) >= 3 and sys.argv[1] == "--shard-map":
+        d_arg = int(sys.argv[2])
+        n_arg = int(sys.argv[3]) if len(sys.argv) > 3 else 32768
+        # CPU-only boxes (JAX_PLATFORMS=cpu): force d virtual host devices
+        # BEFORE the first jax import, same mechanism as tests/conftest.py.
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+            flag = "--xla_force_host_platform_device_count"
+            if flag not in os.environ.get("XLA_FLAGS", ""):
+                os.environ["XLA_FLAGS"] = (
+                    os.environ.get("XLA_FLAGS", "") + f" {flag}={d_arg}"
+                ).strip()
+        try:
+            from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+            enable_repo_jax_cache()
+        except Exception:
+            pass
+        from scalecube_cluster_tpu.obs.export import (
+            append_jsonl,
+            jsonl_line,
+            make_row,
+            run_metadata,
+        )
+
+        # One recorded backend probe first (the ladder driver's discipline:
+        # outage budget must leave evidence in bench_history.jsonl).
+        t_probe = time.monotonic()
+        probe_err = _probe_once()
+        _record_probe_attempt(1, probe_err, time.monotonic() - t_probe)
+        if probe_err is not None:
+            row = make_row(
+                "bench_shard_map",
+                {"error": probe_err, "shards": d_arg, **_self_evidence()},
+                run_metadata(seed=0),
+            )
+        else:
+            out = _measure_shard_map(d_arg, n_arg)
+            row = make_row("bench_shard_map", out, run_metadata(seed=0))
+        try:
+            append_jsonl(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "artifacts",
+                    "bench_history.jsonl",
+                ),
+                [row],
+            )
+        except Exception:
+            pass
+        print(jsonl_line(row), flush=True)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--telemetry":
         _telemetry(
             n_members=int(sys.argv[3]) if len(sys.argv) > 3 else 4096,

@@ -33,6 +33,10 @@ from __future__ import annotations
 #                      the alarming state (Rapid; 0 for SWIM)
 #   cut_detected       members whose cut detector turned stable and locked
 #                      a vote this tick (Rapid; 0 for SWIM)
+#   exchange_overflow  cross-shard payloads dropped because a fixed-capacity
+#                      per-destination bucket was full this tick (explicit
+#                      shard_map engine, parallel/spmd.py; the single-program
+#                      engines have no buckets and emit constant 0)
 SHARED_COUNTERS: tuple[str, ...] = (
     "pings",
     "ping_reqs",
@@ -49,6 +53,7 @@ SHARED_COUNTERS: tuple[str, ...] = (
     "view_changes",
     "alarms_raised",
     "cut_detected",
+    "exchange_overflow",
 )
 
 # Emitted by the sparse engine only — they measure the compact working-set

@@ -106,6 +106,19 @@ def fanout_permutations_structured(rng, n, k, group=GROUP):
     by :func:`permuted_delivery`; ``ginv`` ``[k, N/group]`` and ``rots``
     ``[k, N/group]`` are the compact form the Pallas kernel prefetches.
     """
+    ginv, rots = structured_fanout_draw(rng, n, k, group)
+    return inv_from_structured(ginv, rots, n, group), ginv, rots
+
+
+def structured_fanout_draw(rng, n, k, group=GROUP):
+    """The random draw of :func:`fanout_permutations_structured` alone:
+    ``(ginv [k, n/group], rots [k, n/group])``, no expansion.
+
+    Split out for the explicit-SPMD engine (parallel/spmd.py): the draw's
+    values depend only on the key and (n, k, group), so every shard draws
+    the same compact routing tables (replicated, bit-identical to the
+    single-device draw) and expands only its own rows.
+    """
     ng = n // group
     if ng * group != n:
         raise ValueError(f"n={n} not a multiple of group={group}")
@@ -114,7 +127,41 @@ def fanout_permutations_structured(rng, n, k, group=GROUP):
         [jax.random.permutation(ks[c], ng) for c in range(k)]
     ).astype(jnp.int32)
     rots = jax.random.randint(ks[k], (k, ng), 0, group, jnp.int32)
-    return inv_from_structured(ginv, rots, n, group), ginv, rots
+    return ginv, rots
+
+
+def shard_group_routing(ginv, d):
+    """Per-destination-shard bucket routing for the structured fan-out.
+
+    With ``d`` equal shards each owning ``ngl = ng/d`` contiguous row
+    groups, sender group ``s`` on channel ``c`` delivers its whole
+    ``group``-row block to receiver group ``gfwd[c, s]`` — i.e. to exactly
+    one destination shard. This computes, from the compact group
+    permutation alone (replicated on every shard):
+
+      dest[c, q, j] — destination shard of shard q's j-th local sender
+        group on channel c, and
+      rank[c, q, j] — its arrival slot among shard q's channel-c groups
+        bound for that destination (0-based, order-preserving).
+
+    Both ``[k, d, ngl]`` int32. Because ``gfwd[c]`` is a permutation, a
+    destination shard receives exactly ``ngl`` groups per channel overall,
+    so a per-(channel, destination) bucket of capacity ``ngl`` can never
+    overflow; smaller capacities drop the highest ranks (counted by the
+    ``exchange_overflow`` counter). The receiver recovers a group's slot
+    from the same tables: sender group ``s = ginv[c, r]`` for receiver
+    group ``r`` sits at ``rank[c, s // ngl, s % ngl]``.
+    """
+    k, ng = ginv.shape
+    ngl = ng // d
+    if ngl * d != ng:
+        raise ValueError(f"{ng} sender groups not divisible by d={d} shards")
+    gfwd = jnp.argsort(ginv, axis=1).astype(jnp.int32)  # [k, ng]
+    dest = (gfwd // ngl).reshape(k, d, ngl)
+    onehot = dest[..., None] == jnp.arange(d, dtype=jnp.int32)
+    csum = jnp.cumsum(onehot.astype(jnp.int32), axis=2)
+    rank = jnp.take_along_axis(csum, dest[..., None], axis=3)[..., 0] - 1
+    return dest, rank
 
 
 def inv_from_structured(ginv, rots, n, group=GROUP):

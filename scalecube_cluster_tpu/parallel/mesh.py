@@ -66,6 +66,28 @@ def make_universe_mesh(devices=None) -> Mesh:
     return Mesh(np.asarray(devices), (UNIVERSE_AXIS,))
 
 
+def make_universe_member_mesh(shape: tuple[int, int], devices=None) -> Mesh:
+    """Two-axis mesh: universes × members — the ensemble twin of the
+    explicit-SPMD engine (parallel/spmd.py). Each (du, dm) device runs the
+    member-shard ``dm`` of ``B/du`` universes: cross-shard exchange
+    collectives stay inside a ``members`` row, the universe axis remains
+    pure data-parallel (the shard_map body vmaps over its local
+    universes)."""
+    devices = jax.devices() if devices is None else devices
+    du, dm = shape
+    return Mesh(
+        np.asarray(devices[: du * dm]).reshape(du, dm), (UNIVERSE_AXIS, AXIS)
+    )
+
+
+def _ns(mesh: Mesh, spec: P) -> NamedSharding:
+    """The one place a (mesh, PartitionSpec) pair becomes a NamedSharding —
+    state_shardings / sparse_state_shardings / the shard_map drivers all
+    route through here instead of growing parallel copies of the
+    construction."""
+    return NamedSharding(mesh, spec)
+
+
 def ensemble_shardings(tree, mesh: Mesh):
     """A ``tree``-shaped pytree of NamedShardings splitting every leaf's
     leading (universe) axis. Uniform by construction: stacked ensemble
@@ -93,11 +115,11 @@ def _specs(mesh: Mesh) -> tuple[P, P, P]:
 def state_shardings(mesh: Mesh) -> SimState:
     """A SimState-shaped pytree of NamedShardings for a 1D or 2D mesh."""
     mat, vec_p, rep_p = _specs(mesh)
-    row = NamedSharding(mesh, mat)
+    row = _ns(mesh, mat)
     # [N, G] user-gossip arrays keep G tiny — shard viewers only.
-    srow = NamedSharding(mesh, P(AXIS, None))
-    vec = NamedSharding(mesh, vec_p)
-    rep = NamedSharding(mesh, rep_p)
+    srow = _ns(mesh, P(AXIS, None))
+    vec = _ns(mesh, vec_p)
+    rep = _ns(mesh, rep_p)
     return SimState(
         view=row,
         rumor_age=row,
@@ -109,8 +131,8 @@ def state_shardings(mesh: Mesh) -> SimState:
         alive=vec,
         useen=srow,
         uage=srow,
-        uinf=NamedSharding(mesh, P(AXIS, None, None)),
-        uflight=NamedSharding(mesh, P(AXIS, None, None)),
+        uinf=_ns(mesh, P(AXIS, None, None)),
+        uflight=_ns(mesh, P(AXIS, None, None)),
         tick=rep,
         rng=rep,
     )
@@ -125,10 +147,10 @@ def shard_plan(plan: FaultPlan, mesh: Mesh) -> FaultPlan:
     """Fault matrices shard like the view matrices; compact uniform plans
     ([1, 1] matrices, sim/faults.py) replicate instead."""
     if plan.block.shape[0] == 1:
-        rep = NamedSharding(mesh, P())
+        rep = _ns(mesh, P())
         return jax.device_put(plan, FaultPlan(block=rep, loss=rep, mean_delay=rep))
     mat, _, _ = _specs(mesh)
-    row = NamedSharding(mesh, mat)
+    row = _ns(mesh, mat)
     return jax.device_put(plan, FaultPlan(block=row, loss=row, mean_delay=row))
 
 
@@ -155,14 +177,32 @@ def sparse_state_shardings(mesh: Mesh, like=None):
     vectors stay sharded over viewers only (replicated across the subject
     axis); write-back/load become subject-axis collectives XLA inserts.
     """
+    pspecs = sparse_state_pspecs(
+        like=like, two_d=SUBJECT_AXIS in mesh.axis_names
+    )
+    return jax.tree_util.tree_map(lambda spec: _ns(mesh, spec), pspecs)
+
+
+def sparse_state_pspecs(like=None, two_d: bool = False, prefix: tuple = ()):
+    """The SparseState layout as a pytree of bare PartitionSpecs — the
+    single source both :func:`sparse_state_shardings` (via :func:`_ns`) and
+    the explicit-SPMD shard_map in_specs/out_specs (parallel/spmd.py)
+    consume, so the two engines cannot drift apart on layout.
+
+    ``prefix`` prepends leading axes to every spec — the ensemble twin
+    passes ``(UNIVERSE_AXIS,)`` to stack a universe axis in front of each
+    leaf's member layout.
+    """
     from scalecube_cluster_tpu.sim.sparse import SparseState
 
-    two_d = SUBJECT_AXIS in mesh.axis_names
+    def mk(*axes):
+        return P(*prefix, *axes)
+
     # view_T [subj, viewer]
-    row = NamedSharding(mesh, P(SUBJECT_AXIS, AXIS) if two_d else P(None, AXIS))
-    slabrow = NamedSharding(mesh, P(AXIS, None))  # slab/age/susp [viewer, S]
-    vec = NamedSharding(mesh, P(AXIS))
-    rep = NamedSharding(mesh, P())
+    row = mk(SUBJECT_AXIS, AXIS) if two_d else mk(None, AXIS)
+    slabrow = mk(AXIS, None)  # slab/age/susp [viewer, S]
+    vec = mk(AXIS)
+    rep = mk()
     return SparseState(
         view_T=row,
         slot_subj=rep,
@@ -175,7 +215,7 @@ def sparse_state_shardings(mesh: Mesh, like=None):
         alive=vec,
         useen=slabrow,  # [N, G]: viewer rows shard, G tiny
         uage=slabrow,
-        uinf_ids=NamedSharding(mesh, P(AXIS, None, None)),  # [N, G, k]
+        uinf_ids=mk(AXIS, None, None),  # [N, G, k]
         uptr=slabrow,
         tick=rep,
         rng=rep,

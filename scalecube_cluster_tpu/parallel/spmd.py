@@ -1,0 +1,939 @@
+"""Explicit-SPMD sparse engine: the tick as a ``shard_map`` program.
+
+The 1D-NamedSharding path (parallel/mesh.py + sim/sparse.py) hands the GSPMD
+partitioner a single-device program and lets it infer the communication
+schedule. This module writes the schedule down instead: each of ``d`` shards
+owns its ``[N/d, S]`` slab / age / suspicion block, its viewer columns of
+``view_T``, and its member vectors, and every cross-shard interaction is an
+explicit fixed-shape collective:
+
+- **member scalars** (who is alive, which epoch): ONE tiled ``all_gather``
+  each of the [N/d] ``alive``/``epoch`` vectors per tick — O(N) bytes, the
+  channel over which probe targets answer pings/acks and relays answer
+  ping-reqs. No O(N·S) or O(N²) array is ever replicated.
+- **SYNC replies**: a requester's partner lives on shard ``prt // (N/d)``;
+  each shard answers with a ``[d, N/d, 1+W]`` reply buffer exchanged in one
+  tiled ``all_to_all``, slotted by requester row — a shard hosts exactly
+  N/d requesters, so the per-destination capacity is structural (never
+  drops).
+- **gossip fan-out rows**: the structured fan-out (ops/delivery.py) moves
+  whole ``group``-row sender blocks to single destination shards; blocks
+  are packed into per-(channel, destination-shard) buckets of capacity
+  ``bucket_groups`` (default ``N/(d·group)``, the provable maximum — see
+  ops/delivery.py::shard_group_routing) and exchanged in one tiled
+  ``all_to_all``. Overflowing blocks are DROPPED and counted in the
+  ``exchange_overflow`` counter (obs/counters.py) — at the default
+  capacity the counter is provably zero and the engine is bit-identical
+  to the oracle.
+
+Randomness follows the presample/slice discipline (sim/faults.py::
+link_pass_from): every draw happens at the full [N] shape — values depend
+only on key and shape, so replicated draws are bit-identical to the
+single-device draws — and each shard slices its rows before the (local)
+decision. Merges are int32 lattice maxes and bool ORs, and every counter is
+an integer partial sum combined with ``psum``/``pmax``, so no
+reduction-order hazard exists anywhere: the engine reproduces
+sim/sparse.py::sparse_tick bit-for-bit (tests/test_spmd.py pins clean,
+scheduled-fault, and knobbed timelines at n=2048 on 8 virtual devices;
+testlib/certify.py runs it as an extra engine through the full cadence).
+
+Scope: XLA tick core only (``pallas_core=False``) with in-scan write-back;
+the per-shard Pallas launch is a follow-on (ROADMAP).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from scalecube_cluster_tpu.ops.delivery import (
+    GROUP,
+    shard_group_routing,
+    structured_fanout_draw,
+)
+from scalecube_cluster_tpu.ops.merge import (
+    DEAD_BIT,
+    UNKNOWN_KEY,
+    decode_epoch,
+    decode_incarnation,
+    decode_status,
+    encode_key,
+    is_alive_key,
+    is_suspect_key,
+    merge_views,
+)
+from scalecube_cluster_tpu.parallel.mesh import AXIS, UNIVERSE_AXIS, sparse_state_pspecs
+from scalecube_cluster_tpu.sim.faults import FaultPlan, _edge_lookup, link_pass_from
+from scalecube_cluster_tpu.sim.knobs import Knobs, edge_live, suspicion_fill
+from scalecube_cluster_tpu.sim.schedule import (
+    FaultSchedule,
+    events_at,
+    plan_at,
+    plan_dirty_at,
+)
+from scalecube_cluster_tpu.sim.sparse import (
+    _ALIVE,
+    _SUSPECT,
+    _DEAD,
+    SparseParams,
+    SparseState,
+    _fd_decide,
+    _fd_zeros,
+    _sync_fire,
+    _sync_zeros,
+    sync_accept,
+)
+from scalecube_cluster_tpu.sim.state import AGE_STALE
+from scalecube_cluster_tpu.sim.tick import _acct_add, _acct_zero, _link_acct
+from scalecube_cluster_tpu.sim.usergossip import ring_record, user_gossip_finish
+from scalecube_cluster_tpu.ops.merge import EPOCH_MAX
+from scalecube_cluster_tpu.ops.pallas_sparse import SPARSE_GROUP
+
+
+@dataclass(frozen=True)
+class ShardConfig:
+    """Static layout of the explicit-SPMD engine (a jit-static argument).
+
+    ``d``             — number of member shards (= mesh ``"members"`` size).
+    ``bucket_groups`` — per-(channel, destination-shard) gossip bucket
+                        capacity in sender GROUPS; ``None`` selects the
+                        provably-lossless maximum ``N / (d · group)``.
+                        Smaller values bound the exchange payload and DROP
+                        overflowing blocks (counted per tick in the
+                        ``exchange_overflow`` counter) — a measurement
+                        knob and the negative-test hook, not a fidelity
+                        mode.
+    """
+
+    d: int
+    bucket_groups: int | None = None
+
+
+def _sparse_group(n: int) -> int:
+    """The tick's sender-group size — MUST match sparse_tick's choice."""
+    return SPARSE_GROUP if n % SPARSE_GROUP == 0 else GROUP
+
+
+def _validate(params: SparseParams, cfg: ShardConfig) -> None:
+    n = params.base.n
+    group = _sparse_group(n)
+    if params.pallas_core:
+        raise ValueError(
+            "the explicit-SPMD engine runs the XLA tick core only for now "
+            "(set pallas_core=False); the per-shard Pallas launch is a "
+            "ROADMAP follow-on"
+        )
+    if not params.in_scan_writeback:
+        raise ValueError(
+            "explicit-SPMD needs in_scan_writeback=True (the host-boundary "
+            "free path re-shards between chunks)"
+        )
+    if n % (cfg.d * group) != 0:
+        raise ValueError(
+            f"n={n} must divide into d={cfg.d} shards of whole "
+            f"group-{group} sender blocks (n % (d*group) == 0)"
+        )
+    cap = _bucket_cap(params, cfg)
+    if cap < 1:
+        raise ValueError(f"bucket_groups={cfg.bucket_groups} must be >= 1")
+
+
+def _bucket_cap(params: SparseParams, cfg: ShardConfig) -> int:
+    ngl = (params.base.n // _sparse_group(params.base.n)) // cfg.d
+    return ngl if cfg.bucket_groups is None else cfg.bucket_groups
+
+
+def exchange_rounds_per_tick() -> int:
+    """Cross-shard exchange rounds in one SPMD tick (bench row stamp):
+    member-scalar all_gather, SYNC reply all_to_all, gossip bucket
+    all_to_all. (Scalar psum/pmax reductions ride alongside; they carry
+    counters, not protocol payload.)"""
+    return 3
+
+
+def _apply_events_local(params, st, kill_mask, restart_mask, cut):
+    """sim/sparse.py::apply_events_sparse on one shard's rows.
+
+    ``kill_mask``/``restart_mask`` arrive replicated [N]; row-indexed state
+    uses the shard's slice (``cut``), while the suppression-ring scrub
+    indexes the GLOBAL mask with the ring's global member ids — the exact
+    computation the oracle runs, restricted to local rows.
+    """
+    n = params.base.n
+    any_ev = jnp.any(kill_mask | restart_mask)
+
+    def apply(st):
+        km, rm = cut(kill_mask), cut(restart_mask)
+        new_epoch = jnp.where(
+            rm, jnp.minimum(st.epoch + 1, EPOCH_MAX), st.epoch
+        )
+        uinf_ids = st.uinf_ids
+        if uinf_ids.shape[2] > 0:
+            hit = (uinf_ids >= 0) & restart_mask[jnp.clip(uinf_ids, 0, n - 1)]
+            uinf_ids = jnp.where(hit, -1, uinf_ids)
+            uinf_ids = jnp.where(rm[:, None, None], -1, uinf_ids)
+        st = st.replace(
+            alive=(st.alive & ~km) | rm,
+            epoch=new_epoch,
+            inc_self=jnp.where(rm, 0, st.inc_self),
+            age=jnp.where(rm[:, None], jnp.asarray(AGE_STALE, jnp.int8), st.age),
+            susp=jnp.where(rm[:, None], jnp.asarray(0, jnp.int16), st.susp),
+            useen=jnp.where(rm[:, None], False, st.useen),
+            uptr=jnp.where(rm[:, None], 0, st.uptr),
+            uinf_ids=uinf_ids,
+        )
+        if st.lat_first_suspect is not None:
+            st = st.replace(
+                lat_first_suspect=jnp.where(rm, -1, st.lat_first_suspect),
+                lat_first_dead=jnp.where(rm, -1, st.lat_first_dead),
+            )
+        if st.wb_valid is not None:
+            st = st.replace(wb_valid=jnp.zeros((), bool))
+        return st
+
+    return lax.cond(any_ev, apply, lambda s: s, st)
+
+
+def _free_plan_spmd(params, st, col, gate):
+    """sim/sparse.py::_free_plan with the any-over-viewers pin reduced
+    across shards (one psum; integer, order-free). Returns replicated
+    ``(freeing [S], wb_subj [S])`` plus the shard-local demoted slab."""
+    p = params.base
+    n = p.n
+    active = st.slot_subj >= 0
+    own_row = col[:, None] == st.slot_subj[None, :]  # local viewers × slots
+    dead_rec = ((st.slab & DEAD_BIT) != 0) & (st.slab >= 0)
+    stale_done = st.age.astype(jnp.int32) > p.periods_to_sweep
+    holding = (
+        (st.age < p.periods_to_spread)
+        | (st.susp > 0)
+        | (dead_rec & ~stale_done & ~own_row)
+    )
+    hold_part = jnp.any(holding & st.alive[:, None], axis=0)  # [S] partial
+    pinned = lax.psum(hold_part.astype(jnp.int32), AXIS) > 0
+    freeing = active & ~pinned & gate
+    wb_subj = jnp.where(freeing, st.slot_subj, n)
+
+    def make_writeback():
+        demote = dead_rec & stale_done & ~own_row
+        return jnp.where(demote, UNKNOWN_KEY, st.slab)
+
+    return freeing, wb_subj, make_writeback
+
+
+def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
+    """One gossip period on this shard's rows — sparse_tick restructured
+    around the three exchange boundaries. Runs INSIDE shard_map: ``state``
+    leaves are local per the sparse_state_pspecs layout, replicated leaves
+    (slot tables, tick, rng) are full-size. Returns the local new state
+    and REPLICATED metrics (partials psum'd)."""
+    p = params.base
+    n, S = p.n, params.slot_budget
+    d = cfg.d
+    nl = n // d
+    group = _sparse_group(n)
+    ngl = nl // group
+    cap_b = _bucket_cap(params, cfg)
+    f = p.gossip_fanout
+
+    q = lax.axis_index(AXIS)
+    lo = q * nl
+    lrow = jnp.arange(nl, dtype=jnp.int32)
+    col = lo + lrow  # global member ids of my rows
+
+    def cut(a):
+        return lax.dynamic_slice_in_dim(a, lo, nl, axis=0)
+
+    if events is not None:
+        state = _apply_events_local(params, state, events[0], events[1], cut)
+        restart_m = events[1]
+    t = state.tick + 1
+    (rng_next, k_tgt, k_ping, k_relay, k_gsel, k_glink, k_ssel, k_slink) = (
+        jax.random.split(state.rng, 8)
+    )
+    srange = jnp.arange(S, dtype=jnp.int32)
+    alive = state.alive  # local [nl]
+
+    # Exchange 1/3 — member scalars: the probe/ack answering channel.
+    alive_all = lax.all_gather(alive, AXIS, tiled=True)  # [n]
+    epoch_all = lax.all_gather(state.epoch, AXIS, tiled=True)  # [n]
+
+    do_fd = (t % p.fd_period_ticks) == 0
+    do_sync = (t % p.sync_period_ticks) == 0
+
+    def my_record_of(viewer, subject):
+        """Local rows' records through the slab indirection; ``viewer`` is
+        a LOCAL row index, ``subject`` a global member id."""
+        s = state.subj_slot[subject]
+        from_slab = state.slab[viewer, jnp.where(s >= 0, s, 0)]
+        return jnp.where(s >= 0, from_slab, state.view_T[subject, viewer])
+
+    # ------------------------------------------------------------------ 1. FD
+    def fd_fire_phase(_):
+        return _fd_decide(
+            p, plan, t, k_tgt, k_ping, k_relay, n,
+            lrow=lrow, col=col, cut=cut, record_of=my_record_of,
+            v_alive=alive, alive_all=alive_all, epoch_all=epoch_all,
+            collect=collect,
+        )
+
+    fd_out = lax.cond(do_fd, fd_fire_phase, lambda _: _fd_zeros(nl, collect), None)
+    fd_tgt, fd_key, fd_fire, msgs_fd = fd_out[:4]
+
+    # ------------------------------------- 2. own-record SYNC
+    W = min(params.sync_window, n)
+    nblocks = (n + W - 1) // W if W else 1
+    sync_round = t // p.sync_period_ticks
+    wsubj = (jnp.mod(sync_round, nblocks) * W + jnp.arange(W, dtype=jnp.int32)) % n
+
+    def spmd_partner_records(prt_full, prt):
+        # Exchange 2/3 — the SYNC reply round. Each shard builds the reply
+        # every one of its rows would give a requester (own record + window
+        # rows) and direct-slots it by the requester's LOCAL row: a shard
+        # hosts exactly nl requesters, so the per-destination capacity is
+        # structural and the exchange never drops.
+        rep = my_record_of(lrow, col)[:, None]  # my rows' own records
+        if W > 0:
+            rep = jnp.concatenate(
+                [rep, my_record_of(lrow[:, None], wsubj[None, :])], axis=1
+            )  # [nl, 1+W]
+        pr = prt_full.reshape(d, nl)  # requesters grouped by their shard
+        mine = (pr // nl) == q  # requesters whose partner is one of my rows
+        idx = jnp.where(mine, pr - lo, 0)
+        send = jnp.where(mine[:, :, None], rep[idx], UNKNOWN_KEY)
+        recv = lax.all_to_all(send, AXIS, 0, 0, tiled=True)  # [d, nl, 1+W]
+        got = recv[prt // nl, lrow]  # my requesters' replies
+        learned_key = got[:, 0]
+        learned_w = got[:, 1:] if W > 0 else jnp.full((nl, W), UNKNOWN_KEY, jnp.int32)
+        return learned_key, learned_w
+
+    # The reply all_to_all cannot sit inside a cond branch, so the fire
+    # phase runs every tick and skip-tick outputs are where-masked to the
+    # exact zeros the oracle's cond produces — bit-identical either way.
+    sy_fire = _sync_fire(
+        p, plan, t, k_ssel, k_slink, n,
+        lrow=lrow, col=col, cut=cut, record_of=my_record_of,
+        v_alive=alive, alive_all=alive_all,
+        partner_records=spmd_partner_records,
+        W=W, wsubj=wsubj, collect=collect,
+    )
+    sy_zero = _sync_zeros(nl, W, collect)
+    sy_out = jax.tree.map(lambda a, z: jnp.where(do_sync, a, z), sy_fire, sy_zero)
+    (sy_subj, sy_key, sy_accept, msgs_sync, win_key, win_accept, self_win) = sy_out[:7]
+
+    # -------------------------------------------- 3. slot free + allocation
+    do_wb = (t % params.writeback_period) == 0
+    freeing, wb_subj, make_writeback = _free_plan_spmd(params, state, col, do_wb)
+
+    def apply_writeback(view_T):
+        return view_T.at[wb_subj, :].set(make_writeback().T, mode="drop")
+
+    view_T = lax.cond(
+        jnp.any(freeing), apply_writeback, lambda vt: vt, state.view_T
+    )
+    slot_subj = jnp.where(freeing, -1, state.slot_subj)
+    subj_slot = state.subj_slot.at[wb_subj].set(-1, mode="drop")
+
+    # Activation requests: local scatters into a [N] partial, OR'd across
+    # shards with one psum; the grant ranking then runs replicated —
+    # identical inputs, identical (deterministic) grants on every shard.
+    req_part = jnp.zeros((n,), bool)
+    req_part = req_part.at[fd_tgt].max(fd_fire)
+    req_part = req_part.at[sy_subj].max(sy_accept)
+    if W > 0:
+        req_part = req_part.at[wsubj].max(jnp.any(win_accept, axis=0))
+        st_w = decode_status(self_win)
+        self_threat_pre = (
+            alive
+            & (self_win >= 0)
+            & (decode_epoch(self_win) == state.epoch)
+            & (decode_incarnation(self_win) >= state.inc_self)
+            & ((st_w == _SUSPECT) | (st_w == _DEAD))
+        )
+        req_part = req_part.at[col].max(self_threat_pre)
+    req = lax.psum(req_part.astype(jnp.int32), AXIS) > 0
+    if events is not None:
+        req = req | restart_m
+    req = req & (subj_slot < 0)
+    cap = params.alloc_cap
+    req_rank = jnp.cumsum(req.astype(jnp.int32)) - 1
+    granted = req & (req_rank < cap)
+    free_slots = jnp.flatnonzero(slot_subj < 0, size=cap, fill_value=S - 1)
+    n_free = jnp.sum(slot_subj < 0)
+    granted = granted & (req_rank < n_free)
+    new_subjects = jnp.flatnonzero(granted, size=cap, fill_value=0)
+    n_granted = jnp.sum(granted)
+    grant_valid = jnp.arange(cap) < jnp.minimum(n_granted, n_free)
+    slot_overflow = jnp.sum(req) - n_granted
+
+    tgt_slots = jnp.where(grant_valid, free_slots, S)
+    grant_subj = jnp.where(grant_valid, new_subjects, n)
+    slot_subj = slot_subj.at[tgt_slots].set(new_subjects, mode="drop")
+    subj_slot = subj_slot.at[grant_subj].set(free_slots, mode="drop")
+
+    def apply_loads(args):
+        slab, age, susp = args
+        loaded = view_T[new_subjects, :]  # [cap, nl] — my viewer columns
+        slab = slab.at[:, tgt_slots].set(loaded.T, mode="drop")
+        age = age.at[:, tgt_slots].set(jnp.asarray(AGE_STALE, jnp.int8), mode="drop")
+        susp = susp.at[:, tgt_slots].set(jnp.asarray(0, jnp.int16), mode="drop")
+        return slab, age, susp
+
+    slab, age, susp = lax.cond(
+        n_granted > 0,
+        apply_loads,
+        lambda args: args,
+        (state.slab, state.age, state.susp),
+    )
+    active = slot_subj >= 0
+
+    if events is not None:
+        r_slot = subj_slot[col]
+        r_fire = cut(restart_m) & (r_slot >= 0)
+        r_safe = jnp.where(r_fire, r_slot, 0)
+        r_key = encode_key(
+            jnp.full((nl,), _ALIVE, jnp.int32),
+            jnp.zeros((nl,), jnp.int32),
+            state.epoch,
+        )
+        slab = slab.at[lrow, r_safe].set(jnp.where(r_fire, r_key, slab[lrow, r_safe]))
+        age = age.at[lrow, r_safe].set(
+            jnp.where(r_fire, jnp.asarray(0, jnp.int8), age[lrow, r_safe])
+        )
+
+    # ------------------------------ 4. apply FD verdicts + SYNC learnings
+    slab0 = slab
+    fd_slot = jnp.where(fd_fire & (subj_slot[fd_tgt] >= 0), subj_slot[fd_tgt], -1)
+    sy_slot = jnp.where(
+        sy_accept & (subj_slot[sy_subj] >= 0), subj_slot[sy_subj], -1
+    )
+    cell_fd = srange[None, :] == fd_slot[:, None]
+    cell_sy = srange[None, :] == sy_slot[:, None]
+    slab = jnp.where(
+        cell_sy, sy_key[:, None], jnp.where(cell_fd, fd_key[:, None], slab)
+    )
+    age = jnp.where(cell_sy | cell_fd, jnp.asarray(0, jnp.int8), age)
+
+    # ------------------------------------------------- 5. gossip delivery
+    # Replicated compact routing tables (draws at full shape, values
+    # key-only), then exchange 3/3: whole sender-group blocks packed into
+    # per-(channel, destination-shard) buckets — the explicit form of the
+    # ICI schedule GSPMD infers for the oracle's gather.
+    ginv, rots = structured_fanout_draw(k_gsel, n, f, group)
+    lks = jax.random.split(k_glink, f)
+    u_full = [jax.random.uniform(lks[c], (n,)) for c in range(f)]
+    elive = edge_live(f, knobs)
+    susp_fill = suspicion_fill(p.suspicion_ticks, knobs)
+    susp_in = susp
+    age_in = age
+
+    dest, rank = shard_group_routing(ginv, d)  # [f, d, ngl] replicated
+    dest_l = dest[:, q, :]  # my local groups' destinations / ranks
+    rank_l = rank[:, q, :]
+
+    # Sender payloads: the young-masked slab rows every receiver would
+    # gather, plus the user-gossip flags riding the same fan-out edges.
+    young = age < p.periods_to_spread
+    rows_send = jnp.where(young & active[None, :], slab, UNKNOWN_KEY)
+    G = state.useen.shape[1]
+    tracked = state.uinf_ids.shape[2] > 0
+    urows = state.useen & (state.uage < p.periods_to_spread)
+    gfwd = jnp.argsort(ginv, axis=1).astype(jnp.int32)  # [f, ng]
+
+    rcv_c = []  # sender side: my rows' receivers per channel (global ids)
+    ug_send_c = []  # sender side: user-gossip flags to ship per channel
+    msgs_user = jnp.zeros((G,), jnp.int32)
+    bg = col // group  # my rows' global sender-group ids
+    for c in range(f):
+        g_r = gfwd[c, bg]  # receiver group of my rows
+        rot = rots[c, g_r]
+        rcv = group * g_r + (col - rot) % group  # perm_from_structured rows
+        rcv_c.append(rcv)
+        if tracked:
+            known = jnp.any(state.uinf_ids == rcv[:, None, None], axis=2)
+            s_c = urows & ~known & (alive & (rcv != col))[:, None]
+            if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+                s_c = s_c & elive[c]
+            ug_send_c.append(s_c)
+            msgs_user = msgs_user + jnp.sum(s_c, axis=0)
+        else:
+            # Untracked payload is the young rows themselves; the receiver
+            # applies the delivery mask. Message counting is sender-side
+            # (bijection: equal to the oracle's receiver-indexed sum).
+            ug_send_c.append(urows)
+            m_c = urows & (alive & (rcv != col))[:, None]
+            if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+                m_c = m_c & elive[c]
+            msgs_user = msgs_user + jnp.sum(m_c, axis=0)
+
+    # Pack buckets and exchange. Payload layout per sender group block:
+    # [group, S + G] int32 — slab rows then user-gossip flags.
+    buf = jnp.full((d, f * cap_b, group, S + G), UNKNOWN_KEY, jnp.int32)
+    overflow_part = jnp.zeros((), jnp.int32)
+    for c in range(f):
+        payload = jnp.concatenate(
+            [rows_send, ug_send_c[c].astype(jnp.int32)], axis=1
+        ).reshape(ngl, group, S + G)
+        dst = jnp.where(rank_l[c] < cap_b, dest_l[c], d)  # overflow → dropped
+        slot = c * cap_b + jnp.minimum(rank_l[c], cap_b - 1)
+        buf = buf.at[dst, slot].set(payload, mode="drop")
+        overflow_part = overflow_part + jnp.sum(
+            (rank_l[c] >= cap_b).astype(jnp.int32)
+        )
+    recv = lax.all_to_all(buf, AXIS, 0, 0, tiled=True)  # [d, f*cap, group, S+G]
+
+    # Receiver side: locate each expected sender block via the SAME
+    # replicated routing tables (rank < cap ⇔ the block was actually sent),
+    # un-rotate rows, and merge exactly as the oracle's gather path does.
+    rg = q * ngl + jnp.arange(ngl, dtype=jnp.int32)  # my receiver groups
+    rotv_b = lrow // group  # local group index of each of my rows
+    best_any = jnp.full((nl, S), UNKNOWN_KEY, jnp.int32)
+    best_alive = best_any
+    got_u = jnp.zeros((nl, G), bool)
+    uinf_ids, uptr = state.uinf_ids, state.uptr
+    edge_ok_c = []
+    for c in range(f):
+        sg = ginv[c, rg]  # sender group feeding each of my receiver groups
+        sshard = sg // ngl
+        srank = rank[c, sshard, sg % ngl]
+        delivered = srank < cap_b
+        blk = recv[sshard, c * cap_b + jnp.minimum(srank, cap_b - 1)]
+        blk = jnp.where(delivered[:, None, None], blk, UNKNOWN_KEY)
+        stag = blk.reshape(nl, S + G)
+        rot = rots[c, rg][rotv_b]  # per-row rotation of my receiver groups
+        r_idx = rotv_b * group + (col + rot) % group
+        sender_rows = stag[r_idx, :S]
+        ug_flags = stag[r_idx, S:] > 0
+        sid = group * sg[rotv_b] + (col + rot) % group  # global sender ids
+        gpass = link_pass_from(cut(u_full[c]), plan, sid, col)
+        e_ok = alive_all[sid] & gpass
+        if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+            e_ok = e_ok & elive[c]
+        edge_ok_c.append(e_ok)
+        contrib = jnp.where(e_ok[:, None], sender_rows, UNKNOWN_KEY)
+        best_any = jnp.maximum(best_any, contrib)
+        best_alive = jnp.maximum(
+            best_alive, jnp.where(is_alive_key(contrib), contrib, UNKNOWN_KEY)
+        )
+        # User gossip, same bucket: tracked records the pushing sender in
+        # the suppression ring channel by channel (ring order matches the
+        # oracle's sequential channel loop).
+        if tracked:
+            arrived = ug_flags & e_ok[:, None] & alive[:, None]
+            got_u = got_u | arrived
+            uinf_ids, uptr = ring_record(uinf_ids, uptr, arrived, sid)
+        else:
+            got_u = got_u | (ug_flags & e_ok[:, None])
+
+    own_col = col[:, None] == slot_subj[None, :]
+    self_rumor = jnp.max(jnp.where(own_col, best_any, UNKNOWN_KEY), axis=1)
+    best_any = jnp.where(own_col, UNKNOWN_KEY, best_any)
+    best_alive = jnp.where(own_col, UNKNOWN_KEY, best_alive)
+    merged, _ = merge_views(slab, best_any, best_alive)
+    merged = jnp.where(active[None, :], merged, slab)
+    merged = jnp.where(alive[:, None], merged, slab)
+
+    # --------------------- 6. suspicion sweep (cancel-on-update form)
+    armed = susp_in > 0
+    rearm = merged != slab0
+    left0 = jnp.maximum(susp_in.astype(jnp.int32) - 1, 0)
+    expired = (
+        alive[:, None]
+        & armed
+        & ~rearm
+        & (left0 == 0)
+        & ((merged & DEAD_BIT) == 0)
+        & ((merged & 1) != 0)
+        & (merged >= 0)
+    )
+    dead_keys = (merged | DEAD_BIT) & ~jnp.int32(1)
+    slab2 = jnp.where(expired, dead_keys, merged)
+    changed = (slab2 != slab0) & alive[:, None] & active[None, :]
+    age = jnp.where(
+        changed,
+        jnp.asarray(0, jnp.int8),
+        jnp.minimum(age, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
+    )
+    is_susp = is_suspect_key(slab2)
+    susp = jnp.where(
+        is_susp & active[None, :],
+        jnp.where(rearm | ~armed, susp_fill, left0),
+        0,
+    ).astype(jnp.int16)
+    susp = jnp.where(alive[:, None], susp, susp_in)
+
+    # ------------------------- 6.5 window SYNC application (cond-gated)
+    if W > 0:
+
+        def _apply_window(args):
+            slab_a, age_a, susp_a = args
+            wslot = subj_slot[wsubj]
+            safe = jnp.where(wslot >= 0, wslot, 0)
+            cur = slab_a[:, safe]
+            app = (
+                win_accept
+                & (wslot >= 0)[None, :]
+                & alive[:, None]
+                & sync_accept(win_key, cur)
+            )
+            new = jnp.where(app, win_key, cur)
+            route = jnp.where(wslot >= 0, wslot, S)
+            slab_a = slab_a.at[:, route].set(new, mode="drop")
+            age_a = age_a.at[:, route].set(
+                jnp.where(app, jnp.asarray(0, jnp.int8), age_a[:, safe]),
+                mode="drop",
+            )
+            is_s = is_suspect_key(new)
+            new_susp = jnp.where(
+                app,
+                jnp.where(is_s, susp_fill, 0),
+                susp_a[:, safe].astype(jnp.int32),
+            ).astype(jnp.int16)
+            susp_a = susp_a.at[:, route].set(new_susp, mode="drop")
+            return slab_a, age_a, susp_a
+
+        slab2, age, susp = lax.cond(
+            do_sync, _apply_window, lambda a: a, (slab2, age, susp)
+        )
+
+    # --------------------------------------------------- 7. self-refutation
+    self_rumor = jnp.maximum(self_rumor, self_win)
+    r_status = decode_status(self_rumor)
+    own_slot = subj_slot[col]
+    has_own = own_slot >= 0
+    own_safe = jnp.where(has_own, own_slot, 0)
+    own_key = jnp.where(
+        has_own, slab2[lrow, own_safe], encode_key(0, state.inc_self, state.epoch)
+    )
+    left_flag = (own_key & DEAD_BIT) != 0
+    threat = (
+        alive
+        & ~left_flag
+        & (self_rumor >= 0)
+        & (decode_epoch(self_rumor) == state.epoch)
+        & ((r_status == _SUSPECT) | (r_status == _DEAD))
+        & (decode_incarnation(self_rumor) >= state.inc_self)
+        & has_own
+    )
+    inc_self = jnp.where(threat, decode_incarnation(self_rumor) + 1, state.inc_self)
+    own_new = encode_key(jnp.full((nl,), _ALIVE, jnp.int32), inc_self, state.epoch)
+    slab2 = slab2.at[lrow, own_safe].set(
+        jnp.where(threat, own_new, slab2[lrow, own_safe])
+    )
+    age = age.at[lrow, own_safe].set(jnp.where(threat, 0, age[lrow, own_safe]))
+
+    # ------------------------------------------------- 8. user gossip finish
+    if tracked:
+        new_seen, uage, swept = user_gossip_finish(
+            state.useen, state.uage, got_u, p.periods_to_sweep
+        )
+        uinf_ids = jnp.where(swept[:, :, None], -1, uinf_ids)
+        uptr = jnp.where(swept, 0, uptr)
+    else:
+        new_seen, uage, _ = user_gossip_finish(
+            state.useen, state.uage, got_u & alive[:, None], p.periods_to_sweep
+        )
+
+    # ------------------------- 9. verdict-latency recorder (structure-gated)
+    lat_s, lat_d = state.lat_first_suspect, state.lat_first_dead
+    if lat_s is not None:
+        live_rows = alive[:, None]
+        seen_s_part = jnp.any(is_suspect_key(slab2) & live_rows, axis=0)
+        seen_d_part = jnp.any(
+            ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0) & live_rows, axis=0
+        )
+        seen = lax.psum(
+            jnp.stack([seen_s_part, seen_d_part]).astype(jnp.int32), AXIS
+        ) > 0
+        # Member-centric form of the oracle's slot scatter: my member's
+        # slot carries the event iff any live viewer anywhere saw it.
+        my_slot = subj_slot[col]
+        slot_safe = jnp.where(my_slot >= 0, my_slot, 0)
+        first_s = (my_slot >= 0) & seen[0, slot_safe] & (lat_s < 0)
+        first_d = (my_slot >= 0) & seen[1, slot_safe] & (lat_d < 0)
+        lat_s = jnp.where(first_s, t, lat_s)
+        lat_d = jnp.where(first_d, t, lat_d)
+
+    wb_pinned, wb_valid = state.wb_pinned, state.wb_valid
+    if wb_pinned is not None:
+        wb_valid = jnp.zeros((), bool)  # XLA core: mask stale, like the oracle
+
+    new_state = state.replace(
+        view_T=view_T,
+        slot_subj=slot_subj,
+        subj_slot=subj_slot,
+        slab=slab2,
+        age=age,
+        susp=susp,
+        inc_self=inc_self,
+        useen=new_seen,
+        uage=uage,
+        uinf_ids=uinf_ids,
+        uptr=uptr,
+        tick=t,
+        rng=rng_next,
+        lat_first_suspect=lat_s,
+        lat_first_dead=lat_d,
+        wb_pinned=wb_pinned,
+        wb_valid=wb_valid,
+    )
+    if not collect:
+        return new_state, {"tick": t}
+
+    # Counters: integer partial sums over local rows, combined in ONE psum
+    # (and two pmaxes) — identical totals to the oracle's full-row sums.
+    slab_send, age_send = slab, age_in  # post-point sender view (XLA path)
+    is_susp2 = is_suspect_key(slab2)
+    sender_active = jnp.any(
+        (age_send < p.periods_to_spread) & active[None, :] & (slab_send >= 0),
+        axis=1,
+    )
+    g_att_c = []
+    for c in range(f):
+        att = sender_active & alive & (rcv_c[c] != col)
+        if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+            att = att & elive[c]
+        g_att_c.append(att)
+    g_acct = _acct_zero()
+    for c in range(f):
+        # Sender-side attribution of the SAME per-edge draws the receiver
+        # consumed (u_full[c] indexed at the receiver): exact by bijection.
+        g_blk = _edge_lookup(plan.block, col, rcv_c[c])
+        g_pass = link_pass_from(u_full[c][rcv_c[c]], plan, col, rcv_c[c])
+        g_acct = _acct_add(g_acct, _link_acct(g_att_c[c], g_blk, g_pass))
+    acct = _acct_add(fd_out[7:], g_acct, sy_out[7:])
+    viewer_live = alive[:, None] & active[None, :]
+    was_dead = ((slab0 & DEAD_BIT) != 0) & (slab0 >= 0)
+    now_dead = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
+    fd_pings, fd_ping_reqs, fd_acks = fd_out[4:7]
+    partials = {
+        "n_suspected": jnp.sum(is_susp2 & alive[:, None] & active[None, :]),
+        "msgs_fd": msgs_fd,
+        "msgs_sync": msgs_sync,
+        "msgs_gossip": sum(jnp.sum(m) for m in g_att_c),
+        "msgs_user": msgs_user,
+        "coverage_num": jnp.sum(new_seen & alive[:, None], axis=0),
+        "n_alive": jnp.sum(alive, dtype=jnp.int32),
+        "pings": fd_pings,
+        "ping_reqs": fd_ping_reqs,
+        "acks": fd_acks,
+        "suspicions_raised": jnp.sum(
+            is_susp2 & ~is_suspect_key(slab0) & viewer_live
+        ),
+        "verdicts_dead": jnp.sum(now_dead & ~was_dead & viewer_live),
+        "verdicts_alive": jnp.sum(
+            is_alive_key(slab2)
+            & ~is_alive_key(slab0)
+            & (slab0 >= 0)
+            & viewer_live
+        ),
+        "gossip_infections": jnp.sum(new_seen & ~state.useen),
+        "sync_window_accepts": jnp.sum(win_accept),
+        "link_attempts": acct[0],
+        "link_delivered": acct[1],
+        "fault_blocked": acct[2],
+        "fault_lost": acct[3],
+        "exchange_overflow": overflow_part,
+    }
+    summed = lax.psum(partials, AXIS)
+    metrics = {
+        "tick": t,
+        "n_active_slots": jnp.sum(slot_subj >= 0),
+        "slot_overflow": slot_overflow,
+        "n_suspected": summed["n_suspected"],
+        "msgs_fd": summed["msgs_fd"],
+        "msgs_sync": summed["msgs_sync"],
+        "msgs_gossip": summed["msgs_gossip"],
+        "msgs_user": summed["msgs_user"],
+        "gossip_coverage": summed["coverage_num"]
+        / jnp.maximum(summed["n_alive"], 1),
+        "pings": summed["pings"],
+        "ping_reqs": summed["ping_reqs"],
+        "acks": summed["acks"],
+        "suspicions_raised": summed["suspicions_raised"],
+        "verdicts_dead": summed["verdicts_dead"],
+        "verdicts_alive": summed["verdicts_alive"],
+        "gossip_infections": summed["gossip_infections"],
+        "slot_activations": n_granted,
+        "slot_frees": jnp.sum(freeing),
+        "sync_window_accepts": summed["sync_window_accepts"],
+        "link_attempts": summed["link_attempts"],
+        "link_delivered": summed["link_delivered"],
+        "fault_blocked": summed["fault_blocked"],
+        "fault_lost": summed["fault_lost"],
+        "inc_max": lax.pmax(jnp.max(inc_self), AXIS),
+        "epoch_max": lax.pmax(jnp.max(state.epoch), AXIS),
+        "view_changes": jnp.zeros((), jnp.int32),
+        "alarms_raised": jnp.zeros((), jnp.int32),
+        "cut_detected": jnp.zeros((), jnp.int32),
+        # The one counter the bucketed exchange OWNS: blocks dropped to
+        # capacity this tick (provably 0 at the default capacity).
+        "exchange_overflow": summed["exchange_overflow"],
+    }
+    return new_state, metrics
+
+
+def _scan_body(params, cfg, n_ticks, collect, scheduled):
+    """The per-shard scan over ticks — the function shard_map wraps."""
+
+    def body(state, plan, *maybe_knobs):
+        kn = maybe_knobs[0] if maybe_knobs else None
+
+        def step(carry, _):
+            if not scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
+                return _tick_spmd(params, cfg, carry, plan, collect=collect, knobs=kn)
+            t = carry.tick + 1
+            kill_m, restart_m = events_at(plan, t, params.base.n)
+            plan_t = plan_at(plan, t)
+            new_state, metrics = _tick_spmd(
+                params,
+                cfg,
+                carry,
+                plan_t,
+                collect=collect,
+                events=(kill_m, restart_m),
+                knobs=kn,
+            )
+            if collect:
+                metrics = dict(metrics)
+                metrics["plan_dirty"] = plan_dirty_at(plan, t)
+                metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
+                metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+            return new_state, metrics
+
+        return lax.scan(step, state, None, length=n_ticks)
+
+    return body
+
+
+def scan_sparse_ticks_spmd(
+    params: SparseParams,
+    cfg: ShardConfig,
+    mesh: Mesh,
+    state: SparseState,
+    plan: FaultPlan | FaultSchedule,
+    n_ticks: int,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """UNJITTED shard_map driver (jit wrapper: :func:`run_sparse_ticks_spmd`).
+
+    ``mesh`` must carry a ``"members"`` axis of size ``cfg.d``. The state
+    may live anywhere — shard_map moves it per sparse_state_pspecs — but
+    pre-placing with parallel/mesh.py::shard_sparse_state avoids a resharding
+    copy. Accepts fixed plans and FaultSchedules (replicated; events and
+    segment resolution run per shard on replicated data, bit-identically).
+
+    Fault matrices must be replicated-form here (compact [1, 1] or full
+    [N, N] carried whole per shard): edge lookups index arbitrary (src, dst)
+    pairs, which is the one pattern explicit SPMD cannot slice. Schedules at
+    n where a dense plan matters should pass segments through unchanged —
+    the compact-uniform rule (sim/schedule.py) keeps them O(1).
+    """
+    if AXIS not in mesh.axis_names or mesh.shape[AXIS] != cfg.d:
+        raise ValueError(
+            f"mesh needs a '{AXIS}' axis of size d={cfg.d}; got {dict(mesh.shape)}"
+        )
+    _validate(params, cfg)
+    scheduled = isinstance(plan, FaultSchedule)
+    pspecs = sparse_state_pspecs(like=state)
+    body = _scan_body(params, cfg, n_ticks, collect, scheduled)
+    operands = (state, plan)
+    in_specs = (pspecs, P())
+    if knobs is not None:
+        operands = operands + (knobs,)
+        in_specs = in_specs + (P(),)
+    shmapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(pspecs, P()),
+        check_rep=False,
+    )
+    return shmapped(*operands)
+
+
+@partial(
+    jax.jit,
+    static_argnums=(0, 1, 2, 5),
+    static_argnames=("collect",),
+    donate_argnums=(3,),
+)
+def run_sparse_ticks_spmd(
+    params: SparseParams,
+    cfg: ShardConfig,
+    mesh: Mesh,
+    state: SparseState,
+    plan: FaultPlan | FaultSchedule,
+    n_ticks: int,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """``lax.scan`` driver of the explicit-SPMD engine — the shard_map twin
+    of sim/sparse.py::run_sparse_ticks (same signature plus the static
+    ``cfg``/``mesh``). The input state is DONATED like the oracle's."""
+    return scan_sparse_ticks_spmd(
+        params, cfg, mesh, state, plan, n_ticks, collect=collect, knobs=knobs
+    )
+
+
+def run_ensemble_sparse_ticks_spmd(
+    params: SparseParams,
+    cfg: ShardConfig,
+    mesh: Mesh,
+    states: SparseState,
+    plans,
+    n_ticks: int,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Ensemble twin on a 2D ``universes × members`` mesh
+    (parallel/mesh.py::make_universe_member_mesh): each device runs the
+    member-shard of its universe block, vmapping the per-universe scan —
+    exchange collectives stay inside a ``members`` row, the universe axis
+    is pure data parallelism. ``states``/``plans``/``knobs`` are stacked
+    pytrees (sim/ensemble.py::stack_universes); B % du == 0.
+
+    Unjitted like sim/ensemble.py's cores — wrap in jit at the call site
+    if reuse matters; tests drive it directly.
+    """
+    if UNIVERSE_AXIS not in mesh.axis_names or AXIS not in mesh.axis_names:
+        raise ValueError(
+            f"need a ('{UNIVERSE_AXIS}', '{AXIS}') mesh "
+            "(parallel/mesh.py::make_universe_member_mesh)"
+        )
+    if mesh.shape[AXIS] != cfg.d:
+        raise ValueError(
+            f"mesh '{AXIS}' axis is {mesh.shape[AXIS]}, cfg.d is {cfg.d}"
+        )
+    _validate(params, cfg)
+    scheduled = isinstance(plans, FaultSchedule)
+    pspecs = sparse_state_pspecs(like=states, prefix=(UNIVERSE_AXIS,))
+    inner = _scan_body(params, cfg, n_ticks, collect, scheduled)
+
+    def body(sts, pls, *maybe_knobs):
+        if maybe_knobs:
+            return jax.vmap(lambda s, pl, kn: inner(s, pl, kn))(
+                sts, pls, maybe_knobs[0]
+            )
+        return jax.vmap(lambda s, pl: inner(s, pl))(sts, pls)
+
+    operands = (states, plans)
+    in_specs = (pspecs, P(UNIVERSE_AXIS))
+    if knobs is not None:
+        operands = operands + (knobs,)
+        in_specs = in_specs + (P(UNIVERSE_AXIS),)
+    shmapped = shard_map(
+        body,
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=(pspecs, P(UNIVERSE_AXIS)),
+        check_rep=False,
+    )
+    return shmapped(*operands)

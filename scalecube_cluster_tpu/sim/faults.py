@@ -120,6 +120,23 @@ def _edge_lookup(mat: jax.Array, src: jax.Array, dst: jax.Array) -> jax.Array:
     return mat[s, d]
 
 
+def link_pass_from(
+    u: jax.Array, plan: FaultPlan, src: jax.Array, dst: jax.Array
+) -> jax.Array:
+    """:func:`link_pass` with the uniform draw supplied by the caller.
+
+    The split exists for the explicit-SPMD engine (parallel/spmd.py): the
+    draw's VALUES depend only on the key and the full edge-set shape, so a
+    shard can draw the full-[N] uniforms (replicated, bit-identical to the
+    single-device draw) and slice its local rows before the per-edge
+    decision — the decision itself stays shard-local. ``u`` must broadcast
+    against the (src, dst) edge set.
+    """
+    blocked = _edge_lookup(plan.block, src, dst)
+    loss = _edge_lookup(plan.loss, src, dst)
+    return ~blocked & (u >= loss)
+
+
 def link_pass(
     rng: jax.Array, plan: FaultPlan, src: jax.Array, dst: jax.Array
 ) -> jax.Array:
@@ -131,9 +148,8 @@ def link_pass(
     ``src``/``dst`` are broadcast-compatible int32 index arrays.
     """
     blocked = _edge_lookup(plan.block, src, dst)
-    loss = _edge_lookup(plan.loss, src, dst)
     u = jax.random.uniform(rng, jnp.shape(blocked))
-    return ~blocked & (u >= loss)
+    return link_pass_from(u, plan, src, dst)
 
 
 def link_delay_within_tick(
@@ -160,6 +176,30 @@ def link_delay_within_tick(
     return u < p
 
 
+def round_trip_in_time_from(
+    u: jax.Array,
+    plan: FaultPlan,
+    legs: list[tuple[jax.Array, jax.Array]],
+    deadline_ms: float,
+) -> jax.Array:
+    """:func:`round_trip_in_time` with the uniform draw supplied by the
+    caller — the same presample/slice split as :func:`link_pass_from`:
+    the explicit-SPMD engine draws at the full path-set shape (replicated)
+    and slices its shard's rows before the Erlang-tail decision."""
+    k = len(legs)
+    mean_total = sum(_edge_lookup(plan.mean_delay, s, d) for s, d in legs)
+    theta = mean_total / k
+    has_delay = theta > 0
+    x = deadline_ms / jnp.where(has_delay, theta, 1.0)
+    term = jnp.ones_like(x)
+    acc = jnp.ones_like(x)
+    for i in range(1, k):
+        term = term * x / i
+        acc = acc + term
+    p_miss = jnp.where(has_delay, jnp.exp(-x) * acc, 0.0)
+    return u >= p_miss
+
+
 def round_trip_in_time(
     rng: jax.Array,
     plan: FaultPlan,
@@ -180,18 +220,10 @@ def round_trip_in_time(
         P(miss) = e^(-x) * sum_{i<k} x^i / i!,   x = deadline / theta,
         theta = (sum of leg mean delays) / k.
     """
-    k = len(legs)
-    mean_total = sum(_edge_lookup(plan.mean_delay, s, d) for s, d in legs)
-    theta = mean_total / k
-    has_delay = theta > 0
-    x = deadline_ms / jnp.where(has_delay, theta, 1.0)
-    term = jnp.ones_like(x)
-    acc = jnp.ones_like(x)
-    for i in range(1, k):
-        term = term * x / i
-        acc = acc + term
-    p_miss = jnp.where(has_delay, jnp.exp(-x) * acc, 0.0)
-    u = jax.random.uniform(rng, jnp.shape(p_miss))
-    return u >= p_miss
+    shape = jnp.broadcast_shapes(
+        *(jnp.broadcast_shapes(jnp.shape(s), jnp.shape(d)) for s, d in legs)
+    )
+    u = jax.random.uniform(rng, shape)
+    return round_trip_in_time_from(u, plan, legs, deadline_ms)
 
 

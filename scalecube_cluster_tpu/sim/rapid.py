@@ -501,6 +501,8 @@ def rapid_tick(
         "link_delivered": acct[1],
         "fault_blocked": acct[2],
         "fault_lost": acct[3],
+        # Bucketed-exchange counter (explicit-SPMD SWIM engine): no analog.
+        "exchange_overflow": zero,
         # Monotonicity gauges (inc_max has no Rapid analog: constant 0).
         "inc_max": zero,
         "epoch_max": jnp.max(state.epoch),

@@ -102,8 +102,8 @@ from scalecube_cluster_tpu.ops.select import probe_cursor_targets
 from scalecube_cluster_tpu.sim.faults import (
     FaultPlan,
     _edge_lookup,
-    link_pass,
-    round_trip_in_time,
+    link_pass_from,
+    round_trip_in_time_from,
 )
 from scalecube_cluster_tpu.sim.knobs import Knobs, edge_live, suspicion_fill
 from scalecube_cluster_tpu.sim.params import SimParams
@@ -711,6 +711,247 @@ def _free_plan(params: SparseParams, state: SparseState, gate=True):
     return freeing, wb_subj, make_writeback
 
 
+def _fd_decide(
+    p,
+    plan,
+    t,
+    k_tgt,
+    k_ping,
+    k_relay,
+    n,
+    lrow,
+    col,
+    cut,
+    record_of,
+    v_alive,
+    alive_all,
+    epoch_all,
+    collect,
+):
+    """The FD probe decision for one set of viewer rows — THE shared body of
+    sparse_tick's step 1, factored so the explicit-SPMD engine
+    (parallel/spmd.py) runs it per shard bit-identically.
+
+    Every random draw happens at the FULL [n]-row shape (the values depend
+    only on the key and shape, never on which shard evaluates them) and is
+    then sliced by ``cut`` to the caller's rows — the single-device oracle
+    passes the identity cut, a shard passes its dynamic row slice, and both
+    see the same bits. ``lrow`` indexes the caller's local slab rows,
+    ``col`` carries their GLOBAL member ids (equal for the oracle);
+    ``record_of(lrow, subject)`` reads the caller's rows' records through
+    the slab indirection. ``alive_all``/``epoch_all`` are full [n] member
+    scalars (the SPMD engine all-gathers them — O(N) bytes, the probe/ack
+    answering channel). Scalar outputs are SUMS OVER THE CALLER'S ROWS
+    (exact totals for the oracle, per-shard partials to psum for SPMD —
+    integer sums, so reduction order cannot break bit-parity).
+    """
+    rr_tgt = cut(probe_cursor_targets(t // p.fd_period_ticks, n))
+    rr_key = record_of(lrow, rr_tgt)
+    rr_valid = (rr_tgt != col) & (rr_key >= 0) & ((rr_key & DEAD_BIT) == 0)
+    rand_tgt = cut(jax.random.randint(k_tgt, (n,), 0, n, jnp.int32))
+    tgt = jnp.where(rr_valid, rr_tgt, rand_tgt)
+    vkey = record_of(lrow, tgt)
+    valid = (tgt != col) & (vkey >= 0) & ((vkey & DEAD_BIT) == 0)
+    probing = v_alive & valid
+    pk1, pk2, pk3 = jax.random.split(k_ping, 3)
+    fwd_ok = link_pass_from(cut(jax.random.uniform(pk1, (n,))), plan, col, tgt)
+    ack_ok = link_pass_from(cut(jax.random.uniform(pk2, (n,))), plan, tgt, col)
+    rt_ok = round_trip_in_time_from(
+        cut(jax.random.uniform(pk3, (n,))),
+        plan,
+        [(col, tgt), (tgt, col)],
+        p.ping_timeout_ms,
+    )
+    direct = probing & alive_all[tgt] & fwd_ok & ack_ok & rt_ok
+
+    kr, rk1, rk2, rk3, rk4, rk5 = jax.random.split(k_relay, 6)
+    nrel = p.ping_req_members
+    ridx = cut(jax.random.randint(kr, (n, nrel), 0, n, jnp.int32))
+    rkey = record_of(lrow[:, None], ridx)
+    rvalid = (
+        (ridx != col[:, None])
+        & (ridx != tgt[:, None])
+        & (rkey >= 0)
+        & ((rkey & DEAD_BIT) == 0)
+    )
+    u_or = cut(jax.random.uniform(rk1, (n, nrel)))
+    u_rt = cut(jax.random.uniform(rk2, (n, nrel)))
+    u_tr = cut(jax.random.uniform(rk3, (n, nrel)))
+    u_ro = cut(jax.random.uniform(rk4, (n, nrel)))
+    leg_or = link_pass_from(u_or, plan, col[:, None], ridx)  # origin->relay
+    leg_rt = link_pass_from(u_rt, plan, ridx, tgt[:, None])  # relay->target
+    leg_tr = link_pass_from(u_tr, plan, tgt[:, None], ridx)  # target->relay
+    leg_ro = link_pass_from(u_ro, plan, ridx, col[:, None])  # relay->origin
+    legs = leg_or & leg_rt & leg_tr & leg_ro
+    path_ok = round_trip_in_time_from(
+        cut(jax.random.uniform(rk5, (n, nrel))),
+        plan,
+        [(col[:, None], ridx), (ridx, tgt[:, None]),
+         (tgt[:, None], ridx), (ridx, col[:, None])],
+        p.ping_req_timeout_ms,
+    )
+    relay = rvalid & alive_all[ridx] & alive_all[tgt][:, None] & legs & path_ok
+    reached = direct | (probing & jnp.any(relay, axis=1))
+    gone = reached & (epoch_all[tgt] != decode_epoch(vkey))
+    fd_key = encode_key(
+        jnp.where(gone, _DEAD, _SUSPECT),
+        decode_incarnation(vkey),
+        decode_epoch(vkey),
+    )
+    fire = ((probing & ~reached) | gone) & overrides_same_epoch(fd_key, vkey)
+    n_pings = jnp.sum(probing)
+    req_att = (probing & ~direct)[:, None] & rvalid
+    n_ping_reqs = jnp.sum(req_att)
+    msgs = n_pings + n_ping_reqs
+    out = (tgt, fd_key, fire, msgs)
+    if collect:
+        # Flight-recorder extras ride the same cond; gated at trace time
+        # on the STATIC collect flag so the bench graph is unchanged.
+        # Fault accounting mirrors tick.py::_fd_vectors exactly: each
+        # wire message is delivered, blocked, or lost; the deadline
+        # draws (rt_ok/path_ok) are late deliveries, not drops.
+        blk_fwd = _edge_lookup(plan.block, col, tgt)
+        blk_ack = _edge_lookup(plan.block, tgt, col)
+        ack_att = probing & fwd_ok & alive_all[tgt]
+        blk1 = _edge_lookup(plan.block, col[:, None], ridx)
+        blk2 = _edge_lookup(plan.block, ridx, tgt[:, None])
+        blk3 = _edge_lookup(plan.block, tgt[:, None], ridx)
+        blk4 = _edge_lookup(plan.block, ridx, col[:, None])
+        att1 = req_att
+        att2 = att1 & leg_or & alive_all[ridx]
+        att3 = att2 & leg_rt & alive_all[tgt][:, None]
+        att4 = att3 & leg_tr
+        acct = _acct_add(
+            _link_acct(probing, blk_fwd, fwd_ok),
+            _link_acct(ack_att, blk_ack, ack_ok),
+            _link_acct(att1, blk1, leg_or),
+            _link_acct(att2, blk2, leg_rt),
+            _link_acct(att3, blk3, leg_tr),
+            _link_acct(att4, blk4, leg_ro),
+        )
+        out = out + (n_pings, n_ping_reqs, jnp.sum(reached)) + acct
+    return out
+
+
+def _fd_zeros(m, collect):
+    """Skip-phase output of :func:`_fd_decide` for ``m`` viewer rows."""
+    out = (
+        jnp.zeros((m,), jnp.int32),
+        jnp.zeros((m,), jnp.int32),
+        jnp.zeros((m,), bool),
+        jnp.asarray(0, jnp.int32),
+    )
+    if collect:
+        zero = jnp.asarray(0, jnp.int32)
+        out = out + (zero, zero, zero) + _acct_zero()
+    return out
+
+
+def _window_zeros(m, W):
+    """Empty window-SYNC outputs (learned_w, accept_w, self_win) for ``m``
+    viewer rows."""
+    return (
+        jnp.full((m, W), UNKNOWN_KEY, jnp.int32),
+        jnp.zeros((m, W), bool),
+        jnp.full((m,), UNKNOWN_KEY, jnp.int32),
+    )
+
+
+def _sync_zeros(m, W, collect):
+    """Skip-phase output of :func:`_sync_fire` for ``m`` viewer rows."""
+    learned_w, accept_w, self_win = _window_zeros(m, W)
+    out = (
+        jnp.zeros((m,), jnp.int32),
+        jnp.zeros((m,), jnp.int32),
+        jnp.zeros((m,), bool),
+        jnp.asarray(0, jnp.int32),
+        learned_w,
+        accept_w,
+        self_win,
+    )
+    if collect:
+        out = out + _acct_zero()
+    return out
+
+
+def _sync_fire(
+    p,
+    plan,
+    t,
+    k_ssel,
+    k_slink,
+    n,
+    lrow,
+    col,
+    cut,
+    record_of,
+    v_alive,
+    alive_all,
+    partner_records,
+    W,
+    wsubj,
+    collect,
+):
+    """The own-record + bounded-window SYNC decision for one set of viewer
+    rows — sparse_tick's step 2 factored around its ONE remote read.
+
+    ``partner_records(prt_full, prt)`` is the exchange boundary: given the
+    full replicated partner draw and the caller's row slice of it, return
+    ``(learned_key [m], learned_w [m, W])`` — the partners' own records and
+    their records for the rotating window subjects. The oracle implements
+    it as direct slab gathers; the SPMD engine (parallel/spmd.py) as a
+    bucketed all-to-all reply round (capacity N/d per destination shard —
+    exact by construction, since a shard only hosts N/d requesters).
+    Draw/slice and local/global row conventions as in :func:`_fd_decide`.
+    """
+    prt_full = jax.random.randint(k_ssel, (n,), 0, n, jnp.int32)
+    prt = cut(prt_full)
+    s_pass = link_pass_from(
+        cut(jax.random.uniform(k_slink, (n,))), plan, col, prt
+    )
+    ok = v_alive & alive_all[prt] & (prt != col) & s_pass
+    # I learn the partner's ACTUAL own-record — which may be a leave
+    # tombstone (DEAD at the bumped incarnation, sim/sparse.py::
+    # leave_sparse); synthesizing ALIVE here would resurrect graceful
+    # leavers cluster-wide.
+    learned_key, learned_w = partner_records(prt_full, prt)
+    mine = record_of(lrow, prt)
+    accept = ok & sync_accept(learned_key, mine)
+
+    # Bounded-window table exchange (params.sync_window): the partner's
+    # records for the rotating window ride the same SYNC message pair —
+    # the scalable form of the reference's full-table SyncData
+    # (SyncData.java:11-41; onSync, MembershipProtocolImpl.java:352-373).
+    # Self-cells are excluded from the merge and routed to the
+    # refutation channel instead (onSelfMemberDetected,
+    # MembershipProtocolImpl.java:549-569).
+    if W > 0:
+        mine_w = record_of(lrow[:, None], wsubj[None, :])
+        self_cell = wsubj[None, :] == col[:, None]
+        accept_w = ok[:, None] & ~self_cell & sync_accept(learned_w, mine_w)
+        self_win = jnp.max(
+            jnp.where(
+                self_cell & ok[:, None] & (learned_w >= 0),
+                learned_w,
+                UNKNOWN_KEY,
+            ),
+            axis=1,
+        )
+    else:
+        learned_w, accept_w, self_win = _window_zeros(lrow.shape[0], W)
+    out = (prt, learned_key, accept, jnp.sum(ok) * 2, learned_w, accept_w, self_win)
+    if collect:
+        # Fault accounting: the forward leg is a real link draw; the
+        # reverse reply rides the SAME draw (module deviation 2 — one
+        # draw covers both directions), so a reverse attempt exists iff
+        # the exchange happened (``ok``) and is always delivered.
+        att_f = v_alive & (prt != col)
+        acct_f = _link_acct(att_f, _edge_lookup(plan.block, col, prt), s_pass)
+        n_rev = jnp.sum(ok, dtype=jnp.int32)
+        out = out + (acct_f[0] + n_rev, acct_f[1] + n_rev, acct_f[2], acct_f[3])
+    return out
+
+
 @partial(jax.jit, static_argnums=0, static_argnames=("collect",))
 def sparse_tick(
     params: SparseParams,
@@ -767,167 +1008,26 @@ def sparse_tick(
     # Shuffled round-robin cursor (ops/select.py::probe_cursor_targets —
     # selectPingMember, FailureDetectorImpl.java:340-349) with an i.i.d.
     # fallback for rows whose cursor slot is not probeable this round; all
-    # [N]-sized work (module docstring FD deviation).
+    # [N]-sized work (module docstring FD deviation). The decision body
+    # lives in :func:`_fd_decide`, shared with the explicit-SPMD engine
+    # (parallel/spmd.py) — the oracle is the identity-cut instantiation.
     def fd_fire_phase(_):
-        rr_tgt = probe_cursor_targets(t // p.fd_period_ticks, n)
-        rr_key = my_record_of(col, rr_tgt)
-        rr_valid = (rr_tgt != col) & (rr_key >= 0) & ((rr_key & DEAD_BIT) == 0)
-        rand_tgt = jax.random.randint(k_tgt, (n,), 0, n, jnp.int32)
-        tgt = jnp.where(rr_valid, rr_tgt, rand_tgt)
-        vkey = my_record_of(col, tgt)
-        valid = (tgt != col) & (vkey >= 0) & ((vkey & DEAD_BIT) == 0)
-        probing = alive & valid
-        pk1, pk2, pk3 = jax.random.split(k_ping, 3)
-        fwd_ok = link_pass(pk1, plan, col, tgt)
-        ack_ok = link_pass(pk2, plan, tgt, col)
-        rt_ok = round_trip_in_time(
-            pk3, plan, [(col, tgt), (tgt, col)], p.ping_timeout_ms
+        return _fd_decide(
+            p, plan, t, k_tgt, k_ping, k_relay, n,
+            lrow=col, col=col, cut=lambda a: a, record_of=my_record_of,
+            v_alive=alive, alive_all=alive, epoch_all=state.epoch,
+            collect=collect,
         )
-        direct = probing & alive[tgt] & fwd_ok & ack_ok & rt_ok
 
-        kr, rk1, rk2, rk3, rk4, rk5 = jax.random.split(k_relay, 6)
-        ridx = jax.random.randint(kr, (n, p.ping_req_members), 0, n, jnp.int32)
-        rkey = my_record_of(col[:, None], ridx)
-        rvalid = (
-            (ridx != col[:, None])
-            & (ridx != tgt[:, None])
-            & (rkey >= 0)
-            & ((rkey & DEAD_BIT) == 0)
-        )
-        leg_or = link_pass(rk1, plan, col[:, None], ridx)  # origin->relay
-        leg_rt = link_pass(rk2, plan, ridx, tgt[:, None])  # relay->target
-        leg_tr = link_pass(rk3, plan, tgt[:, None], ridx)  # target->relay
-        leg_ro = link_pass(rk4, plan, ridx, col[:, None])  # relay->origin
-        legs = leg_or & leg_rt & leg_tr & leg_ro
-        path_ok = round_trip_in_time(
-            rk5,
-            plan,
-            [(col[:, None], ridx), (ridx, tgt[:, None]),
-             (tgt[:, None], ridx), (ridx, col[:, None])],
-            p.ping_req_timeout_ms,
-        )
-        relay = rvalid & alive[ridx] & alive[tgt][:, None] & legs & path_ok
-        reached = direct | (probing & jnp.any(relay, axis=1))
-        gone = reached & (state.epoch[tgt] != decode_epoch(vkey))
-        fd_key = encode_key(
-            jnp.where(gone, _DEAD, _SUSPECT),
-            decode_incarnation(vkey),
-            decode_epoch(vkey),
-        )
-        fire = ((probing & ~reached) | gone) & overrides_same_epoch(fd_key, vkey)
-        n_pings = jnp.sum(probing)
-        req_att = (probing & ~direct)[:, None] & rvalid
-        n_ping_reqs = jnp.sum(req_att)
-        msgs = n_pings + n_ping_reqs
-        out = (tgt, fd_key, fire, msgs)
-        if collect:
-            # Flight-recorder extras ride the same cond; gated at trace time
-            # on the STATIC collect flag so the bench graph is unchanged.
-            # Fault accounting mirrors tick.py::_fd_vectors exactly: each
-            # wire message is delivered, blocked, or lost; the deadline
-            # draws (rt_ok/path_ok) are late deliveries, not drops.
-            blk_fwd = _edge_lookup(plan.block, col, tgt)
-            blk_ack = _edge_lookup(plan.block, tgt, col)
-            ack_att = probing & fwd_ok & alive[tgt]
-            blk1 = _edge_lookup(plan.block, col[:, None], ridx)
-            blk2 = _edge_lookup(plan.block, ridx, tgt[:, None])
-            blk3 = _edge_lookup(plan.block, tgt[:, None], ridx)
-            blk4 = _edge_lookup(plan.block, ridx, col[:, None])
-            att1 = req_att
-            att2 = att1 & leg_or & alive[ridx]
-            att3 = att2 & leg_rt & alive[tgt][:, None]
-            att4 = att3 & leg_tr
-            acct = _acct_add(
-                _link_acct(probing, blk_fwd, fwd_ok),
-                _link_acct(ack_att, blk_ack, ack_ok),
-                _link_acct(att1, blk1, leg_or),
-                _link_acct(att2, blk2, leg_rt),
-                _link_acct(att3, blk3, leg_tr),
-                _link_acct(att4, blk4, leg_ro),
-            )
-            out = out + (n_pings, n_ping_reqs, jnp.sum(reached)) + acct
-        return out
-
-    def fd_skip_phase(_):
-        out = (
-            jnp.zeros((n,), jnp.int32),
-            jnp.zeros((n,), jnp.int32),
-            jnp.zeros((n,), bool),
-            jnp.asarray(0, jnp.int32),
-        )
-        if collect:
-            zero = jnp.asarray(0, jnp.int32)
-            out = out + (zero, zero, zero) + _acct_zero()
-        return out
-
-    fd_out = lax.cond(do_fd, fd_fire_phase, fd_skip_phase, None)
+    fd_out = lax.cond(do_fd, fd_fire_phase, lambda _: _fd_zeros(n, collect), None)
     fd_tgt, fd_key, fd_fire, msgs_fd = fd_out[:4]
 
     # ------------------------------------- 2. own-record SYNC (cond-gated)
     # Partner uniform-random; exchange own records both directions
     # (module docstring deviation 2). Produces per-node "learned" records
-    # about the partner subjects.
-    def sync_fire_phase(_):
-        prt = jax.random.randint(k_ssel, (n,), 0, n, jnp.int32)
-        s_pass = link_pass(k_slink, plan, col, prt)
-        ok = alive & alive[prt] & (prt != col) & s_pass
-        # I learn the partner's ACTUAL own-record — which may be a leave
-        # tombstone (DEAD at the bumped incarnation, sim/sparse.py::
-        # leave_sparse); synthesizing ALIVE here would resurrect graceful
-        # leavers cluster-wide.
-        learned_key = my_record_of(prt, prt)
-        mine = my_record_of(col, prt)
-        accept = ok & sync_accept(learned_key, mine)
-
-        # Bounded-window table exchange (params.sync_window): the partner's
-        # records for the rotating window ride the same SYNC message pair —
-        # the scalable form of the reference's full-table SyncData
-        # (SyncData.java:11-41; onSync, MembershipProtocolImpl.java:352-373).
-        # Self-cells are excluded from the merge and routed to the
-        # refutation channel instead (onSelfMemberDetected,
-        # MembershipProtocolImpl.java:549-569).
-        if W > 0:
-            learned_w = my_record_of(prt[:, None], wsubj[None, :])
-            mine_w = my_record_of(col[:, None], wsubj[None, :])
-            self_cell = wsubj[None, :] == col[:, None]
-            accept_w = ok[:, None] & ~self_cell & sync_accept(learned_w, mine_w)
-            self_win = jnp.max(
-                jnp.where(
-                    self_cell & ok[:, None] & (learned_w >= 0),
-                    learned_w,
-                    UNKNOWN_KEY,
-                ),
-                axis=1,
-            )
-        else:
-            learned_w, accept_w, self_win = _window_zeros()
-        out = (prt, learned_key, accept, jnp.sum(ok) * 2, learned_w, accept_w, self_win)
-        if collect:
-            # Fault accounting: the forward leg is a real link draw; the
-            # reverse reply rides the SAME draw (module deviation 2 — one
-            # draw covers both directions), so a reverse attempt exists iff
-            # the exchange happened (``ok``) and is always delivered.
-            att_f = alive & (prt != col)
-            acct_f = _link_acct(att_f, _edge_lookup(plan.block, col, prt), s_pass)
-            n_rev = jnp.sum(ok, dtype=jnp.int32)
-            out = out + (acct_f[0] + n_rev, acct_f[1] + n_rev, acct_f[2], acct_f[3])
-        return out
-
-    def sync_skip_phase(_):
-        learned_w, accept_w, self_win = _window_zeros()
-        out = (
-            jnp.zeros((n,), jnp.int32),
-            jnp.zeros((n,), jnp.int32),
-            jnp.zeros((n,), bool),
-            jnp.asarray(0, jnp.int32),
-            learned_w,
-            accept_w,
-            self_win,
-        )
-        if collect:
-            out = out + _acct_zero()
-        return out
-
+    # about the partner subjects. Decision body in :func:`_sync_fire`; the
+    # oracle's partner_records is a direct slab gather (the SPMD engine
+    # substitutes a bucketed all-to-all reply round).
     # Rotating global window: full table coverage every ceil(n/W) sync
     # periods; W <= n keeps in-window subjects distinct (wrap at the last
     # block only re-covers early subjects).
@@ -936,14 +1036,26 @@ def sparse_tick(
     sync_round = t // p.sync_period_ticks
     wsubj = (jnp.mod(sync_round, nblocks) * W + jnp.arange(W, dtype=jnp.int32)) % n
 
-    def _window_zeros():
-        return (
-            jnp.full((n, W), UNKNOWN_KEY, jnp.int32),
-            jnp.zeros((n, W), bool),
-            jnp.full((n,), UNKNOWN_KEY, jnp.int32),
+    def oracle_partner_records(prt_full, prt):
+        learned_key = my_record_of(prt, prt)
+        if W > 0:
+            learned_w = my_record_of(prt[:, None], wsubj[None, :])
+        else:
+            learned_w = jnp.full((n, W), UNKNOWN_KEY, jnp.int32)
+        return learned_key, learned_w
+
+    def sync_fire_phase(_):
+        return _sync_fire(
+            p, plan, t, k_ssel, k_slink, n,
+            lrow=col, col=col, cut=lambda a: a, record_of=my_record_of,
+            v_alive=alive, alive_all=alive,
+            partner_records=oracle_partner_records,
+            W=W, wsubj=wsubj, collect=collect,
         )
 
-    sy_out = lax.cond(do_sync, sync_fire_phase, sync_skip_phase, None)
+    sy_out = lax.cond(
+        do_sync, sync_fire_phase, lambda _: _sync_zeros(n, W, collect), None
+    )
     (sy_subj, sy_key, sy_accept, msgs_sync, win_key, win_accept, self_win) = sy_out[:7]
 
     # -------------------------------------------- 3. slot free + allocation
@@ -1125,8 +1237,14 @@ def sparse_tick(
         k_gsel, n, p.gossip_fanout, group=group
     )
     lks = jax.random.split(k_glink, p.gossip_fanout)
+    # Receiver-edge link draws at full [n] shape (bit-identical to
+    # link_pass: same key, same uniform shape) so the SPMD engine can
+    # replicate the draw and slice its receiver rows (link_pass_from).
     gpass = [
-        link_pass(lks[c], plan, inv_perm[c], col) for c in range(p.gossip_fanout)
+        link_pass_from(
+            jax.random.uniform(lks[c], (n,)), plan, inv_perm[c], col
+        )
+        for c in range(p.gossip_fanout)
     ]
     edge_ok = jnp.stack(
         [alive[inv_perm[c]] & gpass[c] for c in range(p.gossip_fanout)]
@@ -1537,6 +1655,10 @@ def sparse_tick(
         "view_changes": jnp.zeros((), jnp.int32),
         "alarms_raised": jnp.zeros((), jnp.int32),
         "cut_detected": jnp.zeros((), jnp.int32),
+        # Bucketed-exchange counter (explicit-SPMD engine, parallel/spmd.py):
+        # the single-program tick has no fixed-capacity buckets, so the
+        # schema slot is constant zero here.
+        "exchange_overflow": jnp.zeros((), jnp.int32),
     }
     return new_state, metrics
 

@@ -838,5 +838,8 @@ def sim_tick(
         "view_changes": jnp.zeros((), jnp.int32),
         "alarms_raised": jnp.zeros((), jnp.int32),
         "cut_detected": jnp.zeros((), jnp.int32),
+        # Bucketed-exchange counter (explicit-SPMD engine, parallel/spmd.py):
+        # no fixed-capacity buckets in the dense tick, constant zero.
+        "exchange_overflow": jnp.zeros((), jnp.int32),
     }
     return new_state, metrics

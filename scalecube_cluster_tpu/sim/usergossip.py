@@ -22,6 +22,35 @@ from scalecube_cluster_tpu.ops.delivery import permuted_delivery
 AGE_CAP = 1 << 20
 
 
+def user_gossip_finish(useen, uage, got, sweep):
+    """Seen/age/sweep bookkeeping shared by both lifecycle variants (and by
+    the explicit-SPMD engine's receiver-local finish, parallel/spmd.py):
+    fold this period's arrivals ``got`` into the seen set, age everything
+    (arrivals restart at 0), and sweep copies past the deadline.
+
+    Returns ``(new_seen_swept, new_age, swept)`` — ``swept`` is returned so
+    the tracked variant can drop its per-slot infected ring with the slot.
+    """
+    new_seen = useen | got
+    first_seen = new_seen & ~useen
+    new_age = jnp.where(first_seen, 0, jnp.minimum(uage + 1, AGE_CAP))
+    swept = new_seen & (new_age > sweep)
+    return new_seen & ~swept, new_age, swept
+
+
+def ring_record(uinf_ids, uptr, arrived, sid):
+    """Record pushing sender ``sid [N]`` into the last-k ring of every
+    (receiver, slot) cell where ``arrived [N, G]`` — one fan-out channel's
+    arrivals (onGossipReq records the sender, GossipProtocolImpl.java:
+    171-183). Returns the advanced ``(uinf_ids, uptr)``."""
+    k = uinf_ids.shape[2]
+    kr = jnp.arange(k, dtype=jnp.int32)
+    pos = jnp.mod(uptr, k)  # [N, G]
+    cell = (kr[None, None, :] == pos[:, :, None]) & arrived[:, :, None]
+    uinf_ids = jnp.where(cell, sid[:, None, None], uinf_ids)
+    return uinf_ids, uptr + arrived.astype(jnp.int32)
+
+
 def user_gossip_step(useen, uage, inv_perm, edge_ok, alive, spread, sweep,
                      edge_live=None):
     """Advance the [N, G] user-gossip state one period.
@@ -47,11 +76,10 @@ def user_gossip_step(useen, uage, inv_perm, edge_ok, alive, spread, sweep,
     if edge_live is not None:
         sent = [m & edge_live[c] for c, m in enumerate(sent)]
     msgs_user = sum(jnp.sum(m, axis=0) for m in sent)
-    new_seen = useen | (got & alive[:, None])
-    first_seen = new_seen & ~useen
-    new_age = jnp.where(first_seen, 0, jnp.minimum(uage + 1, AGE_CAP))
-    swept = new_seen & (new_age > sweep)
-    return new_seen & ~swept, new_age, msgs_user
+    seen, new_age, _ = user_gossip_finish(
+        useen, uage, got & alive[:, None], sweep
+    )
+    return seen, new_age, msgs_user
 
 
 def user_gossip_step_tracked(
@@ -84,10 +112,8 @@ def user_gossip_step_tracked(
     Returns ``(new_seen, new_age, uinf_ids, uptr, msgs_user [G])``.
     """
     n, g_slots = useen.shape
-    k = uinf_ids.shape[2]
     f = inv_perm.shape[0]
     col = jnp.arange(n, dtype=jnp.int32)
-    kr = jnp.arange(k, dtype=jnp.int32)
     if perm is None:
         perm = jnp.argsort(inv_perm, axis=1).astype(jnp.int32)
     urows = useen & (uage < spread)
@@ -116,17 +142,10 @@ def user_gossip_step_tracked(
             sent_s[c][inv_perm[c]] & edge_ok[c][:, None] & alive[:, None]
         )
         got = got | arrived
-        sid = inv_perm[c]
-        pos = jnp.mod(uptr, k)  # [N, G]
-        cell = (kr[None, None, :] == pos[:, :, None]) & arrived[:, :, None]
-        uinf_ids = jnp.where(cell, sid[:, None, None], uinf_ids)
-        uptr = uptr + arrived.astype(jnp.int32)
+        uinf_ids, uptr = ring_record(uinf_ids, uptr, arrived, inv_perm[c])
 
-    new_seen = useen | got
-    first_seen = new_seen & ~useen
-    new_age = jnp.where(first_seen, 0, jnp.minimum(uage + 1, AGE_CAP))
-    swept = new_seen & (new_age > sweep)
+    seen, new_age, swept = user_gossip_finish(useen, uage, got, sweep)
     # Sweeping drops the whole GossipState, infected ring included.
     uinf_ids = jnp.where(swept[:, :, None], -1, uinf_ids)
     uptr = jnp.where(swept, 0, uptr)
-    return new_seen & ~swept, new_age, uinf_ids, uptr, msgs_user
+    return seen, new_age, uinf_ids, uptr, msgs_user

@@ -1,0 +1,274 @@
+"""Explicit-SPMD engine (parallel/spmd.py) — bit-parity and exchange tests.
+
+The shard_map engine re-derives every cross-shard interaction by hand
+(bucketed all_to_alls, all-gathered member scalars, psum'd counters); the
+1D-GSPMD path stays the oracle. These tests pin the only acceptable
+relationship between the two: bit-for-bit identical trajectories — clean,
+scheduled-fault AND knobbed — at n=2048 over 8 virtual devices, plus the
+fixed-capacity exchange's one owned failure mode (overflow counts drops,
+and only a tampered capacity ever drops).
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.parallel.mesh import (
+    make_mesh,
+    make_mesh2d,
+    make_universe_member_mesh,
+)
+from scalecube_cluster_tpu.parallel.spmd import (
+    ShardConfig,
+    exchange_rounds_per_tick,
+    run_ensemble_sparse_ticks_spmd,
+    run_sparse_ticks_spmd,
+    scan_sparse_ticks_spmd,
+)
+from scalecube_cluster_tpu.sim.ensemble import stack_universes
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.knobs import make_knobs
+from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
+from scalecube_cluster_tpu.sim.sparse import (
+    init_sparse_full_view,
+    run_sparse_ticks,
+)
+from scalecube_cluster_tpu.testlib.certify import certify_params
+from scalecube_cluster_tpu.utils.jaxcache import jit_cache_size
+
+
+def _params(n):
+    # Compressed cadences (testlib/certify.py): FD, window SYNC, suspicion
+    # expiry, slot free/alloc all fire inside the test horizon.
+    return certify_params(n)
+
+
+def _assert_same_trajectory(ref, ref_tr, out, out_tr, where):
+    extra = set(out_tr) - set(ref_tr)
+    assert not extra, f"spmd-only trace keys {extra} ({where})"
+    for k in ref_tr:
+        a, b = np.asarray(ref_tr[k]), np.asarray(out_tr[k])
+        assert a.shape == b.shape and np.array_equal(a, b), f"trace {k} ({where})"
+    for name in ref.__dataclass_fields__:
+        a, b = getattr(ref, name), getattr(out, name)
+        if a is None and b is None:
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"state.{name} ({where})"
+        )
+
+
+def test_spmd_bit_identical_n2048_all_timelines():
+    """One n=2048 / d=8 run per timeline — clean, scheduled faults (kills,
+    a restart, a lossy middle segment), and knobbed — each bit-for-bit
+    against run_sparse_ticks: every trace key and every state leaf. Also
+    pins the zero-recompile contract: a second clean run from a different
+    seed reuses the SAME executable (utils/jaxcache.py::jit_cache_size)."""
+    assert len(jax.devices()) >= 8
+    n, d, T = 2048, 8, 35
+    p = _params(n)
+    mesh = make_mesh(jax.devices()[:d])
+    cfg = ShardConfig(d=d)
+
+    sched = (
+        ScheduleBuilder(n)
+        .add_segment(0, FaultPlan.uniform())
+        .add_segment(12, FaultPlan.uniform(loss_percent=20.0, mean_delay_ms=40.0))
+        .add_segment(24, FaultPlan.uniform())
+        .kill(7, 3)
+        .kill(9, 1500)
+        .restart(21, 3)
+        .build()
+    )
+    timelines = [
+        ("clean", FaultPlan.uniform(), None),
+        ("scheduled", sched, None),
+        ("knobbed", FaultPlan.uniform(),
+         make_knobs(p.base, suspicion_mult=1.5, fanout_cap=2)),
+    ]
+    for tag, plan, knobs in timelines:
+        ref, ref_tr = run_sparse_ticks(
+            p, init_sparse_full_view(n, p.slot_budget, seed=3), plan, T,
+            collect=True, knobs=knobs,
+        )
+        jax.block_until_ready(ref)
+        out, out_tr = run_sparse_ticks_spmd(
+            p, cfg, mesh, init_sparse_full_view(n, p.slot_budget, seed=3),
+            plan, T, collect=True, knobs=knobs,
+        )
+        jax.block_until_ready(out)
+        _assert_same_trajectory(ref, ref_tr, out, out_tr, tag)
+        # Lossless default capacity: the exchange counter owns exactly 0.
+        assert not np.asarray(out_tr["exchange_overflow"]).any(), tag
+
+    # Zero-recompile: same (params, cfg, mesh, treedefs), new seed → cache
+    # must not grow.
+    before = jit_cache_size(run_sparse_ticks_spmd)
+    out2, _ = run_sparse_ticks_spmd(
+        p, cfg, mesh, init_sparse_full_view(n, p.slot_budget, seed=11),
+        FaultPlan.uniform(), T, collect=True, knobs=None,
+    )
+    jax.block_until_ready(out2)
+    assert jit_cache_size(run_sparse_ticks_spmd) == before
+
+
+def test_spmd_latency_recorder_parity():
+    """The verdict-latency recorder shards (psum'd any-live-viewer events,
+    member-centric first-tick stamps) — structure-gated arrays must match
+    the oracle's, including under scheduled kills."""
+    n, d, T = 256, 4, 35
+    p = _params(n)
+    mesh = make_mesh(jax.devices()[:d])
+    sched = (
+        ScheduleBuilder(n)
+        .add_segment(0, FaultPlan.uniform())
+        .kill(4, 9)
+        .build()
+    )
+    ref, ref_tr = run_sparse_ticks(
+        p, init_sparse_full_view(n, p.slot_budget, seed=5, record_latency=True),
+        sched, T, collect=True,
+    )
+    out, out_tr = run_sparse_ticks_spmd(
+        p, ShardConfig(d=d), mesh,
+        init_sparse_full_view(n, p.slot_budget, seed=5, record_latency=True),
+        sched, T, collect=True,
+    )
+    _assert_same_trajectory(ref, ref_tr, out, out_tr, "latency")
+    assert int(np.asarray(out.lat_first_suspect[9])) > 0  # it actually fired
+
+
+def test_spmd_exchange_overflow_tampered_capacity():
+    """The negative control for the exchange's fixed capacity: shrinking
+    ``bucket_groups`` below the provable max MUST surface as a nonzero
+    exchange_overflow count (silent drops would be a liveness bug hidden
+    by the counter's constant-0 contract), while the oracle — no buckets —
+    reports exactly 0 on the same timeline."""
+    n, d, T = 256, 4, 35
+    p = _params(n)
+    mesh = make_mesh(jax.devices()[:d])
+    _, ref_tr = run_sparse_ticks(
+        p, init_sparse_full_view(n, p.slot_budget, seed=3),
+        FaultPlan.uniform(), T, collect=True,
+    )
+    assert not np.asarray(ref_tr["exchange_overflow"]).any()
+    _, out_tr = run_sparse_ticks_spmd(
+        p, ShardConfig(d=d, bucket_groups=1), mesh,
+        init_sparse_full_view(n, p.slot_budget, seed=3),
+        FaultPlan.uniform(), T, collect=True,
+    )
+    assert int(np.asarray(out_tr["exchange_overflow"]).sum()) > 0
+
+
+def test_spmd_ensemble_universe_member_mesh():
+    """The 2D universes×members twin: B=2 universes × d=4 member shards on
+    8 devices, each universe bit-identical to its own single-device run
+    (different seeds AND different fault plans per universe)."""
+    n, d, B, T = 256, 4, 2, 20
+    p = _params(n)
+    mesh = make_universe_member_mesh((B, d))
+    cfg = ShardConfig(d=d)
+    plans = [
+        FaultPlan.uniform(),
+        FaultPlan.uniform(loss_percent=15.0, mean_delay_ms=25.0),
+    ]
+    seeds = [3, 9]
+    states = stack_universes(
+        [init_sparse_full_view(n, p.slot_budget, seed=s) for s in seeds]
+    )
+    es_st, es_tr = run_ensemble_sparse_ticks_spmd(
+        p, cfg, mesh, states, stack_universes(plans), T, collect=True
+    )
+    for b in range(B):
+        ref, ref_tr = run_sparse_ticks(
+            p, init_sparse_full_view(n, p.slot_budget, seed=seeds[b]),
+            plans[b], T, collect=True,
+        )
+        for k in ref_tr:
+            assert np.array_equal(
+                np.asarray(ref_tr[k]), np.asarray(es_tr[k])[b]
+            ), (b, k)
+        for name in ref.__dataclass_fields__:
+            a, bb = getattr(ref, name), getattr(es_st, name)
+            if a is None and bb is None:
+                continue
+            assert np.array_equal(np.asarray(a), np.asarray(bb)[b]), (b, name)
+
+
+def test_spmd_validation():
+    """The engine refuses configurations it cannot run bit-faithfully."""
+    n, d = 256, 4
+    p = _params(n)
+    mesh = make_mesh(jax.devices()[:d])
+    st = init_sparse_full_view(n, p.slot_budget)
+    plan = FaultPlan.uniform()
+    with pytest.raises(ValueError, match="pallas"):
+        scan_sparse_ticks_spmd(
+            dataclasses.replace(p, pallas_core=True),
+            ShardConfig(d=d), mesh, st, plan, 4,
+        )
+    with pytest.raises(ValueError, match="in_scan_writeback"):
+        scan_sparse_ticks_spmd(
+            dataclasses.replace(p, in_scan_writeback=False),
+            ShardConfig(d=d), mesh, st, plan, 4,
+        )
+    with pytest.raises(ValueError, match="shards"):
+        # 256 % (3 shards * group 32) != 0 — mesh matches d so the
+        # divisibility check is the one that fires.
+        scan_sparse_ticks_spmd(
+            p, ShardConfig(d=3), make_mesh(jax.devices()[:3]), st, plan, 4
+        )
+    with pytest.raises(ValueError, match="axis"):
+        scan_sparse_ticks_spmd(
+            p, ShardConfig(d=2), make_mesh2d((4, 2)), st, plan, 4
+        )
+    with pytest.raises(ValueError, match="bucket_groups"):
+        scan_sparse_ticks_spmd(
+            p, ShardConfig(d=d, bucket_groups=0), mesh, st, plan, 4
+        )
+    assert exchange_rounds_per_tick() == 3
+
+
+@pytest.mark.deep
+def test_spmd_full_cadence_certification_engine():
+    """The MULTICHIP certifier runs the shard_map engine as an extra
+    engine through the full kill → expiry → DEAD → restart → re-admission
+    lifecycle (testlib/certify.py): parity on all 15 fields + 4 traces at
+    every segment boundary, same host-op interleaving as a real driver.
+
+    The run_fn compiles the engine WITHOUT donation (certify.py's
+    ``_run_ticks_nodonate`` rule): the production jit donates the state,
+    and on multi-threaded CPU hosts XLA's donated-carry aliasing races
+    whenever the input is a committed device array — exactly what the
+    segment-boundary kill/restart host ops hand back. The non-donating
+    compile is bitwise repeatable; donation semantics are covered by the
+    n=2048 timeline test above (fresh uncommitted inputs, race-free)."""
+    from scalecube_cluster_tpu.parallel.mesh import shard_plan, shard_sparse_state
+    from scalecube_cluster_tpu.testlib.certify import sparse_full_cadence_certify
+
+    assert len(jax.devices()) >= 8
+    d = 8
+    mesh = make_mesh(jax.devices()[:d])
+    cfg = ShardConfig(d=d)
+    run_nodonate = jax.jit(
+        scan_sparse_ticks_spmd,
+        static_argnums=(0, 1, 2, 5),
+        static_argnames=("collect",),
+    )
+
+    def run_spmd(params, state, plan, ticks):
+        return run_nodonate(params, cfg, mesh, state, plan, ticks)
+
+    # Empty mesh list: the GSPMD twin has its own certification in
+    # tests/test_sparse.py — this certifies the shard_map ENGINE against
+    # the unsharded reference, nothing else.
+    events = sparse_full_cadence_certify(
+        [], 1024, shard_plan, shard_sparse_state,
+        extra_engines={"shard_map": run_spmd},
+    )
+    assert events["engines"] == ["shard_map"]
+    assert events["meshes"] == 0
+    assert events["total_ticks"] == 80
+    assert events["readmitted_viewers"] > 0

@@ -148,6 +148,30 @@ def _build_run_sparse_ticks(pallas_core, schedule=False):
     )
 
 
+def _build_run_sparse_ticks_spmd(schedule=False):
+    # The explicit-SPMD shard_map engine (parallel/spmd.py). The census
+    # environment is single-device, so the probe mesh is d=1 over
+    # devices[:1] — every collective (all_gather / all_to_all / psum) still
+    # appears in the jaxpr, it just has one participant; the semantic rules
+    # see the same program structure the multi-chip run lowers.
+    import jax
+
+    from scalecube_cluster_tpu.parallel.mesh import make_mesh
+    from scalecube_cluster_tpu.parallel.spmd import (
+        ShardConfig,
+        run_sparse_ticks_spmd,
+    )
+
+    params, state, plan = _sparse_inputs(False, schedule=schedule)
+    mesh = make_mesh(jax.devices()[:1])
+    return (
+        run_sparse_ticks_spmd,
+        (params, ShardConfig(d=1), mesh, state, plan, T),
+        {"collect": True},
+        {"donate_argnums": (3,), "state_argnum": 3, "state_out": _state_first},
+    )
+
+
 def _build_writeback_free():
     from scalecube_cluster_tpu.sim.sparse import writeback_free
 
@@ -304,6 +328,14 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
         lambda: _build_run_sparse_ticks(True, schedule=True),
     ),
     EntrySpec("sim.sparse.writeback_free", _build_writeback_free),
+    EntrySpec(
+        "parallel.spmd.run_sparse_ticks_spmd[plan]",
+        lambda: _build_run_sparse_ticks_spmd(False),
+    ),
+    EntrySpec(
+        "parallel.spmd.run_sparse_ticks_spmd[schedule]",
+        lambda: _build_run_sparse_ticks_spmd(True),
+    ),
     EntrySpec(
         "sim.ensemble.run_ensemble_ticks",
         lambda: _build_run_ensemble_ticks(False),

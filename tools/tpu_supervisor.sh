@@ -110,6 +110,17 @@ PYEOF
   sleep 10
   cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
 
+  echo "--- [3d/6] explicit-SPMD shard_map rung at 102400 (8 shards) ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  # The shard_map engine's first multi-chip number at the 100k scale
+  # (ROADMAP "million-member clusters"): bit-parity is already certified
+  # at n=2048 in CI, so this rung is pure measurement. The rung
+  # self-stamps shards / bucket capacity / exchange rounds into every row
+  # and appends to artifacts/bench_history.jsonl; the GSPMD 102400 rung
+  # in sparse_times above is the comparison row.
+  timeout 1500 python bench.py --shard-map 8 102400 >>"$LOG" 2>&1
+  sleep 10
+  cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
+
   echo "--- [4/6] dense control ($(date -u +%FT%TZ)) ---" >>"$LOG"
   timeout 600 python tools/chunk_times.py 2>&1 | tail -30 >>"$LOG"
   cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
