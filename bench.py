@@ -259,14 +259,16 @@ def _measure_shard_map(
     ticks synced by an element fetch off the large view_T buffer). The row
     carries the exchange geometry next to the throughput number — shard
     count, resolved per-(channel, destination) bucket capacity in sender
-    groups, and exchange rounds per tick — so GSPMD-vs-explicit-SPMD
-    comparisons in PERF.md read straight off bench_history.jsonl rows."""
+    groups, exchange rounds per tick, and the analytic exchange payload in
+    bytes/tick — so GSPMD-vs-explicit-SPMD comparisons in PERF.md read
+    straight off bench_history.jsonl rows."""
     import jax
 
     from scalecube_cluster_tpu.parallel.mesh import make_mesh
     from scalecube_cluster_tpu.parallel.spmd import (
         ShardConfig,
         _bucket_cap,
+        exchange_payload_bytes_per_tick,
         exchange_rounds_per_tick,
         run_sparse_ticks_spmd,
     )
@@ -316,6 +318,12 @@ def _measure_shard_map(
         "shards": d,
         "bucket_groups": _bucket_cap(params, cfg),
         "exchange_rounds": exchange_rounds_per_tick(),
+        # Priced per shard per tick by the same analytic model tpulint S2
+        # cross-checks against the traced gossip buffer, so this column
+        # can't silently drift from the engine.
+        "exchange_bytes_per_tick": exchange_payload_bytes_per_tick(
+            params, cfg
+        )["total_bytes"],
     }
 
 

@@ -26,6 +26,12 @@ _CENSUS_PATH = os.path.join(
 )
 _CENSUS_STAMP_CACHE: dict | None = None
 
+#: Committed collective census golden (tpulint tier 3) — same anchoring.
+_COLLECTIVE_CENSUS_PATH = os.path.join(
+    os.path.dirname(_CENSUS_PATH), "collective_census.json"
+)
+_COLLECTIVE_STAMP_CACHE: dict | None = None
+
 
 def _census_stamp() -> dict:
     """``{"lint_schema", "census_digest"}`` from the committed census golden.
@@ -49,6 +55,27 @@ def _census_stamp() -> dict:
             _CENSUS_STAMP_CACHE = {}
     return dict(_CENSUS_STAMP_CACHE)
 
+
+def _collective_stamp() -> dict:
+    """``{"collective_digest"}`` from the committed collective census.
+
+    The tier-3 twin of :func:`_census_stamp`: ties every exported row to
+    the mesh exchange surface tpulint verified
+    (artifacts/collective_census.json — per-entry collectives, axes,
+    payload bytes/tick). Empty when the golden is absent.
+    """
+    global _COLLECTIVE_STAMP_CACHE
+    if _COLLECTIVE_STAMP_CACHE is None:
+        try:
+            with open(_COLLECTIVE_CENSUS_PATH) as fh:
+                data = json.load(fh)
+            _COLLECTIVE_STAMP_CACHE = {
+                "collective_digest": str(data["digest"])[:12],
+            }
+        except Exception:
+            _COLLECTIVE_STAMP_CACHE = {}
+    return dict(_COLLECTIVE_STAMP_CACHE)
+
 # Row keys reserved by the exporter itself; payloads may not override them.
 _RESERVED = ("schema", "kind")
 
@@ -66,7 +93,8 @@ def run_metadata(
     bench driver process must never initialize a backend (its children own
     the accelerator), so detection here is passive. ``lint_schema`` and
     ``census_digest`` are stamped from the committed tpulint census golden
-    when present (see :func:`_census_stamp`).
+    when present (see :func:`_census_stamp`); ``collective_digest`` ties
+    the row to the tier-3 collective census (:func:`_collective_stamp`).
     """
     if commit is None:
         try:
@@ -87,7 +115,12 @@ def run_metadata(
                 platform = "unknown"
         else:
             platform = "unknown"
-    meta: dict = {"commit": commit, "platform": platform, **_census_stamp()}
+    meta: dict = {
+        "commit": commit,
+        "platform": platform,
+        **_census_stamp(),
+        **_collective_stamp(),
+    }
     if n is not None:
         meta["n"] = int(n)
     if slot_budget is not None:

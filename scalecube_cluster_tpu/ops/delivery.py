@@ -164,6 +164,41 @@ def shard_group_routing(ginv, d):
     return dest, rank
 
 
+def lossless_bucket_capacity(n, d, group=GROUP):
+    """The provable per-(channel, destination) bucket capacity for
+    :func:`shard_group_routing`: ``ngl = (n/group)/d``.
+
+    This is both an upper bound and tight: a destination shard receives
+    exactly ``ngl`` groups per channel (``gfwd[c]`` is a permutation over
+    ``ng = d*ngl`` groups, ``ngl`` of which land in each shard's contiguous
+    block), and the identity permutation realizes rank ``ngl - 1``.
+    tpulint rule S2 gates any ``ShardConfig.bucket_groups`` below this
+    value; the runtime twin is the ``exchange_overflow`` counter.
+    """
+    ng, rem = divmod(n, group)
+    if rem:
+        raise ValueError(f"n={n} not a multiple of group={group}")
+    ngl, rem = divmod(ng, d)
+    if rem:
+        raise ValueError(f"{ng} sender groups not divisible by d={d} shards")
+    return ngl
+
+
+def routing_demand(ginv, d):
+    """Max bucket slots any (channel, source, destination) triple of a
+    concrete routing actually needs — ``max(rank) + 1`` over
+    :func:`shard_group_routing`. For every group permutation this is
+    bounded by :func:`lossless_bucket_capacity` (a source shard only has
+    ``ngl`` groups per channel to send anywhere), and the bound is tight:
+    the identity permutation routes all of a shard's groups to one
+    destination and realizes rank ``ngl - 1``. The S2 property check runs
+    adversarial draws against the bound; a configured capacity below the
+    demand of the tick's actual draw drops messages (``exchange_overflow``).
+    """
+    _, rank = shard_group_routing(ginv, d)
+    return int(jnp.max(rank)) + 1
+
+
 def inv_from_structured(ginv, rots, n, group=GROUP):
     """Expand the compact structured form to full ``[k, N]`` sender indices."""
     j = jnp.arange(n, dtype=jnp.int32)

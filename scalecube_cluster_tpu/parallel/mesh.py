@@ -80,6 +80,27 @@ def make_universe_member_mesh(shape: tuple[int, int], devices=None) -> Mesh:
     )
 
 
+def spec_axes(spec) -> frozenset:
+    """Mesh axis names a :class:`PartitionSpec` shards over (flattening
+    multi-axis dims); ``None``/unsharded dims contribute nothing."""
+    axes = set()
+    for dim in tuple(spec):
+        if dim is None:
+            continue
+        for a in dim if isinstance(dim, tuple) else (dim,):
+            axes.add(a)
+    return frozenset(axes)
+
+
+def replicated_axes(spec, axis_names) -> frozenset:
+    """Mesh axes a value under ``spec`` must be REPLICATED over — the
+    complement of :func:`spec_axes` in the mesh. This is the contract the
+    tpulint tier-3 replication analysis (rule S1) verifies against each
+    shard_map output: a value claimed replicated over an axis must not
+    vary over it."""
+    return frozenset(axis_names) - spec_axes(spec)
+
+
 def _ns(mesh: Mesh, spec: P) -> NamedSharding:
     """The one place a (mesh, PartitionSpec) pair becomes a NamedSharding —
     state_shardings / sparse_state_shardings / the shard_map drivers all

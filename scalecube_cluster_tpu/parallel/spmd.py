@@ -157,6 +157,40 @@ def exchange_rounds_per_tick() -> int:
     return 3
 
 
+def exchange_payload_bytes_per_tick(
+    params: SparseParams, cfg: ShardConfig
+) -> dict:
+    """Per-device operand bytes of the 3 exchange collectives in one tick.
+
+    Derived from the buffer shapes ``_tick_spmd`` actually builds (the
+    tpulint tier-3 collective census cross-checks these against the traced
+    jaxpr, so this function cannot silently drift from the engine):
+
+    - ``all_gather``: alive [nl] bool + epoch [nl] int32,
+    - SYNC reply ``all_to_all``: send [d, nl, 1+W] int32,
+    - gossip bucket ``all_to_all``: buf [d, f*cap_b, group, S+G] int32.
+    """
+    _validate(params, cfg)
+    p = params.base
+    n, d = p.n, cfg.d
+    nl = n // d
+    group = _sparse_group(n)
+    cap_b = _bucket_cap(params, cfg)
+    f = p.gossip_fanout
+    w = min(params.sync_window, n)
+    s = params.slot_budget
+    g = p.user_gossip_slots
+    gather = nl * 1 + nl * 4
+    sync = d * nl * (1 + w) * 4
+    gossip = d * f * cap_b * group * (s + g) * 4
+    return {
+        "all_gather_bytes": gather,
+        "sync_all_to_all_bytes": sync,
+        "gossip_all_to_all_bytes": gossip,
+        "total_bytes": gather + sync + gossip,
+    }
+
+
 def _apply_events_local(params, st, kill_mask, restart_mask, cut):
     """sim/sparse.py::apply_events_sparse on one shard's rows.
 

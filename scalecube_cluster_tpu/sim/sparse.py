@@ -1808,16 +1808,20 @@ def run_sparse_chunked(
         )
 
     for _ in range(whole):
+        # tpulint: disable=S3 -- deliberate donated chain: the chunked driver exists for big-n memory headroom, so each chunk donates the previous chunk's committed state; the CPU aliasing race this shape risks is covered by tpulint --sanitize-donation, and audits route through testlib/donation.py twins
         state, tr = run_sparse_ticks(
             params, state, plan, chunk, collect=collect, knobs=knobs
         )
+        # tpulint: disable=S3 -- same deliberate chain: the free writeback donates the chunk result in place (sanitize-donation covered)
         state = writeback_free(params, state)
         if collect:
             grab(tr)
     if tail:
+        # tpulint: disable=S3 -- same deliberate chain as the whole-chunk loop (tail variant), sanitize-donation covered
         state, tr = run_sparse_ticks(
             params, state, plan, tail, collect=collect, knobs=knobs
         )
+        # tpulint: disable=S3 -- same deliberate chain: tail writeback donates the tail result in place (sanitize-donation covered)
         state = writeback_free(params, state)
         if collect:
             grab(tr)

@@ -35,8 +35,8 @@ from scalecube_cluster_tpu.sim.sparse import (
     init_sparse_full_view,
     kill_sparse,
     restart_sparse,
-    run_sparse_ticks,
 )
+from scalecube_cluster_tpu.testlib.donation import run_sparse_ticks_nodonate
 
 PARITY_FIELDS = (
     "view_T",
@@ -56,22 +56,12 @@ PARITY_FIELDS = (
     "rng",
 )
 
-#: Certification always runs a NON-DONATING compile of the tick scan.
-#: ``run_sparse_ticks`` donates the state (the production default: one live
-#: [N, S] buffer is what lets 100k+ members fit a chip), but donation lets
-#: XLA:CPU alias the scan carry onto the input buffers, and on
-#: multi-threaded hosts that in-place overwrite RACES reads whenever the
-#: input is a committed device array (a prior jit's output — exactly what
-#: segment chaining produces). Two bitwise-identical runs then disagree in
-#: the slot tables (~alloc_cap entries, segment 1) roughly half the time on
-#: an 8-virtual-device CPU host; numpy inputs or dropping donation are both
-#: race-free (measured 0/20 vs ~8/15 divergent). A parity audit needs
-#: repeatability, not memory headroom (n <= 2048 here), so it never donates.
-_run_ticks_nodonate = jax.jit(
-    run_sparse_ticks.__wrapped__,
-    static_argnums=(0, 3),
-    static_argnames=("collect",),
-)
+#: Certification always runs a NON-DONATING compile of the tick scan — a
+#: parity audit needs repeatability, not memory headroom (n <= 2048 here).
+#: The donated-carry aliasing race this sidesteps (committed device inputs
+#: from segment chaining, ~8/15 divergent runs) is documented once in
+#: testlib/donation.py and statically flagged by tpulint rule S3.
+_run_ticks_nodonate = run_sparse_ticks_nodonate
 
 #: Segment plan: (ticks, host_op) — op applied BEFORE the segment runs.
 KILLED_EARLY = 7  # dead before tick 0: suspicion arms and expires in seg 1

@@ -27,7 +27,6 @@ from scalecube_cluster_tpu.sim.ensemble import (
     ensemble_sparse_convergence,
     init_ensemble_dense,
     init_ensemble_sparse,
-    run_ensemble_sparse_ticks,
     run_ensemble_ticks,
     sparse_convergence_device,
     stack_universes,
@@ -46,9 +45,12 @@ from scalecube_cluster_tpu.sim.schedule import FaultSchedule, ScheduleBuilder
 from scalecube_cluster_tpu.sim.sparse import (
     SparseParams,
     init_sparse_full_view,
-    run_sparse_ticks,
 )
 from scalecube_cluster_tpu.sim.state import init_full_view, seeds_mask
+from scalecube_cluster_tpu.testlib.donation import (
+    run_ensemble_sparse_ticks_nodonate,
+    run_sparse_ticks_nodonate,
+)
 from scalecube_cluster_tpu.testlib.invariants import (
     RAPID_REQUIRED_KEYS,
     REQUIRED_KEYS,
@@ -211,7 +213,11 @@ def run_scheduled(
             seed=seed,
             user_gossip_slots=params.user_gossip_slots,
         )
-        state, traces = run_sparse_ticks(sp, state, schedule, n_ticks)
+        # Non-donating compile (testlib/donation.py): chaos states are
+        # committed device arrays from jitted init ops — the donated-carry
+        # surface the PR-8 race lives on. Soaks need repeatability, not
+        # memory headroom.
+        state, traces = run_sparse_ticks_nodonate(sp, state, schedule, n_ticks)
         return state, traces, sparse_convergence(state)
     if engine == "rapid":
         rp = rapid_chaos_params(n)
@@ -299,7 +305,9 @@ def chaos_ensemble(seeds, n: int, engine: str) -> list[dict]:
             slot_budget=sp.slot_budget,
             user_gossip_slots=params.user_gossip_slots,
         )
-        states, traces = run_ensemble_sparse_ticks(sp, states, plans, ticks)
+        states, traces = run_ensemble_sparse_ticks_nodonate(
+            sp, states, plans, ticks
+        )
         pull = {k: traces[k] for k in REQUIRED_KEYS}
         pull["conv"] = ensemble_sparse_convergence(states)
         host = jax.device_get(pull)

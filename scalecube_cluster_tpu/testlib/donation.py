@@ -1,0 +1,55 @@
+"""No-donation twins of the donating jit entry points — the shared
+parity-audit compile rule.
+
+The production entry points donate their state carries (one live [N, S]
+buffer is what lets 100k+ members fit a chip), but donation lets XLA:CPU
+alias the scan carry onto the input buffers, and on multi-threaded hosts
+that in-place overwrite RACES reads whenever the input is a committed
+device array — a prior jit's output, exactly what segment chaining and
+chaos kill/restart boundaries hand back. Two bitwise-identical runs then
+disagree in the slot tables (~alloc_cap entries) roughly half the time on
+an 8-virtual-device CPU host; numpy inputs or dropping donation are both
+race-free (measured 0/20 vs ~8/15 divergent — see testlib/certify.py,
+PR-8 root cause).
+
+Any audit that needs REPEATABILITY rather than memory headroom (parity
+certification, chaos soaks, the tpulint ``--sanitize-donation`` diff)
+compiles through :func:`nodonate` instead of the production jit. The math
+is identical — only the aliasing contract changes — so bit-parity pins
+hold on either side.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from scalecube_cluster_tpu.sim.ensemble import run_ensemble_sparse_ticks
+from scalecube_cluster_tpu.sim.sparse import run_sparse_ticks
+
+
+def nodonate(jit_fn, *, static_argnums=(), static_argnames=()):
+    """Recompile a donating ``jax.jit`` entry WITHOUT donation.
+
+    ``jit_fn`` must be a ``jax.jit``-wrapped callable (it exposes the
+    original Python function as ``__wrapped__``); the caller restates the
+    static arg structure because jax does not expose it back off the
+    wrapper. Donation is the only dropped piece — the traced program is
+    unchanged, so outputs are bit-identical to the donating compile
+    (absent the aliasing race this helper exists to sidestep).
+    """
+    return jax.jit(
+        jit_fn.__wrapped__,
+        static_argnums=static_argnums,
+        static_argnames=static_argnames,
+    )
+
+
+#: Non-donating twin of sim/sparse.py::run_sparse_ticks.
+run_sparse_ticks_nodonate = nodonate(
+    run_sparse_ticks, static_argnums=(0, 3), static_argnames=("collect",)
+)
+
+#: Non-donating twin of sim/ensemble.py::run_ensemble_sparse_ticks.
+run_ensemble_sparse_ticks_nodonate = nodonate(
+    run_ensemble_sparse_ticks, static_argnums=(0, 3), static_argnames=("collect",)
+)

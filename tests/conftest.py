@@ -70,6 +70,31 @@ def semantic_result():
     )
 
 
+@pytest.fixture(scope="session")
+def spmd_result():
+    """One shared tpulint tier-3 run (traces the shard_map entries on the
+    8-virtual-device mesh this conftest already set up).
+
+    The collective-census gate in test_tpulint.py and the positive pins in
+    test_tpulint_spmd.py consume this single trace. Skips when jax is
+    unavailable, same contract as :func:`semantic_result`."""
+    from pathlib import Path
+
+    from tools.lint.semantic import jax_unavailable_reason
+    from tools.lint.spmdcheck import run_spmd
+
+    reason = jax_unavailable_reason()
+    if reason is not None:  # pragma: no cover - env-dependent
+        pytest.skip(f"spmd tier unavailable: {reason}")
+    repo = Path(__file__).resolve().parent.parent
+    result = run_spmd(
+        root=repo, census_path=repo / "artifacts" / "collective_census.json"
+    )
+    if result.skipped:  # pragma: no cover - env-dependent
+        pytest.skip(result.skipped)
+    return result
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _free_compiled_executables_between_modules():
     """Release each module's jitted executables at module teardown.

@@ -114,7 +114,11 @@ def test_run_metadata_explicit_fields():
     meta = run_metadata(n=32, slot_budget=64, seed=3, platform="cpu", commit="abc1234")
     # The census stamp is auto-detected from the committed tpulint golden;
     # split it off so the explicit fields can be compared exactly.
-    stamp = {k: meta.pop(k) for k in ("lint_schema", "census_digest") if k in meta}
+    stamp = {
+        k: meta.pop(k)
+        for k in ("lint_schema", "census_digest", "collective_digest")
+        if k in meta
+    }
     assert meta == {
         "commit": "abc1234",
         "platform": "cpu",
@@ -145,6 +149,23 @@ def test_run_metadata_census_stamp_matches_golden():
         golden = json.load(fh)
     assert meta["lint_schema"] == golden["census_schema"]
     assert meta["census_digest"] == golden["digest"][:12]
+
+
+def test_run_metadata_collective_stamp_matches_golden():
+    """The tier-3 twin: ``collective_digest`` must mirror
+    artifacts/collective_census.json (when committed)."""
+    census_path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "artifacts",
+        "collective_census.json",
+    )
+    meta = run_metadata(platform="cpu", commit="x")
+    if not os.path.exists(census_path):
+        assert "collective_digest" not in meta
+        return
+    with open(census_path) as fh:
+        golden = json.load(fh)
+    assert meta["collective_digest"] == golden["digest"][:12]
 
 
 def test_prometheus_text(tmp_path):

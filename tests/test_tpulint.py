@@ -62,13 +62,18 @@ def test_semantic_tier_gates_and_census_matches(semantic_result):
 
 
 def test_lint_importable_without_jax():
-    """tools.lint (both tiers' frontends) must import in a jax-less
+    """tools.lint (every tier's frontend) must import in a jax-less
     interpreter — the obs/ lazy-import discipline. Checked by inspecting
     module-level imports rather than a subprocess (jax is already loaded
     in the test process)."""
     import ast
 
-    for mod in ("tools/lint/semantic/__init__.py", "tools/lint/kernelcheck.py"):
+    for mod in (
+        "tools/lint/semantic/__init__.py",
+        "tools/lint/kernelcheck.py",
+        "tools/lint/spmdcheck/__init__.py",
+        "tools/lint/spmdcheck/donation.py",
+    ):
         tree = ast.parse((REPO / mod).read_text())
         top_level = {
             n.names[0].name.split(".")[0]
@@ -139,13 +144,14 @@ def test_cli_exit_codes(tmp_path):
     clean = str(FIXTURES / "r1_neg.py")
     dirty = str(FIXTURES / "r1_pos.py")
     json_out = str(tmp_path / "report.json")
-    # --no-semantic: exit-code plumbing is tier-1's to test; the semantic
-    # tier has its own gate test above and re-tracing here would double
-    # the suite's tracing bill.
+    # --no-semantic/--no-spmd: exit-code plumbing is tier-1's to test; the
+    # traced tiers have their own gate tests (here and in
+    # test_tpulint_spmd.py) and re-tracing here would double the suite's
+    # tracing bill.
     assert lint_main([clean, "--no-json", "--baseline", "none",
-                      "--no-semantic"]) == 0
+                      "--no-semantic", "--no-spmd"]) == 0
     assert lint_main([dirty, "--json", json_out, "--baseline", "none",
-                      "--no-semantic"]) == 1
+                      "--no-semantic", "--no-spmd"]) == 1
     assert Path(json_out).exists()
 
 

@@ -1,0 +1,405 @@
+"""tpulint tier-3 tests: SPMD collective rules S1-S3 and the collective
+census (S4).
+
+Mirrors the tier-2 contract in tests/test_tpulint_semantic.py:
+  1. every detector is demonstrated by a fixture that trips exactly it —
+     an unreduced partial leaking through a replicated out-spec (S1), a
+     tampered lossy ``ShardConfig.bucket_groups`` (S2), a donated-carry
+     chain (S3),
+  2. the sanctioned idioms stay silent — a psum'd output, the default
+     provably-lossless config, the non-donating audit twins,
+  3. the shipped shard_map entries pin clean against the committed
+     collective census (the shared session trace from conftest).
+
+Everything traces on the 8-virtual-device CPU mesh conftest set up; only
+the sanitizer-mechanics test executes anything, and that on scalars.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+import pytest
+
+from tools.lint.semantic import jax_unavailable_reason
+
+if jax_unavailable_reason() is not None:  # pragma: no cover - env-dependent
+    pytest.skip(
+        f"spmd tier needs jax: {jax_unavailable_reason()}",
+        allow_module_level=True,
+    )
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+from tools.lint.spmdcheck import capacity as capacity_mod
+from tools.lint.spmdcheck import census as census_mod
+from tools.lint.spmdcheck import donation as donation_mod
+from tools.lint.spmdcheck import replication as replication_mod
+from tools.lint.spmdcheck.entries import SpmdEntrySpec, TracedSpmdEntry
+
+REPO = Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 2, reason="spmd fixtures need >= 2 devices"
+)
+
+
+def _mesh2():
+    from scalecube_cluster_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(jax.devices()[:2])
+
+
+def _entry(fn, *args, name="fixture"):
+    """Wrap a tiny shard_map fixture the way entries.build_entries would
+    (params/cfg are only consumed by S2/census, which these skip)."""
+    traced = jax.jit(fn).trace(*args)
+    return TracedSpmdEntry(
+        name=name,
+        path="tests/test_tpulint_spmd.py",
+        line=1,
+        fn=fn,
+        args=args,
+        kwargs={},
+        closed=traced.jaxpr,
+        mesh=None,
+        params=None,
+        cfg=None,
+    )
+
+
+# ---------------------------------------------------------------------- S1
+
+
+def test_s1_unreduced_partial_behind_replicated_outspec_flags():
+    """The defect check_rep=False stops catching: a per-shard partial sum
+    returned through out_specs=P() — each shard would ship a different
+    'global' number."""
+    mesh = _mesh2()
+
+    def leaky(x):
+        return shard_map(
+            lambda s: jnp.sum(s),  # per-shard partial, NOT psum'd
+            mesh=mesh,
+            in_specs=P("members"),
+            out_specs=P(),
+            check_rep=False,
+        )(x)
+
+    findings, n_sites = replication_mod.check_s1(
+        _entry(leaky, jnp.arange(8.0), name="fixture.leaky")
+    )
+    assert any(
+        "declared replicated" in f.message and f.rule == "S1" for f in findings
+    ), findings
+
+
+def test_s1_psummed_output_stays_silent():
+    """The sanctioned idiom: reduce the partial over the axis before
+    claiming replication — exactly what the engine's counter merges do."""
+    mesh = _mesh2()
+
+    def sound(x):
+        return shard_map(
+            lambda s: jax.lax.psum(jnp.sum(s), "members"),
+            mesh=mesh,
+            in_specs=P("members"),
+            out_specs=P(),
+            check_rep=False,
+        )(x)
+
+    findings, n_sites = replication_mod.check_s1(
+        _entry(sound, jnp.arange(8.0), name="fixture.sound")
+    )
+    assert findings == [], [f.render() for f in findings]
+    assert n_sites >= 1  # the psum site was walked, not skipped
+
+
+def test_s1_sharded_outspec_stays_silent():
+    """A per-shard value is fine when the out_spec SAYS per-shard."""
+    mesh = _mesh2()
+
+    def sharded(x):
+        return shard_map(
+            lambda s: s * 2.0,
+            mesh=mesh,
+            in_specs=P("members"),
+            out_specs=P("members"),
+            check_rep=False,
+        )(x)
+
+    findings, _ = replication_mod.check_s1(
+        _entry(sharded, jnp.arange(8.0), name="fixture.sharded")
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_s1_axis_index_taints_through_elementwise():
+    """Variance introduced by axis_index must survive arbitrary
+    shard-agnostic math (the union transfer rule)."""
+    mesh = _mesh2()
+
+    def leaky(x):
+        def body(s):
+            i = jax.lax.axis_index("members")
+            return jnp.sum(s) + i.astype(jnp.float32) * 3.0
+
+        return shard_map(
+            body, mesh=mesh, in_specs=P("members"), out_specs=P(),
+            check_rep=False,
+        )(x)
+
+    findings, _ = replication_mod.check_s1(
+        _entry(leaky, jnp.arange(8.0), name="fixture.axis_index")
+    )
+    assert any("vary across" in f.message for f in findings), findings
+
+
+# ---------------------------------------------------------------------- S2
+
+
+def _sparse_params(n):
+    from scalecube_cluster_tpu.sim.sparse import SparseParams
+
+    return SparseParams.for_n(n, slot_budget=128)
+
+
+def test_s2_tampered_bucket_groups_rejected_statically():
+    """n=128, d=2, group=32 gives two sender groups per (channel, shard);
+    bucket_groups=1 WILL drop one — the static gate must refuse it
+    without tracing (the runtime twin is the exchange_overflow negative
+    control in test_spmd.py)."""
+    from scalecube_cluster_tpu.parallel.spmd import ShardConfig
+
+    findings = capacity_mod.check_s2_config(
+        _sparse_params(128), ShardConfig(d=2, bucket_groups=1), name="tampered"
+    )
+    assert any(
+        f.rule == "S2" and "WILL drop" in f.message for f in findings
+    ), findings
+
+
+def test_s2_default_config_is_provably_lossless():
+    from scalecube_cluster_tpu.parallel.spmd import ShardConfig
+
+    for n, d in ((128, 2), (256, 4), (64, 2)):
+        findings = capacity_mod.check_s2_config(
+            _sparse_params(n), ShardConfig(d=d), name=f"default[{n},{d}]"
+        )
+        assert findings == [], [f.render() for f in findings]
+
+
+def test_s2_routing_property_holds():
+    """The losslessness proof re-verified on identity/reversal/random
+    permutations: demand <= (n/group)/d everywhere, tight on identity."""
+    assert capacity_mod.check_routing_property() == []
+
+
+def test_s2_capacity_helpers_agree_with_demand():
+    from scalecube_cluster_tpu.ops.delivery import (
+        lossless_bucket_capacity,
+        routing_demand,
+    )
+
+    ng = 128 // 32
+    ident = jnp.tile(jnp.arange(ng, dtype=jnp.int32), (3, 1))
+    assert lossless_bucket_capacity(128, 2, 32) == 2
+    assert routing_demand(ident, 2) == 2
+    with pytest.raises(ValueError):
+        lossless_bucket_capacity(100, 3, 32)  # unroutable layout
+
+
+# ---------------------------------------------------------------------- S3
+
+
+def _scope_findings(src: str):
+    tree = ast.parse(src)
+    out = list(donation_mod._scan_scope(tree, "fixture.py"))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef):
+            out.extend(donation_mod._scan_scope(node, "fixture.py"))
+    return out
+
+
+def test_s3_loop_chained_donation_flags():
+    """The PR-8 shape: the donated slot is the previous iteration's
+    result — a committed device input every lap after the first."""
+    findings = _scope_findings(
+        "def driver(params, state, plan):\n"
+        "    for _ in range(3):\n"
+        "        state, tr = run_sparse_ticks(params, state, plan, 4)\n"
+        "    return state\n"
+    )
+    assert any(
+        f.rule == "S3" and "committed device input" in f.message
+        for f in findings
+    ), findings
+
+
+def test_s3_sequential_chain_flags():
+    """Straight-line chaining fires too: free(run(...)-result)."""
+    findings = _scope_findings(
+        "def driver(params, state, plan):\n"
+        "    state, tr = run_sparse_ticks(params, state, plan, 4)\n"
+        "    state = writeback_free(params, state)\n"
+        "    return state\n"
+    )
+    assert [f.line for f in findings if f.rule == "S3"] == [3], findings
+
+
+def test_s3_single_fresh_call_stays_silent():
+    """One call on freshly built state is race-free — the binding IS the
+    call line, no committed input exists."""
+    findings = _scope_findings(
+        "def once(params, plan):\n"
+        "    state = init_sparse_full_view(64)\n"
+        "    state, tr = run_sparse_ticks(params, state, plan, 4)\n"
+        "    return state\n"
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_s3_nodonate_twin_stays_silent():
+    """Routing through testlib/donation.py twins is the sanctioned audit
+    escape — not a donating callee, nothing to flag."""
+    findings = _scope_findings(
+        "def audit(params, state, plan):\n"
+        "    for _ in range(3):\n"
+        "        state, tr = run_sparse_ticks_nodonate(params, state, plan, 4)\n"
+        "    return state\n"
+    )
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_s3_library_chain_sites_are_pragma_justified():
+    """The chunked drivers ARE the chain shape on purpose (memory
+    headroom); the static pass must see them and the pragmas must carry
+    justifications — i.e. check_s3 fires raw, the gate filter silences."""
+    raw = donation_mod.check_s3(REPO)
+    chained = [
+        f for f in raw if f.path == "scalecube_cluster_tpu/sim/sparse.py"
+    ]
+    assert len(chained) == 4, [f.render() for f in raw]
+
+
+def test_s3_sanitizer_mechanics(monkeypatch):
+    """The --sanitize-donation loop on a tiny synthetic donated entry:
+    identical math -> clean; meta without static args -> metadata finding."""
+    import tools.lint.semantic.entries as sem_entries
+    import tools.lint.spmdcheck.entries as spmd_entries
+
+    def tick(n, x):
+        return x + jnp.float32(n)
+
+    jitted = jax.jit(tick, static_argnums=(0,), donate_argnums=(1,))
+
+    def build_ok():
+        return (
+            jitted,
+            (3, jnp.arange(4, dtype=jnp.float32)),
+            {},
+            {"donate_argnums": (1,), "static_argnums": (0,)},
+        )
+
+    def build_bad_meta():
+        return (jitted, (3, jnp.zeros(4)), {}, {"donate_argnums": (1,)})
+
+    specs = (
+        SpmdEntrySpec("fixture.ok", build_ok),
+        SpmdEntrySpec("fixture.no_meta", build_bad_meta),
+    )
+    monkeypatch.setattr(sem_entries, "ENTRY_SPECS", ())
+    monkeypatch.setattr(spmd_entries, "SPMD_ENTRY_SPECS", specs)
+    findings, clean = donation_mod.sanitize_donation(REPO)
+    assert clean == ["fixture.ok"]
+    assert len(findings) == 1 and "static arg metadata" in findings[0].message
+
+
+# ---------------------------------------------------------------------- S4
+
+
+def _tiny_census(digest="abc"):
+    return {
+        "collective_census_schema": census_mod.COLLECTIVE_CENSUS_SCHEMA,
+        "jax_version": jax.__version__,
+        "digest": "top",
+        "entries": {
+            "e": {
+                "digest": digest,
+                "collectives": [],
+                "path": "x.py",
+                "exchange_rounds_per_tick": 3,
+                "traced_exchange_bytes_per_tick": 0,
+                "traced_reduce_bytes_per_tick": 0,
+            }
+        },
+    }
+
+
+def test_collective_census_drift_detected(tmp_path):
+    old = _tiny_census("old")
+    new = _tiny_census("new")
+    findings, diff = census_mod.compare(old, new, tmp_path / "c.json")
+    assert any(f.rule == "S4" and "drifted" in f.message for f in findings)
+    assert any("~ e:" in line for line in diff)
+
+
+def test_collective_census_missing_golden_flags(tmp_path):
+    findings, _ = census_mod.compare(
+        None, _tiny_census(), tmp_path / "c.json"
+    )
+    assert any("unpinned" in f.message for f in findings)
+
+
+# ------------------------------------------------- shipped-surface pins
+
+
+def test_shipped_shard_map_entries_clean(spmd_result):
+    """The library passes its own tier-3 gate: S1 replication analysis,
+    S2 capacity + buffer cross-check, S3 chain scan (pragma-justified
+    chunked drivers aside) all silent, and the rebuilt collective census
+    matches the committed artifacts/collective_census.json."""
+    assert spmd_result.skipped is None
+    assert spmd_result.entries_traced >= 4
+    assert spmd_result.collectives_verified > 0
+    assert spmd_result.gated == [], "\n".join(
+        f.render() for f in spmd_result.gated
+    )
+    assert spmd_result.diff == [], "collective census drifted:\n" + "\n".join(
+        spmd_result.diff
+    )
+    assert spmd_result.census is not None
+
+
+def test_collective_census_golden_matches_run(spmd_result):
+    golden = census_mod.load_census(REPO / "artifacts" / "collective_census.json")
+    assert golden is not None, "artifacts/collective_census.json not committed"
+    assert golden["digest"] == spmd_result.census["digest"]
+
+
+def test_exchange_payload_model_matches_trace(spmd_result):
+    """Every shard_map census row's traced in-scan exchange bytes equal the
+    analytic model exactly — the S2 cross-check, asserted end to end."""
+    for name, row in spmd_result.census["entries"].items():
+        assert (
+            row["traced_exchange_bytes_per_tick"]
+            == row["payload_bytes_per_tick"]["total_bytes"]
+        ), name
+
+
+# ------------------------------------------------------- mesh helpers
+
+
+def test_replicated_axes_helper():
+    from scalecube_cluster_tpu.parallel.mesh import replicated_axes, spec_axes
+
+    spec = P(None, "members")
+    assert spec_axes(spec) == frozenset({"members"})
+    assert replicated_axes(spec, ("universes", "members")) == frozenset(
+        {"universes"}
+    )
+    assert spec_axes(P()) == frozenset()

@@ -14,7 +14,7 @@ import traceback
 from pathlib import Path
 
 from tools.lint import DEFAULT_BASELINE, RULES, run_lint
-from tools.lint.report import render_text, write_baseline, write_json
+from tools.lint.report import apply_baseline, render_text, write_baseline, write_json
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -73,6 +73,33 @@ def main(argv: list[str] | None = None) -> int:
         help="re-pin the census golden from this run's traces "
         "(mirrors --write-baseline; drift findings are skipped)",
     )
+    ap.add_argument(
+        "--no-spmd",
+        action="store_true",
+        help="skip tier 3 (shard_map collective rules S1-S3, "
+        "collective census S4)",
+    )
+    ap.add_argument(
+        "--collective-census",
+        default="artifacts/collective_census.json",
+        metavar="PATH",
+        help="collective census golden "
+        "(default: artifacts/collective_census.json)",
+    )
+    ap.add_argument(
+        "--collective-census-update",
+        action="store_true",
+        help="re-pin the collective census golden from this run's "
+        "shard_map traces (mirrors --census-update; S4 drift findings "
+        "are skipped)",
+    )
+    ap.add_argument(
+        "--sanitize-donation",
+        action="store_true",
+        help="S3 runtime mode: execute every registered donated entry "
+        "with and without donation and gate on any bitwise difference "
+        "(costs real compiles)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -90,10 +117,15 @@ def main(argv: list[str] | None = None) -> int:
             select=select,
             baseline=baseline,
         )
-        if args.write_baseline and baseline is not None:
-            write_baseline(result, baseline)
-
         semantic = None
+        spmd = None
+        if not args.no_spmd:
+            # Must run before anything imports jax: the tier-3 rules trace
+            # shard_map on 8 virtual CPU devices, and XLA reads the flag
+            # exactly once at first import.
+            from tools.lint import spmdcheck
+
+            spmdcheck.ensure_virtual_devices()
         if not args.no_semantic:
             from tools.lint.semantic import run_semantic
 
@@ -109,11 +141,33 @@ def main(argv: list[str] | None = None) -> int:
                 write_census(semantic.census, Path(args.census))
                 print(f"census re-pinned: {args.census}")
             result.findings.extend(semantic.findings)
-            result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        if not args.no_spmd:
+            from tools.lint.spmdcheck import run_spmd
+
+            spmd = run_spmd(
+                census_path=args.collective_census,
+                update=args.collective_census_update,
+                disable=disable,
+                select=select,
+                sanitize=args.sanitize_donation,
+            )
+            if args.collective_census_update and spmd.census is not None:
+                from tools.lint.spmdcheck.census import write_census
+
+                write_census(spmd.census, Path(args.collective_census))
+                print(f"collective census re-pinned: {args.collective_census}")
+            result.findings.extend(spmd.findings)
+        result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+        # Baseline accounting covers all tiers: semantic/spmd findings were
+        # merged above, so mark known advisories (and write, on request)
+        # only after the merge.
+        apply_baseline(result, baseline)
+        if args.write_baseline and baseline is not None:
+            write_baseline(result, baseline)
 
         if not args.no_json:
-            write_json(result, Path(args.json), semantic=semantic)
-        print(render_text(result, quiet=args.quiet, semantic=semantic))
+            write_json(result, Path(args.json), semantic=semantic, spmd=spmd)
+        print(render_text(result, quiet=args.quiet, semantic=semantic, spmd=spmd))
         return 1 if result.gated else 0
     except Exception:
         traceback.print_exc()

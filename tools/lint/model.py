@@ -43,6 +43,24 @@ RULES = {
     "K1": "Pallas BlockSpec hazard: index map out of bounds, output tiles "
     "clobbered across grid steps, grid*block not covering the operand, or "
     "tile dims off the per-dtype (sublane,128) layout",
+    # -- SPMD tier (tools/lint/spmdcheck/): rules over shard_map programs
+    #    traced on a virtual multi-device mesh.
+    "S1": "collective unsoundness: a psum/pmax/all_gather/all_to_all/"
+    "ppermute naming a dead mesh axis, or a shard_map output declared "
+    "replicated whose value the varying-set analysis shows can differ "
+    "per shard (the static check-rep the engine's check_rep=False drops)",
+    "S2": "exchange capacity unproven: ShardConfig bucket capacity below "
+    "the provable (n/group)/d routing demand, the routing losslessness "
+    "property violated, or the traced gossip buffer drifted from the "
+    "analytic payload model",
+    "S3": "donation hazard: a donating entry's donated slot fed a prior "
+    "donating-entry result (committed device input — the aliasing-race "
+    "shape), or --sanitize-donation found a bitwise donating-vs-"
+    "donation-free divergence",
+    "S4": "collective census drift: a shard_map entry's mesh/collective/"
+    "payload surface differs from the committed "
+    "artifacts/collective_census.json golden (regenerate deliberately "
+    "with --collective-census-update)",
 }
 
 #: Path segments that put a file in advisory scope: findings are reported

@@ -48,7 +48,7 @@ def write_baseline(result: LintResult, baseline_path: Path) -> None:
     )
 
 
-def write_json(result: LintResult, path: Path, semantic=None) -> None:
+def write_json(result: LintResult, path: Path, semantic=None, spmd=None) -> None:
     path.parent.mkdir(parents=True, exist_ok=True)
     payload = {
         "files_checked": result.files_checked,
@@ -65,6 +65,17 @@ def write_json(result: LintResult, path: Path, semantic=None) -> None:
             ),
             "census_diff": semantic.diff,
         }
+    if spmd is not None:
+        payload["spmd"] = {
+            "skipped": spmd.skipped,
+            "entries_traced": spmd.entries_traced,
+            "collectives_verified": spmd.collectives_verified,
+            "collective_digest": (
+                spmd.census["digest"] if spmd.census else None
+            ),
+            "collective_diff": spmd.diff,
+            "sanitized": spmd.sanitized,
+        }
     path.write_text(json.dumps(payload, indent=2) + "\n")
 
 
@@ -72,9 +83,10 @@ def render_text(
     result: LintResult,
     quiet: bool = False,
     semantic=None,
+    spmd=None,
 ) -> str:
-    """Console report. ``semantic`` is the tier-2 SemanticResult (or None
-    when the semantic tier was not requested)."""
+    """Console report. ``semantic`` is the tier-2 SemanticResult, ``spmd``
+    the tier-3 SpmdResult (either None when the tier was not requested)."""
     lines: list[str] = []
     gated = result.gated
     advisory = result.advisory
@@ -88,6 +100,10 @@ def render_text(
     if semantic is not None and semantic.diff:
         lines.append("census drift (committed golden vs this trace):")
         lines.extend(semantic.diff)
+        lines.append("")
+    if spmd is not None and spmd.diff:
+        lines.append("collective census drift (committed golden vs this trace):")
+        lines.extend(spmd.diff)
         lines.append("")
     lines.append(
         f"tpulint: {result.files_checked} files, "
@@ -109,6 +125,22 @@ def render_text(
             lines.append(
                 f"semantic: {semantic.entries_traced} entries traced, "
                 f"census digest {semantic.census['digest'][:12]}…, {kernel}"
+            )
+    if spmd is not None:
+        if spmd.skipped:
+            lines.append(f"spmd: {spmd.skipped}")
+        else:
+            sanitized = (
+                f", {len(spmd.sanitized)} donated entr"
+                f"{'y' if len(spmd.sanitized) == 1 else 'ies'} "
+                "sanitized bit-for-bit"
+                if spmd.sanitized
+                else ""
+            )
+            lines.append(
+                f"spmd: {spmd.entries_traced} shard_map entries traced, "
+                f"{spmd.collectives_verified} collective sites verified, "
+                f"collective digest {spmd.census['digest'][:12]}…{sanitized}"
             )
     if gated:
         lines.append("gate: FAIL (fix the finding or suppress with "

@@ -144,7 +144,14 @@ def _build_run_sparse_ticks(pallas_core, schedule=False):
         run_sparse_ticks,
         (params, state, plan, T),
         {"collect": True},
-        {"donate_argnums": (1,), "state_argnum": 1, "state_out": _state_first},
+        {
+            "donate_argnums": (1,),
+            "state_argnum": 1,
+            "state_out": _state_first,
+            "static_argnums": (0, 3),
+            "static_argnames": ("collect",),
+            "pallas": pallas_core,
+        },
     )
 
 
@@ -168,7 +175,13 @@ def _build_run_sparse_ticks_spmd(schedule=False):
         run_sparse_ticks_spmd,
         (params, ShardConfig(d=1), mesh, state, plan, T),
         {"collect": True},
-        {"donate_argnums": (3,), "state_argnum": 3, "state_out": _state_first},
+        {
+            "donate_argnums": (3,),
+            "state_argnum": 3,
+            "state_out": _state_first,
+            "static_argnums": (0, 1, 2, 5),
+            "static_argnames": ("collect",),
+        },
     )
 
 
@@ -180,7 +193,12 @@ def _build_writeback_free():
         writeback_free,
         (params, state),
         {},
-        {"donate_argnums": (1,), "state_argnum": 1, "state_out": _identity},
+        {
+            "donate_argnums": (1,),
+            "state_argnum": 1,
+            "state_out": _identity,
+            "static_argnums": (0,),
+        },
     )
 
 
@@ -249,7 +267,13 @@ def _build_run_ensemble_sparse_ticks(chaos=False):
         run_ensemble_sparse_ticks,
         (params, states, plans, T),
         {"collect": True},
-        {"donate_argnums": (1,), "state_argnum": 1, "state_out": _state_first},
+        {
+            "donate_argnums": (1,),
+            "state_argnum": 1,
+            "state_out": _state_first,
+            "static_argnums": (0, 3),
+            "static_argnames": ("collect",),
+        },
     )
 
 
@@ -269,7 +293,12 @@ def _build_ensemble_writeback_free():
         ensemble_writeback_free,
         (params, states),
         {},
-        {"donate_argnums": (1,), "state_argnum": 1, "state_out": _identity},
+        {
+            "donate_argnums": (1,),
+            "state_argnum": 1,
+            "state_out": _identity,
+            "static_argnums": (0,),
+        },
     )
 
 

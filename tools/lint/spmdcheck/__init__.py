@@ -1,0 +1,221 @@
+"""tpulint tier 3 — SPMD collective verification over shard_map programs.
+
+Tier 2 reads what XLA compiles on one device; this tier reads what the
+MESH runs. It traces the registered shard_map entries
+(tools/lint/spmdcheck/entries.py) on a virtual multi-device CPU mesh and
+gates four rules:
+
+- **S1 collective soundness** (tools/lint/spmdcheck/replication.py):
+  every ``psum``/``pmax``/``all_gather``/``all_to_all``/``ppermute``
+  names a live mesh axis, and a varying-set replication analysis — the
+  static twin of shard_map's runtime check_rep, which the engine turns
+  OFF — proves each output claimed replicated over an axis really is
+  (catching an unreduced counter partial leaking into a "global" merge).
+- **S2 exchange-capacity proof** (tools/lint/spmdcheck/capacity.py): the
+  bucketed gossip routing (ops/delivery.py::shard_group_routing) is
+  lossless at the configured ``(n/group)/d`` capacity — the static form
+  of the runtime ``exchange_overflow == 0`` invariant, failing loudly on
+  a tampered ``ShardConfig.bucket_groups``.
+- **S3 donation hazard** (tools/lint/spmdcheck/donation.py): jit entries
+  whose donated carries are fed committed device inputs (a prior jit's
+  output chained back in — the exact PR-8 aliasing-race shape), plus the
+  ``--sanitize-donation`` runtime diff that compiles each donated entry
+  with and without donation and compares bit-for-bit.
+- **S4 collective census** (tools/lint/spmdcheck/census.py): the
+  per-entry collective op list, axes and payload bytes/tick pinned as
+  ``artifacts/collective_census.json``; drift gates like R10 and re-pins
+  with ``--collective-census-update``.
+
+Importable WITHOUT jax (the obs/ lazy-import discipline): jax is imported
+only inside :func:`run_spmd`; absence degrades to a skipped tier.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.lint.model import Finding, is_advisory_path
+from tools.lint.pragmas import parse_pragmas, suppressed_lines
+
+__all__ = [
+    "run_spmd",
+    "SpmdResult",
+    "DEFAULT_COLLECTIVE_CENSUS",
+    "ensure_virtual_devices",
+]
+
+#: Committed collective-census golden (repo-anchored, like jax_census.json).
+DEFAULT_COLLECTIVE_CENSUS = (
+    Path(__file__).resolve().parents[3] / "artifacts" / "collective_census.json"
+)
+
+#: Virtual CPU devices the probe meshes need (d=2 member shards plus the
+#: 2x2 universes×members twin; 8 matches tests/conftest.py).
+VIRTUAL_DEVICES = 8
+
+
+def ensure_virtual_devices(count: int = VIRTUAL_DEVICES) -> bool:
+    """Arrange for ``count`` virtual CPU devices BEFORE jax first imports.
+
+    XLA reads ``--xla_force_host_platform_device_count`` from ``XLA_FLAGS``
+    at backend init, so this only works pre-import (the CLI calls it first
+    thing; pytest's conftest does its own equivalent). Returns False when
+    jax is already imported — the caller then takes whatever device count
+    the embedding process chose, and :func:`run_spmd` skips entries whose
+    mesh doesn't fit.
+    """
+    if "jax" in sys.modules:
+        return False
+    flag = f"--xla_force_host_platform_device_count={count}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = f"{flags} {flag}".strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    return True
+
+
+@dataclass
+class SpmdResult:
+    findings: list[Finding] = field(default_factory=list)
+    census: dict | None = None  # this run's rebuilt collective census
+    diff: list[str] = field(default_factory=list)  # drift vs the golden
+    skipped: str | None = None  # reason when the tier didn't run
+    entries_traced: int = 0
+    collectives_verified: int = 0  # collective call sites S1 walked
+    sanitized: list[str] = field(default_factory=list)  # entries diffed clean
+
+    @property
+    def gated(self) -> list[Finding]:
+        return [f for f in self.findings if not f.advisory and not f.baselined]
+
+
+def _filter_findings(
+    findings: list[Finding],
+    root: Path,
+    disable: tuple[str, ...],
+    select: tuple[str, ...] | None,
+) -> list[Finding]:
+    pragma_cache: dict[str, dict[int, frozenset[str]]] = {}
+
+    def suppressed(f: Finding) -> bool:
+        if f.path not in pragma_cache:
+            full = root / f.path
+            try:
+                source = full.read_text()
+            except OSError:
+                pragma_cache[f.path] = {}
+            else:
+                pragmas, _ = parse_pragmas(source, f.path)
+                pragma_cache[f.path] = suppressed_lines(pragmas, source)
+        return f.rule in pragma_cache[f.path].get(f.line, frozenset())
+
+    kept = []
+    for f in findings:
+        if f.rule in disable:
+            continue
+        if select is not None and f.rule not in select:
+            continue
+        if suppressed(f):
+            continue
+        f.advisory = is_advisory_path(f.path)
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept
+
+
+def run_spmd(
+    *,
+    root: str | Path | None = None,
+    census_path: str | Path | None = None,
+    update: bool = False,
+    disable: tuple[str, ...] = (),
+    select: tuple[str, ...] | None = None,
+    sanitize: bool = False,
+) -> SpmdResult:
+    """Run the SPMD tier. Pure besides reading the census golden — writing
+    an updated census is the caller's move (mirrors run_semantic).
+
+    Args:
+      update: census-regeneration mode — skip S4 drift findings (the
+        caller is about to re-pin the golden from :attr:`SpmdResult.census`).
+      sanitize: also EXECUTE each registered donated entry twice (donating
+        and non-donating compiles) and gate on any bitwise difference —
+        the runtime leg of S3. Costs real compiles; off by default.
+    """
+    from tools.lint.semantic import jax_unavailable_reason
+
+    root = Path(root or os.getcwd()).resolve()
+    census_path = Path(census_path or DEFAULT_COLLECTIVE_CENSUS)
+    disable = tuple(r.upper() for r in disable)
+    select = tuple(r.upper() for r in select) if select is not None else None
+
+    reason = jax_unavailable_reason()
+    if reason is not None:
+        return SpmdResult(skipped=f"spmd tier skipped: {reason}")
+    ensure_virtual_devices()
+    import jax
+
+    if len(jax.devices()) < 2:
+        # A 1-device "mesh" would silently verify nothing cross-shard.
+        return SpmdResult(
+            skipped=f"spmd tier skipped: {len(jax.devices())} device(s) "
+            "available; need >= 2 (set XLA_FLAGS "
+            "--xla_force_host_platform_device_count before importing jax)"
+        )
+
+    from tools.lint.spmdcheck import capacity as capacity_mod
+    from tools.lint.spmdcheck import census as census_mod
+    from tools.lint.spmdcheck import donation as donation_mod
+    from tools.lint.spmdcheck import entries as entries_mod
+    from tools.lint.spmdcheck import replication as replication_mod
+
+    result = SpmdResult()
+    entries, failures = entries_mod.build_entries(str(root))
+    result.entries_traced = len(entries)
+    for spec, err in failures:
+        result.findings.append(
+            Finding(
+                rule="S4",
+                path="tools/lint/spmdcheck/entries.py",
+                line=1,
+                message=f"[{spec.name}] shard_map entry failed to trace: "
+                f"{type(err).__name__}: {err}",
+                hint="the SPMD surface the docs promise doesn't build; fix "
+                "the library (or the entry's probe mesh/inputs)",
+            )
+        )
+
+    rows: dict[str, dict] = {}
+    for entry in entries:
+        s1_findings, n_sites = replication_mod.check_s1(entry)
+        result.findings.extend(s1_findings)
+        result.collectives_verified += n_sites
+        result.findings.extend(capacity_mod.check_s2(entry))
+        rows[entry.name] = census_mod.entry_row(entry, str(root))
+
+    # S2's routing property check runs once (entry-independent math).
+    result.findings.extend(capacity_mod.check_routing_property())
+    # S3 static pass: donated-carry chaining over the library source.
+    result.findings.extend(donation_mod.check_s3(root))
+    if sanitize:
+        s3_findings, clean = donation_mod.sanitize_donation(root)
+        result.findings.extend(s3_findings)
+        result.sanitized = clean
+
+    result.census = census_mod.build_census(rows, jax.__version__)
+    if not update:
+        try:
+            display = census_path.relative_to(root)
+        except ValueError:
+            display = census_path
+        drift, diff = census_mod.compare(
+            census_mod.load_census(census_path), result.census, display
+        )
+        result.findings.extend(drift)
+        result.diff = diff
+
+    result.findings = _filter_findings(result.findings, root, disable, select)
+    return result

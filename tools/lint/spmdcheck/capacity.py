@@ -1,0 +1,192 @@
+"""S2 — exchange-capacity proof for the bucketed gossip routing.
+
+The SPMD gossip exchange packs each shard's outgoing sender groups into a
+fixed ``[d, f*cap, group, S+G]`` bucket tensor; ``exchange_overflow``
+counts drops at runtime and is pinned to 0 in tests. This module turns
+that invariant into a static gate:
+
+1. **Config gate** — the configured per-(channel, source, destination)
+   capacity (``ShardConfig.bucket_groups``, default ``ngl``) must be at
+   least ``lossless_bucket_capacity(n, d, group) = (n/group)/d``, the
+   provable worst-case demand of ``shard_group_routing``. A tampered
+   config below it WILL drop messages on some draw.
+2. **Routing property** — re-verifies the proof itself on adversarial and
+   random group permutations: for every draw, ``routing_demand <= ngl``
+   (a source shard only has ``ngl`` groups per channel), and the identity
+   permutation meets the bound exactly (tightness).
+3. **Trace cross-check** — the gossip ``all_to_all`` operand in the
+   traced jaxpr must have exactly the shape the analytic model
+   (parallel/spmd.py::exchange_payload_bytes_per_tick) prices, so the
+   census's bytes/tick numbers cannot drift from the engine.
+"""
+
+from __future__ import annotations
+
+from tools.lint.model import Finding
+from tools.lint.spmdcheck.replication import _walk, shard_map_eqns
+
+#: Where the capacity logic lives — config findings anchor here.
+_SPMD_PATH = "scalecube_cluster_tpu/parallel/spmd.py"
+_DELIVERY_PATH = "scalecube_cluster_tpu/ops/delivery.py"
+
+
+def check_s2_config(params, cfg, *, name: str = "ShardConfig") -> list[Finding]:
+    """The config gate alone — callable on an untraced (params, cfg)."""
+    from scalecube_cluster_tpu.ops.delivery import lossless_bucket_capacity
+    from scalecube_cluster_tpu.parallel.spmd import _bucket_cap, _sparse_group
+
+    n = params.base.n
+    d = cfg.d
+    group = _sparse_group(n)
+    try:
+        need = lossless_bucket_capacity(n, d, group)
+    except ValueError as e:
+        return [
+            Finding(
+                rule="S2",
+                path=_SPMD_PATH,
+                line=1,
+                message=f"[{name}] unroutable shard layout: {e}",
+                hint="n must split into d shards of whole sender groups",
+            )
+        ]
+    cap = _bucket_cap(params, cfg)
+    if cap < need:
+        return [
+            Finding(
+                rule="S2",
+                path=_SPMD_PATH,
+                line=1,
+                message=f"[{name}] bucket capacity {cap} < provable demand "
+                f"{need} = (n/group)/d with n={n}, d={d}, group={group} — "
+                "the exchange WILL drop messages on some fan-out draw",
+                hint="leave ShardConfig.bucket_groups at None (the provable "
+                "capacity) or raise it to >= (n/group)/d; runtime twin: "
+                "exchange_overflow > 0",
+            )
+        ]
+    return []
+
+
+def check_s2(entry) -> list[Finding]:
+    """Config gate + traced-buffer cross-check for one traced entry."""
+    from scalecube_cluster_tpu.parallel.spmd import (
+        _bucket_cap,
+        _sparse_group,
+        exchange_payload_bytes_per_tick,
+    )
+
+    findings = check_s2_config(entry.params, entry.cfg, name=entry.name)
+    if findings:
+        return findings
+
+    p = entry.params.base
+    n, d = p.n, entry.cfg.d
+    expect = (
+        d,
+        p.gossip_fanout * _bucket_cap(entry.params, entry.cfg),
+        _sparse_group(n),
+        entry.params.slot_budget + p.user_gossip_slots,
+    )
+    seen = []
+    for sm in shard_map_eqns(entry.closed):
+        for eqn in _walk(sm.params["jaxpr"]):
+            if eqn.primitive.name != "all_to_all":
+                continue
+            shape = tuple(eqn.invars[0].aval.shape)
+            # From the split (channel) axis on, the gossip bucket is
+            # [d, f*cap, group, S+G] — 4 dims — while the SYNC reply is
+            # [d, nl, 1+W] — 3. Leading universe dims (the ensemble
+            # engine) sit before the split axis and don't matter.
+            split = int(eqn.params.get("split_axis", 0))
+            if len(shape) - split == 4:
+                seen.append(shape)
+    if not seen:
+        findings.append(
+            Finding(
+                rule="S2",
+                path=entry.path,
+                line=entry.line,
+                message=f"[{entry.name}] no gossip bucket all_to_all found "
+                "in the traced program",
+                hint="the exchange the capacity proof covers isn't there — "
+                "engine restructure? update tools/lint/spmdcheck/capacity.py",
+            )
+        )
+    for shape in seen:
+        if shape[-4:] != expect:
+            findings.append(
+                Finding(
+                    rule="S2",
+                    path=entry.path,
+                    line=entry.line,
+                    message=f"[{entry.name}] gossip bucket shape {shape} != "
+                    f"analytic model {expect} — "
+                    "exchange_payload_bytes_per_tick has drifted from the "
+                    "engine",
+                    hint="fix parallel/spmd.py::exchange_payload_bytes_per_"
+                    "tick (census bytes/tick and bench rows price it)",
+                )
+            )
+    return findings
+
+
+def check_routing_property() -> list[Finding]:
+    """Re-verify the losslessness proof on concrete draws (entry-free)."""
+    import jax
+    import jax.numpy as jnp
+
+    from scalecube_cluster_tpu.ops.delivery import (
+        lossless_bucket_capacity,
+        routing_demand,
+        structured_fanout_draw,
+    )
+
+    findings = []
+
+    def bad(ginv, d, group, tag):
+        n = ginv.shape[1] * group
+        ngl = lossless_bucket_capacity(n, d, group)
+        demand = routing_demand(ginv, d)
+        if demand > ngl:
+            return Finding(
+                rule="S2",
+                path=_DELIVERY_PATH,
+                line=1,
+                message=f"routing demand {demand} exceeds the provable "
+                f"capacity {ngl} on the {tag} permutation "
+                f"(n={n}, d={d}, group={group}) — the losslessness proof "
+                "is broken",
+                hint="shard_group_routing's rank construction changed; "
+                "re-derive the capacity bound before trusting "
+                "exchange_overflow == 0",
+            )
+        if tag == "identity" and demand != ngl:
+            return Finding(
+                rule="S2",
+                path=_DELIVERY_PATH,
+                line=1,
+                message=f"identity permutation demand {demand} != {ngl}: "
+                "the capacity bound is no longer tight "
+                f"(n={n}, d={d}, group={group})",
+                hint="either the routing got cheaper (shrink the bucket and "
+                "the exchange payload) or rank is miscounted",
+            )
+        return None
+
+    for n, d, group in ((128, 2, 32), (256, 4, 32), (64, 2, 8)):
+        ng = n // group
+        ident = jnp.tile(jnp.arange(ng, dtype=jnp.int32), (3, 1))
+        rev = ident[:, ::-1]
+        ginv_rand, _ = structured_fanout_draw(
+            jax.random.PRNGKey(0), n, 3, group
+        )
+        for tag, ginv in (
+            ("identity", ident),
+            ("reversal", rev),
+            ("random", ginv_rand),
+        ):
+            f = bad(ginv, d, group, tag)
+            if f is not None:
+                findings.append(f)
+    return findings

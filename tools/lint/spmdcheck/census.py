@@ -1,0 +1,255 @@
+"""S4 — the collective census: what the mesh exchanges, pinned as a golden.
+
+``artifacts/collective_census.json`` records, per registered shard_map
+entry, the mesh layout, every collective op (primitive, axes, operand
+shapes/dtype, scan context, count) and the exchange payload priced two
+ways — analytically (parallel/spmd.py::exchange_payload_bytes_per_tick)
+and from the traced operand shapes. The file is committed; the tier
+rebuilds it and gates on ANY drift, so "the sparse tick gained a fourth
+exchange round" or "the gossip bucket doubled" becomes a reviewed diff,
+never a surprise in the ICI bill. Regeneration::
+
+    python -m tools.lint --collective-census-update
+
+The census digest is stamped into exported rows (obs/export.py
+run_metadata ``collective_digest``) and bench --shard-map rows, tying
+every measurement to the exchange structure it ran.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from tools.lint.model import Finding
+from tools.lint.semantic import jaxprs
+
+#: Bump when the census wire format changes shape.
+COLLECTIVE_CENSUS_SCHEMA = 1
+
+#: Payload-bearing collectives the census inventories (axis_index and
+#: rewrite artifacts carry no payload and are S1's business).
+_EXCHANGE = {"all_gather", "all_gather_invariant", "all_to_all", "ppermute"}
+_REDUCE = {"psum", "pmax", "pmin", "psum_scatter"}
+_CENSUS_PRIMS = _EXCHANGE | _REDUCE
+
+
+def _operand_bytes(eqn) -> int:
+    total = 0
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is None or not hasattr(aval, "shape"):
+            continue
+        size = 1
+        for dim in aval.shape:
+            size *= int(dim)
+        total += size * aval.dtype.itemsize
+    return total
+
+
+def entry_row(entry, root: str) -> dict:
+    """One census row: mesh, collective inventory, payload pricing."""
+    from scalecube_cluster_tpu.parallel.spmd import (
+        exchange_payload_bytes_per_tick,
+        exchange_rounds_per_tick,
+    )
+
+    sites: dict[tuple, dict] = {}
+    traced_exchange = 0
+    traced_reduce = 0
+    for eqn, ctx in jaxprs.walk_eqns(entry.closed):
+        prim = eqn.primitive.name
+        if prim not in _CENSUS_PRIMS or "shard_map" not in ctx:
+            continue
+        ax = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+        if not isinstance(ax, (tuple, list)):
+            ax = (ax,)
+        axes = tuple(a for a in ax if isinstance(a, str))
+        shapes = tuple(
+            tuple(int(d) for d in v.aval.shape)
+            for v in eqn.invars
+            if hasattr(getattr(v, "aval", None), "shape")
+        )
+        dtypes = tuple(
+            sorted({str(v.aval.dtype) for v in eqn.invars if hasattr(v, "aval")})
+        )
+        in_scan = "scan" in ctx
+        nbytes = _operand_bytes(eqn)
+        if in_scan:
+            if prim in _EXCHANGE:
+                traced_exchange += nbytes
+            else:
+                traced_reduce += nbytes
+        key = (prim, axes, shapes, dtypes, in_scan)
+        if key in sites:
+            sites[key]["count"] += 1
+        else:
+            sites[key] = {
+                "primitive": prim,
+                "axes": list(axes),
+                "shapes": [list(s) for s in shapes],
+                "dtypes": list(dtypes),
+                "in_scan": in_scan,
+                "bytes": nbytes,
+                "count": 1,
+            }
+    collectives = sorted(
+        sites.values(),
+        key=lambda r: (r["primitive"], r["axes"], r["shapes"], r["in_scan"]),
+    )
+    payload = exchange_payload_bytes_per_tick(entry.params, entry.cfg)
+    row = {
+        "mesh": {name: int(size) for name, size in entry.mesh.shape.items()},
+        "n": int(entry.params.base.n),
+        "d": int(entry.cfg.d),
+        "collectives": collectives,
+        "exchange_rounds_per_tick": exchange_rounds_per_tick(),
+        "payload_bytes_per_tick": payload,
+        "traced_exchange_bytes_per_tick": traced_exchange,
+        "traced_reduce_bytes_per_tick": traced_reduce,
+        "jaxpr_digest": jaxprs.jaxpr_digest(entry.closed, strip=(root,)),
+        "path": entry.path,
+    }
+    row["digest"] = hashlib.sha256(
+        json.dumps(
+            {k: row[k] for k in row if k != "path"}, sort_keys=True
+        ).encode()
+    ).hexdigest()
+    return row
+
+
+def build_census(rows: dict[str, dict], jax_version: str) -> dict:
+    digest = hashlib.sha256(
+        json.dumps(
+            {name: row["digest"] for name, row in sorted(rows.items())},
+            sort_keys=True,
+        ).encode()
+    ).hexdigest()
+    return {
+        "collective_census_schema": COLLECTIVE_CENSUS_SCHEMA,
+        "jax_version": jax_version,
+        "digest": digest,
+        "entries": dict(sorted(rows.items())),
+    }
+
+
+def load_census(path: Path) -> dict | None:
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def write_census(census: dict, path: Path) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(census, indent=2, sort_keys=True) + "\n")
+
+
+def _collective_diff(old: list, new: list) -> list[str]:
+    def fmt(c):
+        scan = " in-scan" if c["in_scan"] else ""
+        return (
+            f"{c['primitive']}{c['axes']} x{c['count']} "
+            f"{c['shapes']}{scan} ({c['bytes']}B)"
+        )
+
+    o = {fmt(c) for c in old}
+    n = {fmt(c) for c in new}
+    lines = [f"    - {s}" for s in sorted(o - n)]
+    lines += [f"    + {s}" for s in sorted(n - o)]
+    return lines
+
+
+def compare(
+    old: dict | None, new: dict, census_path: Path
+) -> tuple[list[Finding], list[str]]:
+    """Drift between the committed collective census and this rebuild."""
+    hint = (
+        f"review the drift, then 'python -m tools.lint "
+        f"--collective-census-update' to re-pin {census_path}"
+    )
+    if old is None:
+        f = Finding(
+            rule="S4",
+            path=str(census_path),
+            line=1,
+            message="collective census golden missing or unreadable — the "
+            "mesh exchange surface is unpinned",
+            hint=hint,
+        )
+        return [f], ["collective census golden missing: full rebuild required"]
+
+    findings: list[Finding] = []
+    diff: list[str] = []
+    if old.get("collective_census_schema") != new["collective_census_schema"]:
+        findings.append(
+            Finding(
+                rule="S4",
+                path=str(census_path),
+                line=1,
+                message=f"collective census schema changed: "
+                f"{old.get('collective_census_schema')} -> "
+                f"{new['collective_census_schema']}",
+                hint=hint,
+            )
+        )
+    if old.get("jax_version") != new["jax_version"]:
+        diff.append(
+            f"  jax version: {old.get('jax_version')} -> {new['jax_version']}"
+        )
+    old_entries = old.get("entries", {})
+    new_entries = new["entries"]
+    for name in sorted(set(old_entries) | set(new_entries)):
+        o, n = old_entries.get(name), new_entries.get(name)
+        if o is None:
+            findings.append(
+                Finding(
+                    rule="S4",
+                    path=n.get("path") or str(census_path),
+                    line=1,
+                    message=f"[{name}] shard_map entry is new since the "
+                    "committed collective census",
+                    hint=hint,
+                )
+            )
+            diff.append(f"  + {name} ({len(n['collectives'])} collective sites)")
+            continue
+        if n is None:
+            findings.append(
+                Finding(
+                    rule="S4",
+                    path=o.get("path") or str(census_path),
+                    line=1,
+                    message=f"[{name}] shard_map entry vanished from the "
+                    "collective census",
+                    hint=hint,
+                )
+            )
+            diff.append(f"  - {name}")
+            continue
+        if o.get("digest") == n["digest"]:
+            continue
+        findings.append(
+            Finding(
+                rule="S4",
+                path=n.get("path") or str(census_path),
+                line=1,
+                message=f"[{name}] collective surface drifted from the "
+                f"committed census",
+                hint=hint,
+            )
+        )
+        diff.append(f"  ~ {name}:")
+        diff.extend(
+            _collective_diff(o.get("collectives", []), n["collectives"])
+        )
+        for k in (
+            "exchange_rounds_per_tick",
+            "traced_exchange_bytes_per_tick",
+            "traced_reduce_bytes_per_tick",
+        ):
+            if o.get(k) != n[k]:
+                diff.append(f"    {k}: {o.get(k)} -> {n[k]}")
+    return findings, diff

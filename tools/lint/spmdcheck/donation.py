@@ -1,0 +1,233 @@
+"""S3 — donation-hazard analysis and the ``--sanitize-donation`` runtime.
+
+The PR-8 root cause: ``jax.jit(..., donate_argnums=...)`` lets XLA:CPU
+alias the scan carry onto the input buffers, and on multi-threaded hosts
+that in-place overwrite races reads whenever the input is a COMMITTED
+device array — a prior jit's output chained back into the donated slot.
+Fresh (just-initialized, fully materialized) inputs are race-free; the
+chain shape is what corrupted certification state for five PRs.
+
+Static pass (:func:`check_s3`): flag every call of a donating entry whose
+donated argument is a name bound from a donating entry's result earlier
+in the same function (or anywhere in the same enclosing loop — the
+self-chaining ``state, _ = run(..., state, ...)`` loop). Sanctioned
+escapes: route through the non-donating twins
+(scalecube_cluster_tpu/testlib/donation.py) for audits, or carry a
+``# tpulint: disable=S3 -- why`` pragma where the chain is the point
+(the chunked drivers trade the CPU-only race for TPU memory headroom).
+
+Runtime pass (:func:`sanitize_donation`): execute each registered donated
+entry twice — the production donating compile and a donation-free twin on
+identical fresh inputs — and gate on ANY bitwise difference. Donation
+only changes the aliasing contract, never the math, so a diff means the
+aliasing is live on this host.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+
+from tools.lint.model import Finding
+
+#: Donating entry points: callee name -> (donated positional index,
+#: donated keyword name). Kept in sync with the ``donate_argnums`` in
+#: sim/sparse.py, sim/ensemble.py and parallel/spmd.py; the sanitizer
+#: traces the real decorators, so drift shows up as a runtime diff there.
+DONATING = {
+    "run_sparse_ticks": (1, "state"),
+    "run_sparse_ticks_spmd": (3, "state"),
+    "run_ensemble_sparse_ticks": (1, "states"),
+    "writeback_free": (1, "state"),
+    "ensemble_writeback_free": (1, "states"),
+}
+
+#: Directories the static pass scans (repo-relative).
+_SCAN_DIRS = ("scalecube_cluster_tpu", "experiments")
+
+_LOOPS = (ast.For, ast.While, ast.AsyncFor)
+
+
+def _callee_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _donated_arg(call: ast.Call) -> ast.expr | None:
+    """The expression passed in the donated slot, or None."""
+    name = _callee_name(call)
+    idx, kw = DONATING[name]
+    if len(call.args) > idx:
+        return call.args[idx]
+    for k in call.keywords:
+        if k.arg == kw:
+            return k.value
+    return None
+
+
+def _bound_names(assign: ast.Assign) -> set[str]:
+    """Names an assignment binds to a donating call's STATE result —
+    ``x = free(...)`` binds x; ``x, tr = run(...)`` binds x (state-first
+    returns); starred/attribute targets are ignored (not chained names)."""
+    out: set[str] = set()
+    for t in assign.targets:
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)) and t.elts:
+            first = t.elts[0]
+            if isinstance(first, ast.Name):
+                out.add(first.id)
+    return out
+
+
+def _scan_scope(scope, rel: str) -> list[Finding]:
+    """One function (or module) body: bindings vs donated-slot uses."""
+    bindings: list[tuple[int, str, list[ast.AST]]] = []  # (line, name, loops)
+    calls: list[tuple[ast.Call, ast.expr, list[ast.AST]]] = []
+
+    def visit(node, loops):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested scopes analyzed on their own
+            in_loops = loops + [child] if isinstance(child, _LOOPS) else loops
+            if (
+                isinstance(child, ast.Assign)
+                and isinstance(child.value, ast.Call)
+                and _callee_name(child.value) in DONATING
+            ):
+                for name in _bound_names(child):
+                    bindings.append((child.lineno, name, list(in_loops)))
+            if isinstance(child, ast.Call) and _callee_name(child) in DONATING:
+                arg = _donated_arg(child)
+                if isinstance(arg, ast.Name):
+                    calls.append((child, arg, list(loops)))
+            visit(child, in_loops)
+
+    visit(scope, [])
+
+    findings = []
+    for call, arg, call_loops in calls:
+        chained = None
+        for line, name, bind_loops in bindings:
+            if name != arg.id:
+                continue
+            if line < call.lineno:
+                chained = line
+                break
+            if any(lp in call_loops for lp in bind_loops):
+                chained = line  # self-chaining loop body
+                break
+        if chained is None:
+            continue
+        callee = _callee_name(call)
+        findings.append(
+            Finding(
+                rule="S3",
+                path=rel,
+                line=call.lineno,
+                message=f"donated argument {arg.id!r} of {callee} is a "
+                f"prior donating-entry result (bound line {chained}) — a "
+                "committed device input in the donated slot, the PR-8 "
+                "aliasing-race shape",
+                hint="audits: use the non-donating twins in "
+                "testlib/donation.py; production chains that need the "
+                "memory headroom justify with a pragma and are covered by "
+                "--sanitize-donation",
+            )
+        )
+    return findings
+
+
+def check_s3(root: Path) -> list[Finding]:
+    """Static donated-carry chain scan over the library + experiments."""
+    findings: list[Finding] = []
+    for top in _SCAN_DIRS:
+        base = Path(root) / top
+        if not base.is_dir():
+            continue
+        for path in sorted(base.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            rel = path.relative_to(root).as_posix()
+            try:
+                tree = ast.parse(path.read_text())
+            except (OSError, SyntaxError):
+                continue  # tier 1's R0 owns unparsable files
+            findings.extend(_scan_scope(tree, rel))
+            for node in ast.walk(tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    findings.extend(_scan_scope(node, rel))
+    return findings
+
+
+def sanitize_donation(root: Path) -> tuple[list[Finding], list[str]]:
+    """Execute every registered donated entry with and without donation;
+    gate on any bitwise output difference. Returns (findings, clean)."""
+    import jax
+    import numpy as np
+
+    from tools.lint.semantic.entries import ENTRY_SPECS, _fn_location
+    from tools.lint.spmdcheck.entries import SPMD_ENTRY_SPECS
+
+    findings: list[Finding] = []
+    clean: list[str] = []
+    for spec in (*ENTRY_SPECS, *SPMD_ENTRY_SPECS):
+        fn, args, kwargs, meta = spec.build()
+        if not meta.get("donate_argnums") or meta.get("pallas"):
+            continue  # nothing donated, or a Pallas core (no CPU execution)
+        path, line = _fn_location(meta.get("unwrap", fn), str(root))
+        inner = meta.get("unwrap", getattr(fn, "__wrapped__", None))
+        if inner is None or "static_argnums" not in meta:
+            findings.append(
+                Finding(
+                    rule="S3",
+                    path=path or "tools/lint/spmdcheck/donation.py",
+                    line=line or 1,
+                    message=f"[{spec.name}] donated entry lacks the static "
+                    "arg metadata the sanitizer needs to build its "
+                    "donation-free twin",
+                    hint="add static_argnums/static_argnames to the entry's "
+                    "meta dict",
+                )
+            )
+            continue
+        # Fresh, fully materialized inputs on both sides (the race needs
+        # in-flight committed buffers; block_until_ready mirrors the
+        # passing parity tests).
+        jax.block_until_ready(args)
+        out_d = jax.device_get(fn(*args, **kwargs))
+        twin = jax.jit(
+            inner,
+            static_argnums=meta["static_argnums"],
+            static_argnames=meta.get("static_argnames", ()),
+        )
+        _, args2, kwargs2, _ = spec.build()
+        jax.block_until_ready(args2)
+        out_n = jax.device_get(twin(*args2, **kwargs2))
+        leaves_d = jax.tree_util.tree_leaves(out_d)
+        leaves_n = jax.tree_util.tree_leaves(out_n)
+        bad = [
+            i
+            for i, (a, b) in enumerate(zip(leaves_d, leaves_n))
+            if not np.array_equal(np.asarray(a), np.asarray(b))
+        ]
+        if len(leaves_d) != len(leaves_n) or bad:
+            findings.append(
+                Finding(
+                    rule="S3",
+                    path=path or "tools/lint/spmdcheck/donation.py",
+                    line=line or 1,
+                    message=f"[{spec.name}] donating and donation-free "
+                    f"compiles disagree bit-for-bit (leaves {bad[:8]}) — "
+                    "the donated-carry aliasing race is LIVE on this host",
+                    hint="do not trust donating runs for parity audits "
+                    "here; route through testlib/donation.py twins",
+                )
+            )
+        else:
+            clean.append(spec.name)
+    return findings, clean

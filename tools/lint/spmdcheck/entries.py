@@ -1,0 +1,217 @@
+"""Registry of the shard_map entry points the SPMD tier traces.
+
+Unlike tier 2's single-device registry (tools/lint/semantic/entries.py,
+d=1 probe mesh — collectives appear but have one participant), these
+entries trace on REAL multi-device virtual meshes: d=2 member shards for
+the 1D engine and the 2×2 universes×members twin, so every collective in
+the jaxpr has cross-shard structure for S1/S2 to verify. Probe n=128
+keeps two group-32 sender blocks per shard (``ngl = 2``) — the smallest
+shape where a tampered ``bucket_groups=1`` is actually lossy, mirroring
+the runtime negative in tests/test_spmd.py.
+
+Entry names key ``artifacts/collective_census.json``; adding/removing one
+here is itself a reviewed census diff (S4).
+"""
+
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass, field
+from typing import Callable
+
+from tools.lint.semantic.entries import _fn_location, _state_first
+
+#: Probe shapes — n % (d * 32) == 0 with two sender groups per shard.
+N = 128
+S = 128
+B = 2
+T = 4
+D = 2
+
+
+@dataclass
+class TracedSpmdEntry:
+    """One traced shard_map entry plus everything the rule pack needs."""
+
+    name: str
+    path: str
+    line: int
+    fn: Callable
+    args: tuple
+    kwargs: dict
+    closed: object  # ClosedJaxpr (contains the shard_map eqn(s))
+    mesh: object  # the probe Mesh
+    params: object  # SparseParams
+    cfg: object  # ShardConfig
+    donate_argnums: tuple[int, ...] = ()
+    state_argnum: int | None = None
+
+
+@dataclass(frozen=True)
+class SpmdEntrySpec:
+    name: str
+    build: Callable[[], tuple]  # () -> (fn, args, kwargs, meta-dict)
+    meta: dict = field(default_factory=dict)
+
+
+def _spmd_inputs(schedule=False, record_latency=False):
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+    )
+
+    params = SparseParams.for_n(N, slot_budget=S)
+    state = init_sparse_full_view(
+        N,
+        slot_budget=S,
+        user_gossip_slots=params.base.user_gossip_slots,
+        record_latency=record_latency,
+    )
+    if schedule:
+        plan = (
+            ScheduleBuilder(N)
+            .add_segment(0, FaultPlan.uniform())
+            .add_segment(2, FaultPlan.uniform(loss_percent=10.0))
+            .kill(2, 1)
+            .restart(3, 1)
+            .build()
+        )
+    else:
+        plan = FaultPlan.uniform()
+    return params, state, plan
+
+
+def _build_run_sparse_ticks_spmd(schedule=False, record_latency=False):
+    import jax
+
+    from scalecube_cluster_tpu.parallel.mesh import make_mesh
+    from scalecube_cluster_tpu.parallel.spmd import (
+        ShardConfig,
+        run_sparse_ticks_spmd,
+    )
+
+    params, state, plan = _spmd_inputs(
+        schedule=schedule, record_latency=record_latency
+    )
+    cfg = ShardConfig(d=D)
+    mesh = make_mesh(jax.devices()[:D])
+    return (
+        run_sparse_ticks_spmd,
+        (params, cfg, mesh, state, plan, T),
+        {"collect": True},
+        {
+            "donate_argnums": (3,),
+            "state_argnum": 3,
+            "state_out": _state_first,
+            "static_argnums": (0, 1, 2, 5),
+            "static_argnames": ("collect",),
+            "params": params,
+            "cfg": cfg,
+            "mesh": mesh,
+        },
+    )
+
+
+def _build_run_ensemble_sparse_ticks_spmd():
+    import jax
+
+    from scalecube_cluster_tpu.parallel.mesh import make_universe_member_mesh
+    from scalecube_cluster_tpu.parallel.spmd import (
+        ShardConfig,
+        run_ensemble_sparse_ticks_spmd,
+    )
+    from scalecube_cluster_tpu.sim.ensemble import (
+        init_ensemble_sparse,
+        stack_universes,
+    )
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import SparseParams
+
+    params = SparseParams.for_n(N, slot_budget=S)
+    cfg = ShardConfig(d=D)
+    mesh = make_universe_member_mesh((B, D))
+    states = init_ensemble_sparse(
+        N,
+        [0] * B,
+        slot_budget=S,
+        user_gossip_slots=params.base.user_gossip_slots,
+    )
+    plans = stack_universes(FaultPlan.uniform() for _ in range(B))
+    # The ensemble twin ships unjitted (tests drive it directly); the
+    # probe jits it the way a reusing call site would.
+    fn = jax.jit(
+        run_ensemble_sparse_ticks_spmd,
+        static_argnums=(0, 1, 2, 5),
+        static_argnames=("collect",),
+    )
+    return (
+        fn,
+        (params, cfg, mesh, states, plans, T),
+        {"collect": True},
+        {
+            "state_argnum": 3,
+            "state_out": _state_first,
+            "params": params,
+            "cfg": cfg,
+            "mesh": mesh,
+            "unwrap": run_ensemble_sparse_ticks_spmd,
+        },
+    )
+
+
+SPMD_ENTRY_SPECS: tuple[SpmdEntrySpec, ...] = (
+    SpmdEntrySpec(
+        "parallel.spmd.run_sparse_ticks_spmd[plan,d2]",
+        lambda: _build_run_sparse_ticks_spmd(False),
+    ),
+    SpmdEntrySpec(
+        "parallel.spmd.run_sparse_ticks_spmd[schedule,d2]",
+        lambda: _build_run_sparse_ticks_spmd(True),
+    ),
+    SpmdEntrySpec(
+        "parallel.spmd.run_sparse_ticks_spmd[latency,d2]",
+        lambda: _build_run_sparse_ticks_spmd(False, record_latency=True),
+    ),
+    SpmdEntrySpec(
+        "parallel.spmd.run_ensemble_sparse_ticks_spmd[2x2]",
+        _build_run_ensemble_sparse_ticks_spmd,
+    ),
+)
+
+
+def trace_entry(spec: SpmdEntrySpec, root: str) -> TracedSpmdEntry:
+    """Build inputs and trace one shard_map entry (abstract eval only —
+    the mesh is virtual, no collective executes)."""
+    fn, args, kwargs, meta = spec.build()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        traced = fn.trace(*args, **kwargs)
+    path, line = _fn_location(meta.get("unwrap", fn), root)
+    return TracedSpmdEntry(
+        name=spec.name,
+        path=path,
+        line=line,
+        fn=fn,
+        args=args,
+        kwargs=kwargs,
+        closed=traced.jaxpr,
+        mesh=meta["mesh"],
+        params=meta["params"],
+        cfg=meta["cfg"],
+        donate_argnums=tuple(meta.get("donate_argnums", ())),
+        state_argnum=meta.get("state_argnum"),
+    )
+
+
+def build_entries(root: str):
+    """Trace every registered shard_map entry; ``(entries, failures)``."""
+    entries: list[TracedSpmdEntry] = []
+    failures: list[tuple[SpmdEntrySpec, Exception]] = []
+    for spec in SPMD_ENTRY_SPECS:
+        try:
+            entries.append(trace_entry(spec, root))
+        except Exception as e:  # surfaced as S4 by the orchestrator
+            failures.append((spec, e))
+    return entries, failures

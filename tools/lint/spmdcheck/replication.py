@@ -1,0 +1,273 @@
+"""S1 — collective soundness: a static check-rep for shard_map bodies.
+
+The explicit-SPMD engine compiles with ``check_rep=False`` (the runtime
+checker rejects legal manual-collective patterns), which means NOTHING
+verifies its replication discipline: an output declared replicated
+(``out_specs=P()``) that actually differs per shard — an unreduced
+counter partial, a per-shard value leaking into a "global" merge — would
+ship whichever shard XLA happens to read.
+
+This module rebuilds that guarantee statically as a varying-set abstract
+interpretation over the shard_map body jaxpr. Each variable maps to the
+set of mesh axes its value may VARY over:
+
+- inputs vary over the axes their ``in_names`` shard them on; consts and
+  literals are replicated;
+- ``axis_index(a)`` introduces variance over ``a``;
+- ``psum``/``pmax``/``pmin`` REMOVE their reduced axes (the result is
+  provably equal on every participant); ``all_gather`` likewise;
+- ``all_to_all``/``ppermute``/``pshuffle``/``psum_scatter`` ADD their
+  axis (each shard receives different data);
+- ``scan``/``while`` iterate their carry to a fixpoint (monotone in a
+  finite lattice, so ≤ |axes| rounds); a shard-varying ``while``
+  predicate taints every carry (per-shard trip counts); ``cond`` joins
+  its branches and its predicate;
+- anything else unions its inputs — sound for every shard-agnostic
+  primitive, i.e. everything except the collectives handled above.
+
+A violation is an output whose varying set intersects the axes its
+``out_names`` entry claims replication over. The same walk checks every
+collective names a live mesh axis.
+"""
+
+from __future__ import annotations
+
+from tools.lint.model import Finding
+
+#: Reduce-to-replicated collectives: result provably equal across `axes`.
+_REDUCING = {"psum", "pmax", "pmin", "all_gather", "all_gather_invariant"}
+#: Shard-shuffling collectives: result differs per shard along `axis`.
+_SHUFFLING = {"all_to_all", "ppermute", "pshuffle", "psum_scatter", "pvary"}
+#: Everything S1 counts as a collective call site (axis-liveness check).
+COLLECTIVES = _REDUCING | _SHUFFLING | {"axis_index", "pbroadcast"}
+
+_LOOPS = {"scan", "while", "cond"}
+
+
+def _is_literal(atom) -> bool:
+    return hasattr(atom, "val") and not hasattr(atom, "count")
+
+
+def _axis_names(params) -> tuple:
+    """Normalize a collective's axis parameter (``axes`` or ``axis_name``,
+    scalar or tuple, possibly mixed with positional ints under vmap) to a
+    tuple of mesh-axis NAMES."""
+    ax = params.get("axes", params.get("axis_name", ()))
+    if not isinstance(ax, (tuple, list)):
+        ax = (ax,)
+    return tuple(a for a in ax if isinstance(a, str))
+
+
+def _named_sets(names) -> frozenset:
+    """The mesh axes a shard_map in_names/out_names entry shards over."""
+    return frozenset(ax for axes in names.values() for ax in axes)
+
+
+def _closed_parts(obj):
+    """(raw jaxpr, consts) from either a ClosedJaxpr or a raw Jaxpr."""
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and hasattr(obj, "consts"):
+        return inner, obj.consts
+    return obj, ()
+
+
+def _introduced_axes(jaxpr) -> frozenset:
+    """Axes any nested primitive could make a value vary over — the
+    conservative contribution of a sub-jaxpr we can't map arg-for-arg."""
+    out = set()
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name in _SHUFFLING or name == "axis_index":
+                out.update(_axis_names(eqn.params))
+            for v in eqn.params.values():
+                for sub in _param_jaxprs(v):
+                    stack.append(sub)
+    return frozenset(out)
+
+
+def _param_jaxprs(value):
+    """Yield raw jaxprs inside one params value (mirrors semantic.jaxprs)."""
+    if hasattr(value, "eqns"):
+        yield value
+    elif hasattr(value, "jaxpr") and hasattr(value, "consts"):
+        yield value.jaxpr
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from _param_jaxprs(v)
+
+
+def analyze(jaxpr, in_sets, mesh_axes):
+    """Abstract-interpret one (raw) jaxpr; returns the outvars' varying
+    sets. ``in_sets`` must match ``jaxpr.invars``."""
+    env: dict = {}
+
+    def read(atom):
+        if _is_literal(atom):
+            return frozenset()
+        return env.get(atom, frozenset())
+
+    def write(var, s):
+        env[var] = s
+
+    for v, s in zip(jaxpr.invars, in_sets):
+        write(v, s)
+    for v in jaxpr.constvars:
+        write(v, frozenset())
+
+    for eqn in jaxpr.eqns:
+        ins = [read(a) for a in eqn.invars]
+        outs = _transfer(eqn, ins, mesh_axes)
+        for v, s in zip(eqn.outvars, outs):
+            write(v, s)
+    return [read(v) for v in jaxpr.outvars]
+
+
+def _transfer(eqn, ins, mesh_axes):
+    name = eqn.primitive.name
+    union = frozenset().union(*ins) if ins else frozenset()
+
+    if name == "axis_index":
+        return [frozenset(_axis_names(eqn.params))]
+    if name in {"psum", "pmax", "pmin"}:
+        # n-ary: operand i maps to output i, each loses the reduced axes.
+        axes = frozenset(_axis_names(eqn.params))
+        return [s - axes for s in ins]
+    if name in _REDUCING:  # all_gather family — single operand
+        axes = frozenset(_axis_names(eqn.params))
+        return [union - axes for _ in eqn.outvars]
+    if name in _SHUFFLING:
+        axes = frozenset(_axis_names(eqn.params))
+        return [union | axes for _ in eqn.outvars]
+    if name == "pbroadcast":
+        return [union for _ in eqn.outvars]
+
+    if name == "scan":
+        body, _ = _closed_parts(eqn.params["jaxpr"])
+        nc = eqn.params["num_consts"]
+        ncar = eqn.params["num_carry"]
+        consts, carry, xs = ins[:nc], list(ins[nc : nc + ncar]), ins[nc + ncar :]
+        body_outs = None
+        for _ in range(len(mesh_axes) + 1):
+            body_outs = analyze(body, consts + carry + xs, mesh_axes)
+            new_carry = [c | b for c, b in zip(carry, body_outs[:ncar])]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        return carry + body_outs[ncar:]
+
+    if name == "while":
+        cond, _ = _closed_parts(eqn.params["cond_jaxpr"])
+        body, _ = _closed_parts(eqn.params["body_jaxpr"])
+        cn = eqn.params["cond_nconsts"]
+        bn = eqn.params["body_nconsts"]
+        cconsts, bconsts = ins[:cn], ins[cn : cn + bn]
+        carry = list(ins[cn + bn :])
+        pred = frozenset()
+        for _ in range(len(mesh_axes) + 1):
+            pred = analyze(cond, cconsts + carry, mesh_axes)[0]
+            body_outs = analyze(body, bconsts + carry, mesh_axes)
+            new_carry = [c | b for c, b in zip(carry, body_outs)]
+            if new_carry == carry:
+                break
+            carry = new_carry
+        # A shard-varying predicate means per-shard trip counts: every
+        # carry leaf may then differ across those axes.
+        return [c | pred for c in carry]
+
+    if name == "cond":
+        pred, ops = ins[0], ins[1:]
+        out_sets = None
+        for br in eqn.params["branches"]:
+            body, _ = _closed_parts(br)
+            outs = analyze(body, list(ops), mesh_axes)
+            out_sets = (
+                outs
+                if out_sets is None
+                else [a | b for a, b in zip(out_sets, outs)]
+            )
+        return [s | pred for s in out_sets]
+
+    # Call-like primitives (pjit / closed_call / remat / custom_*): recurse
+    # when the sub-jaxpr maps arg-for-arg; otherwise fall back to the
+    # input union plus every axis the sub-jaxpr could introduce.
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        if key in eqn.params:
+            body, _ = _closed_parts(eqn.params[key])
+            if len(body.invars) == len(ins):
+                return analyze(body, ins, mesh_axes)
+            intro = _introduced_axes(body)
+            return [union | intro for _ in eqn.outvars]
+
+    return [union for _ in eqn.outvars]
+
+
+def _walk(jaxpr):
+    """Yield every eqn in a raw jaxpr, recursively through params."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in _param_jaxprs(v):
+                yield from _walk(sub)
+
+
+def shard_map_eqns(closed):
+    """The shard_map eqns anywhere inside a traced ClosedJaxpr."""
+    jaxpr, _ = _closed_parts(closed)
+    return [e for e in _walk(jaxpr) if e.primitive.name == "shard_map"]
+
+
+def check_s1(entry) -> tuple[list[Finding], int]:
+    """Run axis-liveness + replication analysis over one traced entry.
+    Returns ``(findings, collective_sites_verified)``."""
+    findings: list[Finding] = []
+    n_sites = 0
+    for sm in shard_map_eqns(entry.closed):
+        mesh_axes = frozenset(sm.params["mesh"].axis_names)
+        body = sm.params["jaxpr"]
+        in_names = sm.params["in_names"]
+        out_names = sm.params["out_names"]
+
+        for sub in _walk(body):
+            prim = sub.primitive.name
+            if prim not in COLLECTIVES:
+                continue
+            n_sites += 1
+            for ax in _axis_names(sub.params):
+                if ax not in mesh_axes:
+                    findings.append(
+                        Finding(
+                            rule="S1",
+                            path=entry.path,
+                            line=entry.line,
+                            message=f"[{entry.name}] {prim} names axis "
+                            f"{ax!r} but the mesh only has "
+                            f"{sorted(mesh_axes)}",
+                            hint="collectives must name a live mesh axis; "
+                            "a dead name means the exchange silently "
+                            "doesn't happen",
+                        )
+                    )
+
+        in_sets = [_named_sets(names) for names in in_names]
+        out_sets = analyze(body, in_sets, mesh_axes)
+        for j, (names, varying) in enumerate(zip(out_names, out_sets)):
+            required_rep = mesh_axes - _named_sets(names)
+            bad = varying & required_rep
+            if bad:
+                findings.append(
+                    Finding(
+                        rule="S1",
+                        path=entry.path,
+                        line=entry.line,
+                        message=f"[{entry.name}] shard_map output #{j} is "
+                        f"declared replicated over {sorted(bad)} but its "
+                        "value can vary across those shards",
+                        hint="reduce the partial (psum/pmax) or shard the "
+                        "output spec; with check_rep=False nothing else "
+                        "catches this",
+                    )
+                )
+    return findings, n_sites
