@@ -46,7 +46,11 @@ shards with bucketed cross-shard exchange; rows are stamped with the
 shard count, the resolved bucket capacity and the exchange-round count,
 and both the backend probe attempt and the result row land in
 artifacts/bench_history.jsonl. On a CPU-only box set JAX_PLATFORMS=cpu
-and the rung forces d virtual host devices itself).
+and the rung forces d virtual host devices itself), or ``python bench.py
+--serve [n]`` (the streaming serving-bridge rung, serve/: a synthetic
+event stream replayed through the double-buffered launch pipeline; the
+``kind="serve"`` session row — events/s, member·rounds/s, batch-latency
+percentiles — plus the probe attempt land in bench_history.jsonl).
 """
 
 from __future__ import annotations
@@ -365,6 +369,72 @@ def _measure_rapid(n_members: int = 1024, chunk: int = 40, reps: int = 4) -> dic
         "engine": "rapid",
         "k_observers": params.k,
     }
+
+
+def _measure_serve(
+    n_members: int = 4096,
+    batch_ticks: int = 32,
+    capacity: int = 8,
+    n_batches: int = 8,
+) -> dict:
+    """The ``--serve [n]`` rung: the streaming serving bridge (serve/)
+    replaying a synthetic user-gossip event stream through the double-
+    buffered launch pipeline, ``collect=False``, under the bench's standard
+    one-kill + 5%-loss trajectory. The row is the bridge's own
+    ``kind="serve"`` session summary — events/s ingested-to-verdict,
+    member·rounds/s through the serving path, and per-launch batch-latency
+    percentiles (obs/latency.py) — so the serving overhead reads directly
+    against the offline engine rungs in bench_history.jsonl. A one-batch
+    warmup session on a throwaway state pays the (params, k, C) compile so
+    the timed session measures steady-state serving, which is what the
+    executable-reuse contract promises."""
+    from scalecube_cluster_tpu.serve import EV_GOSSIP, ServeBridge, ServeEvent
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+        kill_sparse,
+    )
+
+    params = SparseParams.for_n(
+        n_members, slot_budget=_rung_slot_budget(n_members)
+    )
+    plan = FaultPlan.uniform(loss_percent=5.0)
+
+    warm = ServeBridge(
+        params,
+        init_sparse_full_view(n_members, params.slot_budget),
+        plan=plan,
+        batch_ticks=batch_ticks,
+        capacity=capacity,
+        collect=False,
+    )
+    warm.run_replay([], batch_ticks)
+
+    state = kill_sparse(init_sparse_full_view(n_members, params.slot_budget), 7)
+    bridge = ServeBridge(
+        params,
+        state,
+        plan=plan,
+        batch_ticks=batch_ticks,
+        capacity=capacity,
+        collect=False,
+    )
+    g_slots = bridge.batcher.g_slots
+    total_ticks = batch_ticks * n_batches
+    per_tick = max(capacity // 2, 1)
+    events = [
+        ServeEvent(
+            EV_GOSSIP,
+            (t * per_tick + j) % n_members,
+            arg=(t + j) % g_slots,
+            tick=t,
+        )
+        for t in range(1, total_ticks + 1)
+        for j in range(per_tick)
+    ]
+    bridge.run_replay(events, total_ticks)
+    return bridge.close()
 
 
 def _measure(engine: str, n_members: int, slot_budget: int | None = None) -> dict:
@@ -706,6 +776,46 @@ if __name__ == "__main__":
         else:
             out = _measure_shard_map(d_arg, n_arg)
             row = make_row("bench_shard_map", out, run_metadata(seed=0))
+        try:
+            append_jsonl(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "artifacts",
+                    "bench_history.jsonl",
+                ),
+                [row],
+            )
+        except Exception:
+            pass
+        print(jsonl_line(row), flush=True)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--serve":
+        try:
+            from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+            enable_repo_jax_cache()
+        except Exception:
+            pass
+        from scalecube_cluster_tpu.obs.export import (
+            append_jsonl,
+            jsonl_line,
+            make_row,
+            run_metadata,
+        )
+
+        n_arg = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+        # One recorded backend probe first (same discipline as --shard-map:
+        # outage budget must leave evidence in bench_history.jsonl).
+        t_probe = time.monotonic()
+        probe_err = _probe_once()
+        _record_probe_attempt(1, probe_err, time.monotonic() - t_probe)
+        if probe_err is not None:
+            row = make_row(
+                "serve",
+                {"error": probe_err, "n_members": n_arg, **_self_evidence()},
+                run_metadata(seed=0),
+            )
+        else:
+            row = _measure_serve(n_arg)
         try:
             append_jsonl(
                 os.path.join(

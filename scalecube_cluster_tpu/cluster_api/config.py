@@ -126,6 +126,13 @@ class TransportConfig(_WithMixin):
     reconnect_backoff_min_ms: int = 50
     reconnect_backoff_max_ms: int = 2_000
     reconnect_backoff_jitter: float = 0.2
+    #: Grace window ``stop()`` gives accepted-connection handlers to finish
+    #: dispatching frames already received (a peer that wrote then closed —
+    #: the serving bridge's live ingestion relies on this: shutting the
+    #: listener down must DRAIN in-flight events, not cancel them mid-frame).
+    #: Handlers still running at expiry are cancelled as before; 0 restores
+    #: the old cancel-immediately behavior.
+    stop_drain_ms: int = 250
 
     @classmethod
     def default_lan(cls) -> "TransportConfig":

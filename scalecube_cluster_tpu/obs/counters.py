@@ -37,6 +37,15 @@ from __future__ import annotations
 #                      per-destination bucket was full this tick (explicit
 #                      shard_map engine, parallel/spmd.py; the single-program
 #                      engines have no buckets and emit constant 0)
+#   ingest_overflow    live/replayed events the serving bridge could not fit
+#                      into their target tick's fixed event capacity and
+#                      DEFERRED to a later tick — never dropped (serve/,
+#                      the host-outran-the-budget signal; offline engines
+#                      have no ingest path and emit constant 0)
+#   serve_batches      event batches the serving bridge completed (stamped
+#                      into serve session rows from host accounting;
+#                      per-tick engine metrics emit constant 0 — a batch is
+#                      a launch, not a tick event)
 SHARED_COUNTERS: tuple[str, ...] = (
     "pings",
     "ping_reqs",
@@ -54,6 +63,8 @@ SHARED_COUNTERS: tuple[str, ...] = (
     "alarms_raised",
     "cut_detected",
     "exchange_overflow",
+    "ingest_overflow",
+    "serve_batches",
 )
 
 # Emitted by the sparse engine only — they measure the compact working-set

@@ -43,6 +43,30 @@ def detection_latencies(state, kill_ticks) -> dict:
     }
 
 
+def percentile_summary(
+    values, percentiles: tuple[float, ...] = (50.0, 95.0, 99.0)
+) -> dict:
+    """p50/p95/p99-style summary of a float sample, JSON-serializable.
+
+    The serving bridge's SLO rollup (serve/bridge.py feeds per-batch
+    ingest→verdict wall-clock milliseconds); shape-agnostic, so any
+    latency-like sample works. Empty input returns ``{"count": 0}`` so
+    callers can merge it into a row unconditionally.
+    """
+    vals = np.asarray(list(values), np.float64)
+    if vals.size == 0:
+        return {"count": 0}
+    out = {
+        "count": int(vals.size),
+        "mean": float(vals.mean()),
+        "max": float(vals.max()),
+    }
+    for p in percentiles:
+        label = int(p) if float(p).is_integer() else p
+        out[f"p{label}"] = float(np.percentile(vals, p))
+    return out
+
+
 def latency_histogram(latencies: np.ndarray, n_bins: int = 16) -> dict:
     """Histogram + summary stats for one latency array, JSON-serializable."""
     lat = np.asarray(latencies, np.int64)

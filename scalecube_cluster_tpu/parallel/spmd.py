@@ -73,8 +73,7 @@ from scalecube_cluster_tpu.sim.faults import FaultPlan, _edge_lookup, link_pass_
 from scalecube_cluster_tpu.sim.knobs import Knobs, edge_live, suspicion_fill
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
-    events_at,
-    plan_at,
+    resolve_tick,
     plan_dirty_at,
 )
 from scalecube_cluster_tpu.sim.sparse import (
@@ -809,6 +808,9 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         # The one counter the bucketed exchange OWNS: blocks dropped to
         # capacity this tick (provably 0 at the default capacity).
         "exchange_overflow": summed["exchange_overflow"],
+        # Serving-bridge counters (serve/): no ingest path offline.
+        "ingest_overflow": jnp.zeros((), jnp.int32),
+        "serve_batches": jnp.zeros((), jnp.int32),
     }
     return new_state, metrics
 
@@ -823,8 +825,7 @@ def _scan_body(params, cfg, n_ticks, collect, scheduled):
             if not scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
                 return _tick_spmd(params, cfg, carry, plan, collect=collect, knobs=kn)
             t = carry.tick + 1
-            kill_m, restart_m = events_at(plan, t, params.base.n)
-            plan_t = plan_at(plan, t)
+            plan_t, (kill_m, restart_m) = resolve_tick(plan, t, params.base.n)
             new_state, metrics = _tick_spmd(
                 params,
                 cfg,

@@ -1,0 +1,53 @@
+"""serve/ — streaming live-traffic bridge over the device sim.
+
+Turns the sparse engine into a digital-twin serving system: join/leave/
+kill/restart/user-gossip traffic arrives from a live TCP session
+(transport/tcp.py) or a deterministic JSONL trace replay (ingest.py),
+is batched into fixed-shape per-tick event tensors (events.py::EventBatch
+— the live-traffic generalization of sim/schedule.py's compact event
+encoding), and steps the engine ``k`` ticks per launch through donated
+double-buffered host→device transfers with zero recompiles (bridge.py).
+Verdict and SLO-latency rows stream out through the schema-versioned
+exporter (obs/export.py).
+
+Correctness anchor: a trace replayed through the bridge is bit-identical
+to the equivalent offline :class:`~scalecube_cluster_tpu.sim.schedule.FaultSchedule`
+run (tests/test_serve.py) — the event masks are value-equal and mask
+application consumes no RNG, so the trajectories cannot diverge.
+"""
+
+from scalecube_cluster_tpu.serve.bridge import ServeBridge
+from scalecube_cluster_tpu.serve.engine import run_serve_batch
+from scalecube_cluster_tpu.serve.events import (
+    EV_GOSSIP,
+    EV_KILL,
+    EV_RESTART,
+    EventBatch,
+    event_masks,
+)
+from scalecube_cluster_tpu.serve.ingest import (
+    SERVE_QUALIFIER,
+    EventBatcher,
+    ServeEvent,
+    TcpEventSource,
+    event_from_message,
+    load_trace,
+    parse_trace_line,
+)
+
+__all__ = [
+    "EV_GOSSIP",
+    "EV_KILL",
+    "EV_RESTART",
+    "EventBatch",
+    "EventBatcher",
+    "SERVE_QUALIFIER",
+    "ServeBridge",
+    "ServeEvent",
+    "TcpEventSource",
+    "event_from_message",
+    "event_masks",
+    "load_trace",
+    "parse_trace_line",
+    "run_serve_batch",
+]

@@ -1,0 +1,274 @@
+"""ServeBridge: the host-side pipeline turning ingested traffic into launches.
+
+One bridge owns a sparse-engine state and steps it ``k`` ticks per launch
+through :func:`~scalecube_cluster_tpu.serve.engine.run_serve_batch`. The
+launch pipeline is double-buffered: the moment launch ``i`` is dispatched
+(JAX async dispatch returns before the device finishes), the host assembles
+batch ``i+1`` and starts its ``jax.device_put`` — so host packing and the
+H2D transfer of the next batch overlap the device executing the current one,
+and the device never waits on ingestion unless the host genuinely outran the
+budget (visible as ``ingest_overflow``, never as a stall-and-drop).
+
+Every launch emits a ``kind="serve_batch"`` row and the session close a
+``kind="serve"`` summary row through the schema-versioned exporter
+(obs/export.py), with ingest→verdict SLO latency percentiles from
+obs/latency.py::percentile_summary.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import jax
+import numpy as np
+
+from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS
+from scalecube_cluster_tpu.obs.export import append_jsonl, make_row, run_metadata
+from scalecube_cluster_tpu.obs.latency import percentile_summary
+from scalecube_cluster_tpu.serve.engine import run_serve_batch
+from scalecube_cluster_tpu.serve.ingest import EventBatcher, ServeEvent, TcpEventSource
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.knobs import Knobs
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    SparseState,
+    writeback_free,
+)
+
+
+class ServeBridge:
+    """Digital-twin serving session over one sparse-engine state.
+
+    ``batch_ticks`` (k) and ``capacity`` (C) fix the launch geometry — ONE
+    compiled executable per (params, k, C) for the whole session. The state
+    is donated into every launch; callers must not hold references to it
+    across :meth:`run_replay` / :meth:`run_live`.
+
+    With ``params.in_scan_writeback=True`` (the small/mid-n default) the
+    session is bit-identical to one offline ``run_sparse_ticks`` call over
+    the same timeline; with the big-n host-boundary mode the bridge frees
+    slots between launches exactly like ``run_sparse_chunked`` with
+    ``chunk=batch_ticks``.
+    """
+
+    def __init__(
+        self,
+        params: SparseParams,
+        state: SparseState,
+        *,
+        plan: FaultPlan | None = None,
+        batch_ticks: int = 8,
+        capacity: int = 4,
+        knobs: Knobs | None = None,
+        collect: bool = True,
+        export_path: str | None = None,
+        meta: dict | None = None,
+    ):
+        self.params = params
+        self.state = state
+        self.plan = plan if plan is not None else FaultPlan.uniform()
+        self.knobs = knobs
+        self.collect = collect
+        self.export_path = export_path
+        g_slots = int(state.useen.shape[1])
+        self.batcher = EventBatcher(
+            params.base.n, g_slots, batch_ticks, capacity
+        )
+        self.meta = (
+            meta
+            if meta is not None
+            else run_metadata(n=params.base.n, slot_budget=params.slot_budget)
+        )
+        self.rows: list[dict] = []
+        self.serve_batches = 0  # host accounting: a batch is a launch
+        self.ticks_run = 0
+        self.events_served = 0
+        self._lat_ms: list[float] = []
+        self._exec_s = 0.0
+        self._counter_totals = {k: 0 for k in SHARED_COUNTERS}
+
+    # -- ingestion ----------------------------------------------------------
+
+    def push(self, ev: ServeEvent) -> None:
+        """Enqueue one event (trace replay / programmatic producers)."""
+        self.batcher.push(ev)
+
+    # -- launch pipeline ----------------------------------------------------
+
+    def _assemble(self, base_tick: int):
+        """Pack the next batch and START its device transfer (the pipeline
+        stage that overlaps the previous launch's execution)."""
+        batch, stats = self.batcher.next_batch(base_tick)
+        stats["t_assemble"] = time.monotonic()
+        return jax.device_put(batch), stats
+
+    def _execute(self, batch_dev, stats: dict):
+        """Dispatch one launch (returns before the device finishes)."""
+        self.state, traces = run_serve_batch(
+            self.params,
+            self.state,
+            self.plan,
+            batch_dev,
+            collect=self.collect,
+            knobs=self.knobs,
+        )
+        return batch_dev, stats, traces
+
+    def _finish_launch(self, stats: dict, traces) -> dict:
+        """Block until the launch's verdicts are ready; emit its row.
+
+        The SLO window opens at the earliest ``t_ingest`` among the batch's
+        events (live mode: true ingest→verdict wall time) and falls back to
+        assembly start for event-free or replayed batches (replay stamps
+        ingestion at push time, which would measure queue residency, not
+        serving latency).
+        """
+        traces = jax.device_get(jax.block_until_ready((self.state.tick, traces)))[1]
+        t_done = time.monotonic()
+        if not self.params.in_scan_writeback:
+            # Big-n host-boundary mode: free done slots between launches,
+            # exactly run_sparse_chunked's cadence with chunk=batch_ticks.
+            self.state = writeback_free(self.params, self.state)
+        t0 = stats.get("oldest_ingest") or stats["t_assemble"]
+        lat_ms = (t_done - t0) * 1000.0
+        exec_s = t_done - stats["t_assemble"]
+        self._lat_ms.append(lat_ms)
+        self._exec_s += exec_s
+        self.serve_batches += 1
+        self.ticks_run += self.batcher.n_ticks
+        self.events_served += stats["n_events"]
+        payload = {
+            "batch": self.serve_batches - 1,
+            "base_tick": int(stats["base_tick"]),
+            "batch_ticks": self.batcher.n_ticks,
+            "capacity": self.batcher.capacity,
+            "n_events": stats["n_events"],
+            "ingest_overflow": stats["n_deferred"],
+            "latency_ms": lat_ms,
+        }
+        if self.collect:
+            for k in SHARED_COUNTERS:
+                if k in traces:
+                    self._counter_totals[k] += int(np.sum(traces[k]))
+            for k in ("kills_fired", "restarts_fired", "gossip_fired",
+                      "verdicts_dead", "verdicts_alive"):
+                payload[k] = int(np.sum(traces[k]))
+        row = make_row("serve_batch", payload, self.meta)
+        self.rows.append(row)
+        return traces
+
+    def step_batch(self):
+        """Assemble → transfer → execute → record ONE launch (no lookahead).
+
+        The unpipelined primitive :meth:`run_replay` double-buffers around;
+        live mode uses it directly so each launch sees the freshest traffic.
+        Returns the launch's device-fetched traces (collected mode).
+        """
+        base = int(jax.device_get(self.state.tick))
+        batch_dev, stats = self._assemble(base)
+        stats["base_tick"] = base
+        _, stats, traces = self._execute(batch_dev, stats)
+        return self._finish_launch(stats, traces)
+
+    def run_replay(self, events, n_ticks: int) -> list:
+        """Replay ``events`` deterministically for ``n_ticks`` ticks.
+
+        Double-buffered: batch ``i+1`` is assembled and its ``device_put``
+        issued right after launch ``i`` dispatches, before blocking on
+        ``i``'s verdicts. Returns the per-launch trace dicts.
+        """
+        for ev in events:
+            # Unstamped: the SLO window of a replayed batch opens at its
+            # assembly, not at trace load (see _finish_launch).
+            self.batcher.push(ev, stamp=False)
+        k = self.batcher.n_ticks
+        n_batches = -(-n_ticks // k)
+        out = []
+        base = int(jax.device_get(self.state.tick))
+        pending = self._assemble(base)
+        pending[1]["base_tick"] = base
+        for i in range(n_batches):
+            batch_dev, stats = pending
+            _, stats, traces = self._execute(batch_dev, stats)
+            if i + 1 < n_batches:
+                # Overlap: pack + H2D of the next batch while the device
+                # executes this one (dispatch above returned immediately).
+                next_base = base + (i + 1) * k
+                pending = self._assemble(next_base)
+                pending[1]["base_tick"] = next_base
+            out.append(self._finish_launch(stats, traces))
+        return out
+
+    async def run_live(
+        self, transport, n_batches: int, settle_s: float = 0.0
+    ) -> list:
+        """Serve ``n_batches`` launches from a live transport session.
+
+        A pump task drains ``serve/event`` messages into the batcher; each
+        launch picks up whatever arrived since the last one. ``settle_s``
+        yields to the loop between launches so socket reads land (loopback
+        tests use a small value; a real deployment would pace on its tick
+        deadline).
+        """
+        src = TcpEventSource(transport)
+        pump = asyncio.ensure_future(src.pump(self.batcher))
+        out = []
+        try:
+            for _ in range(n_batches):
+                if settle_s:
+                    await asyncio.sleep(settle_s)
+                await asyncio.sleep(0)  # let queued frames reach the batcher
+                out.append(self.step_batch())
+        finally:
+            pump.cancel()
+            try:
+                await pump
+            except asyncio.CancelledError:
+                pass
+        return out
+
+    # -- session rollup -----------------------------------------------------
+
+    def counters(self) -> dict:
+        """Session counter totals on the SHARED_COUNTERS schema.
+
+        Trace sums carry the true per-tick values (including the serve
+        runner's ``ingest_overflow`` override); ``serve_batches`` is pure
+        host accounting — a batch is a launch, not a tick event — stamped
+        here over the engines' constant-zero schema slot.
+        """
+        totals = dict(self._counter_totals)
+        totals["serve_batches"] = self.serve_batches
+        return totals
+
+    def summary_row(self) -> dict:
+        """The ``kind="serve"`` session row (bench + artifacts schema)."""
+        lat = percentile_summary(self._lat_ms)
+        exec_s = max(self._exec_s, 1e-9)
+        payload = {
+            "batches": self.serve_batches,
+            "batch_ticks": self.batcher.n_ticks,
+            "capacity": self.batcher.capacity,
+            "ticks": self.ticks_run,
+            "events_total": self.events_served,
+            "events_pending": len(self.batcher),
+            "ingest_overflow": self.batcher.overflow_total,
+            "events_per_sec": self.events_served / exec_s,
+            "member_rounds_per_sec": self.params.base.n * self.ticks_run / exec_s,
+            "latency_ms_p50": lat.get("p50", 0.0),
+            "latency_ms_p95": lat.get("p95", 0.0),
+            "latency_ms_p99": lat.get("p99", 0.0),
+            "latency_ms_mean": lat.get("mean", 0.0),
+        }
+        if self.collect:
+            payload["counters"] = self.counters()
+        return make_row("serve", payload, self.meta)
+
+    def close(self) -> dict:
+        """Finalize: append the summary row and flush to ``export_path``."""
+        summary = self.summary_row()
+        self.rows.append(summary)
+        if self.export_path:
+            append_jsonl(self.export_path, self.rows)
+        return summary

@@ -1,0 +1,83 @@
+"""Jitted serve step: k sparse-engine ticks driven by one EventBatch.
+
+The serving twin of sim/sparse.py::run_sparse_ticks — same donated state,
+same scan, but the per-tick event masks come from the batch's rows instead
+of a FaultSchedule gather (the other producer of the ``resolve_tick``
+contract, sim/schedule.py). One executable serves every launch of the same
+``(params, k, capacity)`` geometry: the batch tensors are traced data, the
+plan is a fixed FaultPlan, and nothing else about the call varies — the
+zero-recompile pin in tests/test_serve.py reads
+utils/jaxcache.py::jit_cache_size across a whole session to certify it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from scalecube_cluster_tpu.serve.events import EventBatch, event_masks
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.knobs import Knobs
+from scalecube_cluster_tpu.sim.sparse import SparseParams, SparseState, sparse_tick
+
+
+@partial(jax.jit, static_argnums=(0,), static_argnames=("collect",), donate_argnums=(1,))
+def run_serve_batch(
+    params: SparseParams,
+    state: SparseState,
+    plan: FaultPlan,
+    batch: EventBatch,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Step the sparse engine ``batch.n_ticks`` ticks, one batch row per tick.
+
+    Returns ``(state, traces)`` with the scheduled runners' trace schema
+    (``plan_dirty`` / ``kills_fired`` / ``restarts_fired`` extras included,
+    computed from the fixed plan and the resolved masks) plus the serve
+    extras: ``gossip_fired`` and the per-tick ``ingest_overflow`` override —
+    the batcher's deferral counts replace the tick core's constant-zero
+    schema slot, so a collected serve trace sums to the session's true
+    host-outran-the-budget total.
+
+    The input state is DONATED exactly like run_sparse_ticks (rebind the
+    result); the batch is NOT donated — the bridge keeps the next batch's
+    transfer in flight while this one executes (double buffering).
+    """
+    n = params.base.n
+    g_slots = state.useen.shape[1]
+    # The plan is fixed for the whole launch, so its dirtiness — the same
+    # predicate ScheduleBuilder precomputes per segment — is one reduction
+    # outside the scan, broadcast into every tick's trace row.
+    dirty = (
+        jnp.any(plan.block)
+        | jnp.any(plan.loss > 0)
+        | jnp.any(plan.mean_delay > 0)
+    )
+
+    def step(carry, xs):
+        node, kind, arg, deferred = xs
+        kill_m, restart_m, gossip_m = event_masks(node, kind, arg, n, g_slots)
+        new_state, metrics = sparse_tick(
+            params,
+            carry,
+            plan,
+            collect=collect,
+            events=(kill_m, restart_m, gossip_m),
+            knobs=knobs,
+        )
+        if collect:
+            metrics = dict(metrics)
+            metrics["plan_dirty"] = dirty
+            metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
+            metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+            metrics["gossip_fired"] = jnp.sum(gossip_m, dtype=jnp.int32)
+            metrics["ingest_overflow"] = deferred
+        return new_state, metrics
+
+    return lax.scan(
+        step, state, (batch.node, batch.kind, batch.arg, batch.deferred)
+    )

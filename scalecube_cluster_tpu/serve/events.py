@@ -1,0 +1,106 @@
+"""Fixed-shape per-tick event tensors for the serving bridge.
+
+:class:`EventBatch` generalizes :class:`~scalecube_cluster_tpu.sim.schedule.FaultSchedule`'s
+compact ``(tick, node, kind)`` event encoding to LIVE traffic: instead of a
+schedule-lifetime event table gathered by global tick, a batch carries a
+``[k, C]`` slab of events — row ``r`` holds the (at most ``C``) events firing
+at the ``r``-th tick of the launch, unused cells carry node -1. ``k`` and
+``C`` are static shapes, so one executable serves every batch of the same
+geometry (the zero-recompile contract, pinned by tests/test_serve.py).
+
+:func:`event_masks` resolves one row into the same ``(kill, restart, gossip)``
+bool-mask contract :func:`~scalecube_cluster_tpu.sim.schedule.events_at`
+produces for schedules — same scatter ops, same clamp convention — so a
+replayed batch whose cells match a schedule's events yields value-identical
+masks and therefore a bit-identical trajectory (mask application consumes no
+RNG; see sim/schedule.py::resolve_tick).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.tree_util import register_dataclass
+
+from scalecube_cluster_tpu.sim.schedule import EV_KILL, EV_RESTART
+
+#: Serve-level event kind beyond the schedule's kill/restart: enqueue user
+#: gossip payload ``arg`` at ``node`` (the in-scan twin of
+#: sim/sparse.py::inject_gossip_sparse, applied via the 3-tuple events path
+#: of sparse_tick). Schedules have no gossip events, so the id lives here.
+EV_GOSSIP = 2
+
+
+@register_dataclass
+@dataclass
+class EventBatch:
+    """One launch worth of ingested events, ``k`` ticks × ``C`` event slots.
+
+    ``node[r, c] == -1`` marks an unused cell (the whole cell is inert,
+    mirroring ``ev_tick == -1`` slots in a FaultSchedule). ``arg`` is the
+    user-gossip payload slot for EV_GOSSIP cells and ignored otherwise.
+    ``deferred[r]`` counts events whose target was the ``r``-th tick but
+    which the batcher could not fit under capacity ``C`` — they fire later
+    (never dropped); the serve runner stamps this count into the tick's
+    ``ingest_overflow`` metric (obs/counters.py).
+    """
+
+    node: jax.Array  # [k, C] int32, -1 = unused cell
+    kind: jax.Array  # [k, C] int32 EV_KILL | EV_RESTART | EV_GOSSIP
+    arg: jax.Array  # [k, C] int32 gossip payload slot (EV_GOSSIP only)
+    deferred: jax.Array  # [k] int32 events deferred past their target tick
+
+    def replace(self, **changes) -> "EventBatch":
+        return dataclasses.replace(self, **changes)
+
+    @property
+    def n_ticks(self) -> int:
+        return self.node.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.node.shape[1]
+
+
+def empty_batch(n_ticks: int, capacity: int) -> EventBatch:
+    """An all-inert batch (host-side numpy; device transfer is the caller's
+    pipeline stage — serve/bridge.py overlaps it with the previous launch)."""
+    return EventBatch(
+        node=np.full((n_ticks, capacity), -1, np.int32),
+        kind=np.zeros((n_ticks, capacity), np.int32),
+        arg=np.zeros((n_ticks, capacity), np.int32),
+        deferred=np.zeros((n_ticks,), np.int32),
+    )
+
+
+def event_masks(
+    node: jax.Array,
+    kind: jax.Array,
+    arg: jax.Array,
+    n: int,
+    g_slots: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Resolve one batch row into ``(kill [N], restart [N], gossip [N, G])``.
+
+    The kill/restart scatters are the exact ops of
+    sim/schedule.py::events_at (fire-guarded ``.at[clipped].max``), so a
+    batch cell ``(node, EV_KILL)`` and a schedule event ``(t, node, EV_KILL)``
+    firing the same tick produce the SAME mask values — the bit-parity
+    anchor of the replay path. The gossip scatter extends the idiom to the
+    ``[N, G]`` user-gossip plane consumed by
+    sim/sparse.py::apply_events_sparse's optional third mask.
+    """
+    fire = node >= 0
+    safe = jnp.clip(node, 0, n - 1)
+    zeros = jnp.zeros((n,), bool)
+    kill = zeros.at[safe].max(fire & (kind == EV_KILL))
+    restart = zeros.at[safe].max(fire & (kind == EV_RESTART))
+    slot = jnp.clip(arg, 0, g_slots - 1)
+    gossip = jnp.zeros((n, g_slots), bool).at[safe, slot].max(
+        fire & (kind == EV_GOSSIP)
+    )
+    return kill, restart, gossip

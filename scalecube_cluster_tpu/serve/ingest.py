@@ -1,0 +1,260 @@
+"""Event ingestion for the serving bridge: trace replay, live TCP, batching.
+
+Trace JSONL format (one event per line, blank lines and ``#`` comments
+skipped)::
+
+    {"tick": 17, "kind": "kill", "node": 5}
+    {"tick": 20, "kind": "join", "node": 5}
+    {"kind": "gossip", "node": 3, "slot": 1}
+
+- ``kind`` — one of ``kill``, ``leave``, ``restart``, ``join``, ``gossip``.
+  ``leave`` aliases to a kill and ``join`` to a restart: joins re-enter the
+  cluster through the kill/restart pipeline (a join is a fresh identity at a
+  bumped epoch — exactly what an in-scan restart applies; ROADMAP.md), and a
+  crash-stop is how the serving plane models an abrupt leave. The aliases
+  keep the wire vocabulary operator-shaped while the device side stays the
+  two-kind schedule contract plus gossip.
+- ``node`` — member index in ``[0, n)``.
+- ``tick`` — optional GLOBAL tick (1-based, the schedule convention) the
+  event should fire at; omitted means "as soon as possible" (the earliest
+  tick of the next batch with free capacity). Events whose tick already
+  passed also fire ASAP — deferred, never dropped.
+- ``slot`` — user-gossip payload slot in ``[0, G)``; ``gossip`` only.
+
+The same JSON objects ride live TCP sessions as ``Message.data`` under
+qualifier ``serve/event`` (transport/tcp.py length-framed frames), so a
+recorded trace and a live client are interchangeable producers.
+
+:class:`EventBatcher` packs pending events into fixed-shape
+:class:`~scalecube_cluster_tpu.serve.events.EventBatch` tensors. Capacity
+overflow is LOSSLESS: an event that does not fit its target tick's ``C``
+slots slides to the next tick with room (or the next batch), FIFO-stable,
+and each such slide increments the target tick's ``deferred`` counter —
+surfaced as the ``ingest_overflow`` counter (obs/counters.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from collections import deque
+from dataclasses import dataclass
+
+from scalecube_cluster_tpu.serve.events import (
+    EV_GOSSIP,
+    EV_KILL,
+    EV_RESTART,
+    EventBatch,
+    empty_batch,
+)
+from scalecube_cluster_tpu.transport.message import Message
+
+logger = logging.getLogger(__name__)
+
+#: Message qualifier live serve traffic rides under (transport.listen()
+#: multicasts everything; the source filters on this).
+SERVE_QUALIFIER = "serve/event"
+
+#: Wire vocabulary -> device event kind (module docstring: join/leave alias
+#: into the kill/restart pipeline).
+KIND_ALIASES = {
+    "kill": EV_KILL,
+    "leave": EV_KILL,
+    "restart": EV_RESTART,
+    "join": EV_RESTART,
+    "gossip": EV_GOSSIP,
+}
+
+
+@dataclass
+class ServeEvent:
+    """One ingested event, normalized to device kinds.
+
+    ``t_ingest`` is the host monotonic clock at ingestion — the start of the
+    SLO ingest→verdict window (obs/latency.py::percentile_summary rows).
+    """
+
+    kind: int
+    node: int
+    arg: int = 0
+    tick: int | None = None
+    t_ingest: float | None = None
+
+
+def event_from_obj(obj: dict) -> ServeEvent:
+    """Normalize one wire/trace JSON object (format: module docstring)."""
+    if not isinstance(obj, dict):
+        raise ValueError(f"serve event must be a JSON object, got {type(obj).__name__}")
+    kind_name = obj.get("kind")
+    if kind_name not in KIND_ALIASES:
+        raise ValueError(
+            f"unknown serve event kind {kind_name!r}; valid: {sorted(KIND_ALIASES)}"
+        )
+    kind = KIND_ALIASES[kind_name]
+    if "node" not in obj:
+        raise ValueError("serve event missing 'node'")
+    tick = obj.get("tick")
+    return ServeEvent(
+        kind=kind,
+        node=int(obj["node"]),
+        arg=int(obj.get("slot", 0)) if kind == EV_GOSSIP else 0,
+        tick=None if tick is None else int(tick),
+    )
+
+
+def parse_trace_line(line: str) -> ServeEvent | None:
+    """One trace line -> event; None for blank/comment lines."""
+    stripped = line.strip()
+    if not stripped or stripped.startswith("#"):
+        return None
+    return event_from_obj(json.loads(stripped))
+
+
+def load_trace(path: str) -> list[ServeEvent]:
+    """Load a whole JSONL trace file, in file order (replay determinism)."""
+    events = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            try:
+                ev = parse_trace_line(line)
+            except ValueError as exc:
+                raise ValueError(f"{path}:{lineno}: {exc}") from exc
+            if ev is not None:
+                events.append(ev)
+    return events
+
+
+def event_from_message(msg: Message) -> ServeEvent:
+    """Normalize a live transport message's payload."""
+    return event_from_obj(msg.data)
+
+
+class EventBatcher:
+    """Packs pending events into fixed-shape per-tick tensors, losslessly.
+
+    ``next_batch(base_tick)`` covers global ticks ``base_tick + 1 ..
+    base_tick + n_ticks``. Placement is FIFO-stable: each pending event
+    targets its requested tick's row (ASAP events and past-due ticks target
+    the first row), slides forward to the first row with free capacity if
+    the target is full — counting one deferral at the TARGET row, the tick
+    whose budget the host outran — and carries into a later batch when the
+    whole launch is full. Events are never dropped; when capacity is
+    adequate the packing reproduces a FaultSchedule's placement exactly
+    (the bit-parity precondition, tests/test_serve.py).
+    """
+
+    def __init__(self, n: int, g_slots: int, n_ticks: int, capacity: int):
+        if n_ticks < 1 or capacity < 1:
+            raise ValueError("need n_ticks >= 1 and capacity >= 1")
+        self.n = int(n)
+        self.g_slots = int(g_slots)
+        self.n_ticks = int(n_ticks)
+        self.capacity = int(capacity)
+        self._pending: deque[ServeEvent] = deque()
+        #: Session totals (host accounting; the bridge stamps them into rows).
+        self.pushed_total = 0
+        self.overflow_total = 0
+
+    def __len__(self) -> int:
+        return len(self._pending)
+
+    def push(self, ev: ServeEvent, stamp: bool = True) -> None:
+        """Validate and enqueue; stamps ``t_ingest`` if the source didn't.
+
+        ``stamp=False`` leaves an unset ``t_ingest`` unset — trace replay
+        uses it so per-batch SLO windows open at batch assembly instead of
+        measuring how long a pre-loaded trace sat in the queue.
+        """
+        if not 0 <= ev.node < self.n:
+            raise ValueError(f"event node {ev.node} outside [0, {self.n})")
+        if ev.kind == EV_GOSSIP and not 0 <= ev.arg < self.g_slots:
+            raise ValueError(
+                f"gossip slot {ev.arg} outside [0, {self.g_slots})"
+            )
+        if ev.kind not in (EV_KILL, EV_RESTART, EV_GOSSIP):
+            raise ValueError(f"unknown event kind {ev.kind}")
+        if stamp and ev.t_ingest is None:
+            ev.t_ingest = time.monotonic()
+        self._pending.append(ev)
+        self.pushed_total += 1
+
+    def next_batch(self, base_tick: int) -> tuple[EventBatch, dict]:
+        """Assemble the batch for ticks ``base_tick + 1 .. base_tick + k``.
+
+        Returns host-side (numpy) tensors plus stats:
+        ``n_events`` placed, ``n_deferred`` deferral increments this call,
+        ``oldest_ingest`` — the earliest ``t_ingest`` among placed events
+        (None when the batch is empty), the SLO window start.
+        """
+        k, cap = self.n_ticks, self.capacity
+        batch = empty_batch(k, cap)
+        fill = [0] * k
+        keep: deque[ServeEvent] = deque()
+        placed = 0
+        oldest: float | None = None
+        while self._pending:
+            ev = self._pending.popleft()
+            if ev.tick is not None and ev.tick > base_tick + k:
+                keep.append(ev)  # scheduled for a future batch: not overflow
+                continue
+            target = 0 if ev.tick is None else max(ev.tick - base_tick - 1, 0)
+            row = target
+            while row < k and fill[row] >= cap:
+                row += 1
+            if row >= k:
+                # The whole launch is full from the target on: defer to the
+                # next batch, firing ASAP there (FIFO order preserved).
+                batch.deferred[min(target, k - 1)] += 1
+                ev.tick = None
+                keep.append(ev)
+                continue
+            if row != target:
+                batch.deferred[target] += 1
+            batch.node[row, fill[row]] = ev.node
+            batch.kind[row, fill[row]] = ev.kind
+            batch.arg[row, fill[row]] = ev.arg
+            fill[row] += 1
+            placed += 1
+            if ev.t_ingest is not None:
+                oldest = ev.t_ingest if oldest is None else min(oldest, ev.t_ingest)
+        self._pending = keep
+        n_deferred = int(batch.deferred.sum())
+        self.overflow_total += n_deferred
+        return batch, {
+            "n_events": placed,
+            "n_deferred": n_deferred,
+            "oldest_ingest": oldest,
+        }
+
+
+class TcpEventSource:
+    """Live ingestion: pump ``serve/event`` messages off a bound transport's
+    inbound stream into a batcher.
+
+    The stream terminates when the transport stops — with the listener's
+    graceful drain (transport/tcp.py::stop), frames a client wrote before
+    the shutdown are still dispatched, so :meth:`pump` returns only after
+    the in-flight traffic reached the batcher.
+    """
+
+    def __init__(self, transport):
+        self._transport = transport
+        self.rejected = 0  # malformed payloads (logged, never fatal)
+
+    async def pump(self, batcher: EventBatcher) -> None:
+        stream = self._transport.listen()
+        try:
+            async for msg in stream:
+                if msg.qualifier != SERVE_QUALIFIER:
+                    continue
+                try:
+                    batcher.push(event_from_message(msg))
+                except (ValueError, TypeError):
+                    self.rejected += 1
+                    logger.warning("rejected malformed serve event: %s", msg)
+        except asyncio.CancelledError:
+            pass
+        finally:
+            stream.close()

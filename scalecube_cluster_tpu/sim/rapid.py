@@ -83,8 +83,7 @@ from scalecube_cluster_tpu.sim.faults import FaultPlan, _edge_lookup, link_pass
 from scalecube_cluster_tpu.sim.knobs import _SUSP_MAX, Knobs
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
-    events_at,
-    plan_at,
+    resolve_tick,
     plan_dirty_at,
 )
 from scalecube_cluster_tpu.sim.tick import _acct_add, _acct_zero, _link_acct
@@ -503,6 +502,9 @@ def rapid_tick(
         "fault_lost": acct[3],
         # Bucketed-exchange counter (explicit-SPMD SWIM engine): no analog.
         "exchange_overflow": zero,
+        # Serving-bridge counters (serve/): no ingest path offline.
+        "ingest_overflow": zero,
+        "serve_batches": zero,
         # Monotonicity gauges (inc_max has no Rapid analog: constant 0).
         "inc_max": zero,
         "epoch_max": jnp.max(state.epoch),
@@ -530,9 +532,8 @@ def scan_rapid_ticks(
     def step(carry: RapidState, _):
         if scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
             t = carry.tick + 1  # the global tick about to execute
-            kill_m, restart_m = events_at(plan, t, params.n)
+            plan_t, (kill_m, restart_m) = resolve_tick(plan, t, params.n)
             carry = apply_events_rapid(params, carry, kill_m, restart_m)
-            plan_t = plan_at(plan, t)
         else:
             plan_t = plan
         new_state, metrics = rapid_tick(

@@ -24,8 +24,7 @@ from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
     apply_events_dense,
-    events_at,
-    plan_at,
+    resolve_tick,
     plan_dirty_at,
 )
 from scalecube_cluster_tpu.sim.state import SimState
@@ -48,9 +47,8 @@ def scan_ticks(
     def step(carry: SimState, _):
         if scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
             t = carry.tick + 1  # the global tick about to execute
-            kill_m, restart_m = events_at(plan, t, params.n)
+            plan_t, (kill_m, restart_m) = resolve_tick(plan, t, params.n)
             carry = apply_events_dense(carry, kill_m, restart_m)
-            plan_t = plan_at(plan, t)
         else:
             plan_t = plan
         new_state, metrics = sim_tick(

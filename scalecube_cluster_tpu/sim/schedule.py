@@ -159,6 +159,23 @@ def events_at(
     return kill, restart
 
 
+def resolve_tick(
+    schedule: FaultSchedule, t: jax.Array, n: int
+) -> tuple[FaultPlan, tuple[jax.Array, jax.Array]]:
+    """``(plan_t, (kill_mask, restart_mask))`` — everything the tick core
+    consumes at global tick ``t``, resolved in one place.
+
+    This is the event-ingestion half of the engines' scheduled step, split
+    from the tick core so a :class:`FaultSchedule` is just one *producer* of
+    per-tick event tensors among several: the serving bridge
+    (serve/events.py::EventBatch) feeds the same ``(kill, restart[, gossip])``
+    mask contract into the same tick core from live or trace-replayed
+    traffic. Any producer whose masks match ``events_at``'s values yields a
+    bit-identical trajectory — mask application consumes no RNG.
+    """
+    return plan_at(schedule, t), events_at(schedule, t, n)
+
+
 def apply_events_dense(
     state: SimState, kill_mask: jax.Array, restart_mask: jax.Array
 ) -> SimState:

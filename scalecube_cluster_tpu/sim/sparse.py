@@ -109,9 +109,8 @@ from scalecube_cluster_tpu.sim.knobs import Knobs, edge_live, suspicion_fill
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
-    events_at,
-    plan_at,
     plan_dirty_at,
+    resolve_tick,
 )
 from scalecube_cluster_tpu.sim.state import AGE_STALE
 from scalecube_cluster_tpu.sim.tick import _acct_add, _acct_zero, _link_acct
@@ -584,7 +583,10 @@ def restart_many_sparse(state: SparseState, idxs) -> SparseState:
 
 
 def apply_events_sparse(
-    state: SparseState, kill_mask: jax.Array, restart_mask: jax.Array
+    state: SparseState,
+    kill_mask: jax.Array,
+    restart_mask: jax.Array,
+    gossip_mask: jax.Array | None = None,
 ) -> SparseState:
     """In-scan scheduled kill/restart for the sparse engine (sim/schedule.py).
 
@@ -604,9 +606,19 @@ def apply_events_sparse(
 
     The epoch bump clamps at EPOCH_MAX instead of raising (no host control
     flow in-scan); ScheduleBuilder enforces the restart budget statically.
+
+    ``gossip_mask`` ([N, G] bool, optional — the serving bridge's user-gossip
+    events, serve/events.py) is the in-scan twin of
+    :func:`inject_gossip_sparse`: every True (node, slot) enqueues that
+    payload young at that node, exactly as the host op between tick calls
+    would (pure metadata arrays — no write-back invalidation needed, no
+    RNG). Passing ``None`` keeps the scheduled-events graph byte-identical
+    to before the serve bridge existed.
     """
     n = state.alive.shape[0]
     any_ev = jnp.any(kill_mask | restart_mask)
+    if gossip_mask is not None:
+        any_ev = any_ev | jnp.any(gossip_mask)
 
     def apply(state: SparseState) -> SparseState:
         new_epoch = jnp.where(
@@ -635,6 +647,13 @@ def apply_events_sparse(
             uptr=jnp.where(restart_mask[:, None], 0, state.uptr),
             uinf_ids=uinf_ids,
         )
+        if gossip_mask is not None:
+            # After the restart wipe, matching the host-side op order
+            # (kill/restart, then spreadGossip) between tick calls.
+            st = st.replace(
+                useen=st.useen | gossip_mask,
+                uage=jnp.where(gossip_mask, 0, st.uage),
+            )
         if st.lat_first_suspect is not None:
             st = st.replace(
                 lat_first_suspect=jnp.where(
@@ -964,12 +983,16 @@ def sparse_tick(
     """One gossip period on the working set. Returns ``(state, metrics)``.
 
     ``events`` is ``None`` (no scheduled events — the default graph, traced
-    structure unchanged) or a ``(kill_mask, restart_mask)`` pair of [N]
-    bools from sim/schedule.py::events_at, applied before the tick body
-    (:func:`apply_events_sparse`); a restarted node additionally requests
-    its own slot through the step-3 activation path and announces its
-    bumped-epoch identity there. Events consume no RNG, so an event-free
-    scheduled tick is bit-identical to the fixed-plan tick.
+    structure unchanged), a ``(kill_mask, restart_mask)`` pair of [N]
+    bools from sim/schedule.py::events_at, or a
+    ``(kill_mask, restart_mask, gossip_mask)`` triple (the serving bridge's
+    [N, G] user-gossip injections, serve/events.py) — applied before the
+    tick body (:func:`apply_events_sparse`); a restarted node additionally
+    requests its own slot through the step-3 activation path and announces
+    its bumped-epoch identity there. The tuple arity is pytree structure,
+    so each form keeps its own cached executable and the 2-tuple graph is
+    unchanged by the 3-tuple's existence. Events consume no RNG, so an
+    event-free scheduled tick is bit-identical to the fixed-plan tick.
 
     ``knobs`` (sim/knobs.py) threads per-run protocol scalars as traced
     data — identity knobs are bit-identical to ``knobs=None``; the ensemble
@@ -985,7 +1008,8 @@ def sparse_tick(
             "suspicion timeout as a kernel constant (set pallas_core=False)"
         )
     if events is not None:
-        state = apply_events_sparse(state, events[0], events[1])
+        gossip_m = events[2] if len(events) > 2 else None
+        state = apply_events_sparse(state, events[0], events[1], gossip_m)
         restart_m = events[1]
     t = state.tick + 1
     (rng_next, k_tgt, k_ping, k_relay, k_gsel, k_glink, k_ssel, k_slink) = (
@@ -1659,6 +1683,11 @@ def sparse_tick(
         # the single-program tick has no fixed-capacity buckets, so the
         # schema slot is constant zero here.
         "exchange_overflow": jnp.zeros((), jnp.int32),
+        # Serving-bridge counters (serve/): the offline tick has no ingest
+        # path, so the schema slots are constant zero here; the serve
+        # runner overrides ingest_overflow with the batch's deferral count.
+        "ingest_overflow": jnp.zeros((), jnp.int32),
+        "serve_batches": jnp.zeros((), jnp.int32),
     }
     return new_state, metrics
 
@@ -1680,8 +1709,10 @@ def scan_sparse_ticks(
         if not scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
             return sparse_tick(params, carry, plan, collect=collect, knobs=knobs)
         t = carry.tick + 1  # the global tick about to execute
-        kill_m, restart_m = events_at(plan, t, params.base.n)
-        plan_t = plan_at(plan, t)
+        # Event ingestion, split from the tick core (sim/schedule.py): the
+        # schedule is one producer of per-tick event masks; the serving
+        # bridge (serve/engine.py) feeds the same contract from live traffic.
+        plan_t, (kill_m, restart_m) = resolve_tick(plan, t, params.base.n)
         new_state, metrics = sparse_tick(
             params,
             carry,

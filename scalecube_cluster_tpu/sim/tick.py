@@ -841,5 +841,8 @@ def sim_tick(
         # Bucketed-exchange counter (explicit-SPMD engine, parallel/spmd.py):
         # no fixed-capacity buckets in the dense tick, constant zero.
         "exchange_overflow": jnp.zeros((), jnp.int32),
+        # Serving-bridge counters (serve/): no ingest path offline.
+        "ingest_overflow": jnp.zeros((), jnp.int32),
+        "serve_batches": jnp.zeros((), jnp.int32),
     }
     return new_state, metrics

@@ -613,3 +613,99 @@ async def compare_protocol_counters(n: int = 8, fd_rounds: int = 6) -> dict:
         "host_ack_rate": rate(host, "acks"),
         "sim_ack_rate": rate(sim, "acks"),
     }
+
+
+async def serve_protocol_counters(
+    n: int, fd_rounds: int, seed: int = 0, gossip_events: int = 3
+) -> dict:
+    """Serving-bridge twin of :func:`sim_protocol_counters`: the same healthy
+    steady-state window, but stepped through a LIVE loopback-TCP
+    :class:`~scalecube_cluster_tpu.serve.ServeBridge` session — a client
+    transport dials the bridge's listener and sends ``gossip_events`` user
+    gossip ``serve/event`` frames, which the pump ingests and the engine
+    applies in-window. User gossip rides the dissemination plane only, so
+    the crossval quantities (SHARED_COUNTERS key set, per-FD-period ping/ack
+    rates) stay those of the healthy window; ``gossip_fired`` proves the
+    live traffic actually reached the device."""
+    from scalecube_cluster_tpu.cluster_api.config import TransportConfig
+    from scalecube_cluster_tpu.serve import SERVE_QUALIFIER, ServeBridge
+    from scalecube_cluster_tpu.sim import SimParams
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+    )
+    from scalecube_cluster_tpu.transport.tcp import TcpTransport
+
+    base = SimParams.from_cluster_config(n, fast_test_config())
+    params = SparseParams(
+        base=base, slot_budget=max(64, 2 * n), in_scan_writeback=False
+    )
+    state = init_sparse_full_view(n, params.slot_budget, seed=seed)
+    ticks = fd_rounds * base.fd_period_ticks
+    bridge = ServeBridge(params, state, batch_ticks=ticks, capacity=2)
+    g_slots = bridge.batcher.g_slots
+    server = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    client = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    try:
+        # Start the live session FIRST: its pump must be subscribed to the
+        # listener's multicast stream before the client writes (frames
+        # dispatched with no subscriber are dropped by design).
+        live = asyncio.ensure_future(
+            bridge.run_live(server, n_batches=1, settle_s=0.3)
+        )
+        await asyncio.sleep(0.05)
+        for i in range(gossip_events):
+            await client.send(
+                server.address,
+                Message.create(
+                    qualifier=SERVE_QUALIFIER,
+                    data={
+                        "kind": "gossip",
+                        "node": i % n,
+                        "slot": i % g_slots,
+                        "tick": 1 + i,
+                    },
+                    sender=client.address,
+                ),
+            )
+        traces = await live
+    finally:
+        await client.stop()
+        await server.stop()
+    totals = bridge.counters()
+    summary = bridge.close()
+    return {
+        "counters": totals,
+        "fd_periods": n * fd_rounds,
+        "gossip_fired": int(np.sum(np.asarray(traces[0]["gossip_fired"]))),
+        "events_pushed": bridge.batcher.pushed_total,
+        "summary": summary,
+    }
+
+
+async def compare_serve_protocol_counters(n: int = 8, fd_rounds: int = 6) -> dict:
+    """Host-vs-serve twin of :func:`compare_protocol_counters`: the healthy
+    steady-state window on the asyncio host backend vs a live loopback-TCP
+    serving-bridge session, compared on the same assertion surface (schema
+    key sets, per-FD-period ping/ack rates — user gossip traffic does not
+    touch the FD cadence)."""
+    from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS
+
+    host = await host_protocol_counters(n, fd_rounds)
+    serve = await serve_protocol_counters(n, fd_rounds)
+
+    def rate(block, key):
+        periods = max(block["fd_periods"], 1)
+        return block["counters"].get(key, 0) / periods
+
+    return {
+        "host": host,
+        "serve": serve,
+        "schema_keys": tuple(SHARED_COUNTERS),
+        "host_keys_ok": set(host["counters"]) == set(SHARED_COUNTERS),
+        "serve_keys_ok": set(serve["counters"]) == set(SHARED_COUNTERS),
+        "host_ping_rate": rate(host, "pings"),
+        "serve_ping_rate": rate(serve, "pings"),
+        "host_ack_rate": rate(host, "acks"),
+        "serve_ack_rate": rate(serve, "acks"),
+    }

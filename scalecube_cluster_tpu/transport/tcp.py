@@ -83,6 +83,7 @@ class TcpTransport(_ListenMixin, Transport):
         self._dial_failures: dict[Address, int] = {}
         self._jitter_rng = random.Random()  # tpulint: disable=R3 -- backoff jitter exists to DECORRELATE redialing senders; tests pin the envelope, not values
         self._accepted: set[asyncio.Task] = set()
+        self._accepted_writers: set[asyncio.StreamWriter] = set()
         self._stopped = False
 
     # -- lifecycle -----------------------------------------------------------
@@ -111,7 +112,19 @@ class TcpTransport(_ListenMixin, Transport):
 
     async def stop(self) -> None:
         """Close the server and all connections; completes listen() streams
-        (TransportImpl.java:196-215)."""
+        (TransportImpl.java:196-215).
+
+        Accepted-connection handlers are DRAINED, not cancelled: frames a
+        peer already delivered (in the StreamReader buffers or the kernel
+        socket buffer after the flush iterations below) are still decoded
+        and dispatched before their stream completes — the serving bridge's
+        live ingestion (serve/ingest.py::TcpEventSource) counts on shutdown
+        never dropping traffic that made it onto the wire. Handlers that
+        outlive ``TransportConfig.stop_drain_ms`` (a peer holding its
+        connection open and idle) are cancelled as before, which also keeps
+        Python 3.12's Server.wait_closed() — it blocks until every handler
+        completes — from deadlocking stop().
+        """
         if self._stopped:
             return
         self._stopped = True
@@ -123,13 +136,24 @@ class TcpTransport(_ListenMixin, Transport):
             else:
                 fut.cancel()
         self._connections.clear()
-        # Cancel accepted-connection handlers BEFORE wait_closed(): since
-        # Python 3.12 Server.wait_closed() blocks until every handler
-        # completes, so the order matters or stop() deadlocks while a peer
-        # holds its outbound connection open.
-        for task in list(self._accepted):
-            task.cancel()
-        await asyncio.sleep(0)  # let cancelled handlers unwind
+        if self._accepted:
+            # Two loop iterations: each polls the selector, so socket data
+            # already in the kernel buffer lands in the StreamReader buffers
+            # (and peer-close EOFs propagate) before we close anything.
+            await asyncio.sleep(0)
+            await asyncio.sleep(0)
+            # EOF the accepted connections: buffered frames stay readable,
+            # so each handler's read loop drains them and exits cleanly.
+            for writer in list(self._accepted_writers):
+                with contextlib.suppress(Exception):
+                    writer.close()
+            grace = max(self._config.stop_drain_ms, 0) / 1000.0
+            pending = list(self._accepted)
+            if grace > 0 and pending:
+                _, pending = await asyncio.wait(pending, timeout=grace)
+            for task in pending:
+                task.cancel()
+            await asyncio.sleep(0)  # let cancelled stragglers unwind
         if self._server is not None:
             with contextlib.suppress(Exception):
                 await self._server.wait_closed()
@@ -248,10 +272,12 @@ class TcpTransport(_ListenMixin, Transport):
         task = asyncio.current_task()
         assert task is not None
         self._accepted.add(task)
+        self._accepted_writers.add(writer)
         try:
             await self._read_loop(reader)
         finally:
             self._accepted.discard(task)
+            self._accepted_writers.discard(writer)
             with contextlib.suppress(Exception):
                 writer.close()
 

@@ -166,6 +166,56 @@ async def test_listen_completes_on_stop():
 
 
 @pytest.mark.asyncio
+async def test_stop_drains_inflight_frames():
+    """stop() must DRAIN accepted connections, not cancel them mid-frame:
+    frames a client already put on the wire are decoded and dispatched
+    before the listen() streams complete (the serving bridge's shutdown
+    contract, serve/ingest.py::TcpEventSource). Written with a raw socket
+    and no yield between the writes and stop() so only the drain path —
+    never scheduler luck — can deliver the frames."""
+    b = await bind()
+    stream = b.listen()
+
+    async def drain():
+        return [m.data async for m in stream]
+
+    task = asyncio.create_task(drain())
+    reader, writer = await asyncio.open_connection(b.address.host, b.address.port)
+    try:
+        await asyncio.sleep(0.05)  # server-side handler is accepted + reading
+        n = 5
+        for i in range(n):
+            payload = b._codec.serialize(Message.create(qualifier="serve/event", data=i))
+            writer.write(b._encode(payload, b._config.max_frame_length))
+        # No await between the writes and stop(): the frames are in flight.
+        await b.stop()
+        got = await asyncio.wait_for(task, timeout=2)
+        assert got == list(range(n))
+    finally:
+        writer.close()
+
+
+@pytest.mark.asyncio
+async def test_stop_bounded_with_idle_peer_connection():
+    """A peer holding its connection open and idle must not stall stop()
+    past the drain grace (and must never deadlock Python 3.12's
+    wait_closed): the accepted socket is EOF'd and its handler exits."""
+    b = await TcpTransport.bind(
+        TransportConfig(connect_timeout=1000, stop_drain_ms=200)
+    )
+    a = await bind()
+    try:
+        # Open (and keep open) a connection into b's listener.
+        await a.send(
+            b.address, Message.create(qualifier="x", data=0, sender=a.address)
+        )
+        await asyncio.sleep(0.05)
+        await asyncio.wait_for(b.stop(), timeout=2)
+    finally:
+        await a.stop()
+
+
+@pytest.mark.asyncio
 async def test_subscriber_isolation():
     """TransportTest.java:268-313 — a failing subscriber doesn't affect others."""
     a, b = await bind(), await bind()

@@ -340,6 +340,29 @@ def _build_run_ensemble_rapid_ticks():
     )
 
 
+def _build_run_serve_batch():
+    # The serving bridge's per-launch executable (serve/engine.py): the
+    # sparse tick scanned over a fixed-shape EventBatch. The probe batch is
+    # the empty all-(-1) tensor — event cells are data, not structure, so the
+    # traced program is the one every live/replayed launch reuses.
+    from scalecube_cluster_tpu.serve.engine import run_serve_batch
+    from scalecube_cluster_tpu.serve.events import empty_batch
+
+    params, state, plan = _sparse_inputs(pallas_core=False)
+    return (
+        run_serve_batch,
+        (params, state, plan, empty_batch(T, 2)),
+        {"collect": True},
+        {
+            "donate_argnums": (1,),
+            "state_argnum": 1,
+            "state_out": _state_first,
+            "static_argnums": (0,),
+            "static_argnames": ("collect",),
+        },
+    )
+
+
 ENTRY_SPECS: tuple[EntrySpec, ...] = (
     EntrySpec("sim.run.run_ticks[plan]", lambda: _build_run_ticks(False)),
     EntrySpec("sim.run.run_ticks[schedule]", lambda: _build_run_ticks(True)),
@@ -384,6 +407,7 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
     EntrySpec("sim.ensemble.ensemble_writeback_free", _build_ensemble_writeback_free),
     EntrySpec("sim.rapid.run_rapid_ticks", _build_run_rapid_ticks),
     EntrySpec("sim.rapid.run_ensemble_rapid_ticks", _build_run_ensemble_rapid_ticks),
+    EntrySpec("serve.engine.run_serve_batch", _build_run_serve_batch),
 )
 
 
