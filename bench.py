@@ -541,15 +541,19 @@ def _probe_once() -> str | None:
         return f"probe timed out after {PROBE_DEADLINE_S}s"
 
 
-def _record_probe_attempt(attempt: int, err: str | None, elapsed_s: float) -> None:
+def _record_probe_attempt(
+    attempt: int, err: str | None, elapsed_s: float, extra: dict | None = None
+) -> None:
     """Append one probe-attempt outcome to artifacts/bench_history.jsonl.
 
     Outage rounds used to burn their probe budget invisibly (BENCH_r05: 8
     attempts × 120 s before the 0.0 row); now every attempt leaves a
     schema row, so the history shows WHEN the tunnel was down and how much
-    budget each round spent discovering it. Best-effort: a read-only or
-    missing artifacts/ dir must never break the bench's one-JSON-line
-    contract.
+    budget each round spent discovering it. ``extra`` merges scenario
+    context into the attempt row — the serve rung stamps its ingest→verdict
+    SLO percentiles here so the probe history carries the serving-latency
+    trend, not just up/down. Best-effort: a read-only or missing artifacts/
+    dir must never break the bench's one-JSON-line contract.
     """
     try:
         from scalecube_cluster_tpu.obs.export import append_jsonl, make_row, run_metadata
@@ -565,6 +569,7 @@ def _record_probe_attempt(attempt: int, err: str | None, elapsed_s: float) -> No
                 "detail": (err or "")[-300:],
                 "elapsed_s": round(elapsed_s, 1),
                 "budget_s": PROBE_DEADLINE_S,
+                **(extra or {}),
             },
             run_metadata(),
         )
@@ -816,6 +821,29 @@ if __name__ == "__main__":
             )
         else:
             row = _measure_serve(n_arg)
+            # Stamp the session's ingest→verdict SLO percentiles onto a
+            # probe-attempt row too: the probe history is the long-lived
+            # per-round record, so serving-latency regressions show up in
+            # the same timeline as outages.
+            _record_probe_attempt(
+                2,
+                None,
+                time.monotonic() - t_probe,
+                extra={
+                    "scenario": "serve",
+                    "n_members": n_arg,
+                    **{
+                        k: row[k]
+                        for k in (
+                            "latency_ms_p50",
+                            "latency_ms_p95",
+                            "latency_ms_p99",
+                            "latency_ms_mean",
+                        )
+                        if k in row
+                    },
+                },
+            )
         try:
             append_jsonl(
                 os.path.join(

@@ -30,43 +30,78 @@ from scalecube_cluster_tpu.obs.export import (
 )
 from scalecube_cluster_tpu.obs.latency import detection_latencies, latency_histogram
 from scalecube_cluster_tpu.obs.profiling import trace_scope
-
-#: obs/ensemble.py names re-exported LAZILY (PEP 562): that module imports
-#: jax, and this package must stay importable without it — the bench driver
-#: process imports obs.export and relies on run_metadata's platform
-#: detection staying passive (no jax import on its account).
-_ENSEMBLE_EXPORTS = (
-    "ensemble_report",
-    "first_tick_where",
-    "masked_quantiles",
-    "population_stats",
+from scalecube_cluster_tpu.obs.trace import (
+    DEAD_VIA_EXPIRY,
+    DEAD_VIA_GOSSIP,
+    TK_NAMES,
+    chrome_trace,
+    load_events_jsonl,
+    record_message_span,
+    ring_events,
+    ring_overflow,
+    start_message_spans,
+    stop_message_spans,
+    write_chrome_trace,
+    write_events_jsonl,
 )
+
+#: obs/ensemble.py and obs/tracer.py names re-exported LAZILY (PEP 562):
+#: those modules import jax, and this package must stay importable without
+#: it — the bench driver process imports obs.export and relies on
+#: run_metadata's platform detection staying passive (no jax import on its
+#: account). obs/trace.py (the host-side assembler) is jax-free by design
+#: and re-exported eagerly above.
+_LAZY_EXPORTS = {
+    "ensemble_report": "ensemble",
+    "first_tick_where": "ensemble",
+    "masked_quantiles": "ensemble",
+    "population_stats": "ensemble",
+    "TraceRing": "tracer",
+    "init_trace_ring": "tracer",
+}
 
 
 def __getattr__(name):
-    if name in _ENSEMBLE_EXPORTS:
-        from scalecube_cluster_tpu.obs import ensemble as _ensemble
+    modname = _LAZY_EXPORTS.get(name)
+    if modname is not None:
+        import importlib
 
-        return getattr(_ensemble, name)
+        mod = importlib.import_module(f"scalecube_cluster_tpu.obs.{modname}")
+        return getattr(mod, name)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 __all__ = [
+    "DEAD_VIA_EXPIRY",
+    "DEAD_VIA_GOSSIP",
     "SCHEMA_VERSION",
     "SHARED_COUNTERS",
+    "TK_NAMES",
     "ProtocolCounters",
+    "TraceRing",
     "append_jsonl",
+    "chrome_trace",
     "detection_latencies",
     "ensemble_report",
     "first_tick_where",
+    "init_trace_ring",
     "jsonl_line",
     "latency_histogram",
+    "load_events_jsonl",
     "make_row",
     "masked_quantiles",
     "population_stats",
     "prometheus_text",
+    "record_message_span",
+    "ring_events",
+    "ring_overflow",
     # (ensemble_report / first_tick_where / masked_quantiles /
-    # population_stats resolve lazily — see __getattr__ below.)
+    # population_stats / TraceRing / init_trace_ring resolve lazily —
+    # see __getattr__ above.)
     "run_metadata",
+    "start_message_spans",
+    "stop_message_spans",
     "trace_scope",
+    "write_chrome_trace",
+    "write_events_jsonl",
     "write_prometheus",
 ]

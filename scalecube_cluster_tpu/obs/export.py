@@ -106,8 +106,15 @@ def run_metadata(
             ).stdout.strip() or "unknown"
         except Exception:
             commit = "unknown"
+    # Toolchain provenance, under the same passive rule as ``platform``:
+    # read only from modules ALREADY imported — never import jax (or touch
+    # a backend) on this function's account.
+    jax_mod = sys.modules.get("jax")
+    jaxlib_mod = sys.modules.get("jaxlib")
+    jax_version = getattr(jax_mod, "__version__", "unknown")
+    jaxlib_version = getattr(jaxlib_mod, "__version__", "unknown")
+    device_kind = "unknown"
     if platform is None:
-        jax_mod = sys.modules.get("jax")
         if jax_mod is not None:
             try:
                 platform = jax_mod.default_backend()
@@ -115,9 +122,21 @@ def run_metadata(
                 platform = "unknown"
         else:
             platform = "unknown"
+    if jax_mod is not None:
+        # devices() would CREATE a backend on first call — only read it when
+        # one already exists (xla_bridge's client cache is non-empty), so an
+        # explicit-platform caller that never ran an op stays backend-free.
+        try:
+            if jax_mod._src.xla_bridge._backends:
+                device_kind = jax_mod.devices()[0].device_kind
+        except Exception:
+            device_kind = "unknown"
     meta: dict = {
         "commit": commit,
         "platform": platform,
+        "jax_version": jax_version,
+        "jaxlib_version": jaxlib_version,
+        "device_kind": device_kind,
         **_census_stamp(),
         **_collective_stamp(),
     }

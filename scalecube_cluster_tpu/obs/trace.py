@@ -1,0 +1,235 @@
+"""Host-side span assembler for the causal flight recorder.
+
+The device half (obs/tracer.py) fills a fixed-shape event ring *inside* the
+scan; this module is its jax-free twin: it decodes rings into plain event
+dicts, merges them with serve/bridge launch spans and host-transport message
+spans (correlation-id keyed), and renders everything as Chrome-trace-event
+JSON loadable in Perfetto — alongside the existing JSONL exporter
+(obs/export.py), which stays the artifact wire format.
+
+Everything here runs without jax: ring arrays decode through
+``np.asarray`` (works on device arrays via ``__array__``), so the bench
+driver process and the transport layer can import this module freely —
+the same no-jax-import rule obs/export.py lives under.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+
+from scalecube_cluster_tpu.obs.export import jsonl_line
+
+# Event kinds — the device ring's ``ev_kind`` vocabulary. Values are wire
+# format (trace JSONL + cause_ref chains), so additions only at the end.
+TK_KILL = 1  # scheduled/host kill         actor=-1        subject=member
+TK_RESTART = 2  # scheduled/host restart   actor=-1        subject=member
+TK_PROBE_SENT = 3  # FD probe dispatched   actor=prober    subject=target
+TK_PROBE_MISSED = 4  # probe round failed  actor=prober    subject=target
+TK_SUSPECT_START = 5  # prober fires SUSPECT verdict       cause=missed probe
+TK_SYNC_ACCEPT = 6  # own-record SYNC accepted             subject=partner
+TK_GOSSIP_EDGE = 7  # user-gossip infection edge           subject=G slot
+TK_VERDICT_DEAD = 8  # viewer's record became DEAD         cause=origin event
+TK_VERDICT_ALIVE = 9  # viewer's record became ALIVE (refutation arrival)
+TK_ALARM = 10  # Rapid watermark edge alarm actor=observer subject=subject
+TK_VOTE = 11  # Rapid vote locked           actor=member
+TK_VIEW_COMMIT = 12  # Rapid view commit     actor=member   subject=vote src
+
+TK_NAMES = {
+    TK_KILL: "kill",
+    TK_RESTART: "restart",
+    TK_PROBE_SENT: "probe_sent",
+    TK_PROBE_MISSED: "probe_missed",
+    TK_SUSPECT_START: "suspect_start",
+    TK_SYNC_ACCEPT: "sync_accept",
+    TK_GOSSIP_EDGE: "gossip_edge",
+    TK_VERDICT_DEAD: "verdict_dead",
+    TK_VERDICT_ALIVE: "verdict_alive",
+    TK_ALARM: "alarm",
+    TK_VOTE: "vote",
+    TK_VIEW_COMMIT: "view_commit",
+}
+
+#: ``aux`` vocabulary of TK_VERDICT_DEAD: where the viewer's DEAD record
+#: came from (1 = its own suspicion countdown expired, 2 = learned through
+#: gossip/SYNC delivery).
+DEAD_VIA_EXPIRY = 1
+DEAD_VIA_GOSSIP = 2
+
+
+def ring_events(ring) -> list[dict]:
+    """Decode a :class:`~scalecube_cluster_tpu.obs.tracer.TraceRing` into
+    plain event dicts, in emission order (``i`` == ring position == the
+    value ``cause`` references)."""
+    cursor = int(np.asarray(ring.cursor))
+    fields = {
+        name: np.asarray(getattr(ring, name))[:cursor]
+        for name in ("ev_kind", "ev_tick", "ev_actor", "ev_subject",
+                     "ev_cause", "ev_aux")
+    }
+    out = []
+    for i in range(cursor):
+        kind = int(fields["ev_kind"][i])
+        out.append(
+            {
+                "i": i,
+                "tick": int(fields["ev_tick"][i]),
+                "kind": kind,
+                "kind_name": TK_NAMES.get(kind, f"kind_{kind}"),
+                "actor": int(fields["ev_actor"][i]),
+                "subject": int(fields["ev_subject"][i]),
+                "cause": int(fields["ev_cause"][i]),
+                "aux": int(fields["ev_aux"][i]),
+            }
+        )
+    return out
+
+
+def ring_overflow(ring) -> int:
+    """Events the bounded ring could not record (lossless accounting:
+    emitted == recorded + overflow, the SHARED_COUNTERS discipline)."""
+    return int(np.asarray(ring.overflow))
+
+
+def write_events_jsonl(path: str, events: list[dict]) -> None:
+    """Deterministic JSONL export of decoded events (the trace-explain CLI's
+    input format; same sorted-key serialization as obs/export.py)."""
+    with open(path, "w") as fh:
+        for ev in events:
+            fh.write(jsonl_line(ev) + "\n")
+
+
+def load_events_jsonl(path: str) -> list[dict]:
+    with open(path) as fh:
+        events = [json.loads(line) for line in fh if line.strip()]
+    events.sort(key=lambda e: e["i"])
+    return events
+
+
+# -------------------------------------------------------------- message spans
+# Host-transport request/response spans, keyed by the existing correlation
+# ids (transport/api.py::request_response). Recording is opt-in: the hook in
+# the transport is a no-op until :func:`start_message_spans` arms it, so the
+# serving path pays nothing by default.
+_MESSAGE_SPANS: list[dict] | None = None
+
+
+def start_message_spans() -> None:
+    """Arm the transport message-span recorder (idempotent)."""
+    global _MESSAGE_SPANS
+    if _MESSAGE_SPANS is None:
+        _MESSAGE_SPANS = []
+
+
+def stop_message_spans() -> list[dict]:
+    """Disarm the recorder and return everything captured."""
+    global _MESSAGE_SPANS
+    spans, _MESSAGE_SPANS = _MESSAGE_SPANS or [], None
+    return spans
+
+
+def record_message_span(
+    cid: str, qualifier: str, t0: float, t1: float, ok: bool = True
+) -> None:
+    """Called by the transport around each correlation-id-matched exchange.
+    No-op unless armed."""
+    if _MESSAGE_SPANS is not None:
+        _MESSAGE_SPANS.append(
+            {
+                "correlation_id": cid,
+                "qualifier": qualifier,
+                "t0": float(t0),
+                "t1": float(t1),
+                "ok": bool(ok),
+            }
+        )
+
+
+# -------------------------------------------------------------- chrome trace
+def chrome_trace(
+    events: list[dict] | None = None,
+    launch_spans: list[dict] | None = None,
+    message_spans: list[dict] | None = None,
+    tick_us: float = 1000.0,
+) -> dict:
+    """Merge device events + serve launch spans + transport message spans
+    into one Chrome-trace-event JSON object (Perfetto / chrome://tracing).
+
+    Three synthetic processes: pid 0 = the device tick timeline (instant
+    events at ``tick * tick_us``, one thread row per actor), pid 1 = serve
+    launch spans, pid 2 = transport request/response spans. Host spans are
+    re-based so the earliest one starts at ts 0 (monotonic-clock origins are
+    arbitrary); the device timeline is tick-indexed, not wall-clock — the
+    pids keep the two clock domains on separate tracks.
+    """
+    out: list[dict] = [
+        {"ph": "M", "pid": 0, "name": "process_name",
+         "args": {"name": "device sim (ticks)"}},
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "serve launches"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "host transport"}},
+    ]
+    for ev in events or []:
+        out.append(
+            {
+                "name": ev.get("kind_name", TK_NAMES.get(ev["kind"], "event")),
+                "ph": "i",
+                "s": "t",
+                "ts": ev["tick"] * tick_us,
+                "pid": 0,
+                "tid": max(ev["actor"], 0),
+                "args": {k: ev[k] for k in
+                         ("i", "tick", "actor", "subject", "cause", "aux")},
+            }
+        )
+    host_t0 = [s["t0"] for s in (launch_spans or [])] + [
+        s["t0"] for s in (message_spans or [])
+    ]
+    origin = min(host_t0) if host_t0 else 0.0
+    for i, sp in enumerate(launch_spans or []):
+        out.append(
+            {
+                "name": "serve_launch",
+                "ph": "X",
+                "ts": (sp["t0"] - origin) * 1e6,
+                "dur": max(sp["t1"] - sp["t0"], 0.0) * 1e6,
+                "pid": 1,
+                "tid": 0,
+                "args": {
+                    k: sp[k]
+                    for k in ("batch", "base_tick", "batch_ticks", "n_events")
+                    if k in sp
+                },
+            }
+        )
+    for sp in message_spans or []:
+        out.append(
+            {
+                "name": sp.get("qualifier", "message"),
+                "ph": "X",
+                "ts": (sp["t0"] - origin) * 1e6,
+                "dur": max(sp["t1"] - sp["t0"], 0.0) * 1e6,
+                "pid": 2,
+                "tid": 0,
+                "args": {
+                    "correlation_id": sp.get("correlation_id"),
+                    "ok": sp.get("ok", True),
+                },
+            }
+        )
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def write_chrome_trace(
+    path: str,
+    events: list[dict] | None = None,
+    launch_spans: list[dict] | None = None,
+    message_spans: list[dict] | None = None,
+    tick_us: float = 1000.0,
+) -> None:
+    with open(path, "w") as fh:
+        json.dump(
+            chrome_trace(events, launch_spans, message_spans, tick_us), fh
+        )

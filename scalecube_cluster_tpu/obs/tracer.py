@@ -1,0 +1,168 @@
+"""Device half of the causal flight recorder: a fixed-shape event ring
+recorded *inside* the scan.
+
+:class:`TraceRing` rides the engine states as an optional field (same
+structure-gated pattern as the verdict-latency recorder, sim/sparse.py:
+``None`` is an empty pytree node, so tracer-off runs compile the identical
+hot graph and stay bit-identical to pre-recorder builds). Every emission is
+a deterministic compaction: ``flatnonzero`` orders events by flat mask
+index, positions are a saturating append cursor (NOT circular — positions
+are stable, which is what lets ``cause`` reference earlier events), and
+anything past capacity lands in ``overflow`` under the lossless
+emitted == recorded + overflow accounting discipline of SHARED_COUNTERS.
+
+Two per-subject causal registers thread the chains across ticks:
+``last_miss[j]`` (ring position of the latest PROBE_MISSED about j) and
+``origin[j]`` (latest SUSPECT_START — or direct epoch-mismatch probe —
+that began j's current verdict episode; reset on restart). A viewer's
+DEAD verdict stamps ``origin[subject]`` as its ``cause``, so the explain
+CLI (tools/trace_explain.py) can walk verdict → suspicion → missed probe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_dataclass
+
+from scalecube_cluster_tpu.obs.trace import (  # noqa: F401 (re-export)
+    TK_ALARM,
+    TK_GOSSIP_EDGE,
+    TK_KILL,
+    TK_PROBE_MISSED,
+    TK_PROBE_SENT,
+    TK_RESTART,
+    TK_SUSPECT_START,
+    TK_SYNC_ACCEPT,
+    TK_VERDICT_ALIVE,
+    TK_VERDICT_DEAD,
+    TK_VIEW_COMMIT,
+    TK_VOTE,
+)
+
+
+@register_dataclass
+@dataclass
+class TraceRing:
+    """Bounded on-device event log + causal registers (all int32)."""
+
+    ev_kind: jax.Array  # [R]
+    ev_tick: jax.Array  # [R]
+    ev_actor: jax.Array  # [R] member id, -1 = control plane
+    ev_subject: jax.Array  # [R] member id / gossip slot
+    ev_cause: jax.Array  # [R] ring position of the causing event, -1 = root
+    ev_aux: jax.Array  # [R] kind-specific annotation
+    cursor: jax.Array  # [] next free position (saturates at R)
+    overflow: jax.Array  # [] events that did not fit (lossless accounting)
+    last_miss: jax.Array  # [N] latest PROBE_MISSED position per subject
+    origin: jax.Array  # [N] verdict-origin event position per subject
+
+    def replace(self, **changes) -> "TraceRing":
+        return dataclasses.replace(self, **changes)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.ev_kind.shape[0])
+
+
+def init_trace_ring(n: int, capacity: int) -> TraceRing:
+    """Empty ring for an ``n``-member cluster. ``capacity`` bounds the whole
+    run's event count (positions never recycle); size it from the scenario —
+    the overflow counter says when it was too small."""
+    if capacity < 1:
+        raise ValueError("trace ring capacity must be >= 1")
+    full = lambda v: jnp.full((capacity,), v, jnp.int32)  # noqa: E731
+    return TraceRing(
+        ev_kind=full(0),
+        ev_tick=full(-1),
+        ev_actor=full(-1),
+        ev_subject=full(-1),
+        ev_cause=full(-1),
+        ev_aux=full(0),
+        cursor=jnp.zeros((), jnp.int32),
+        overflow=jnp.zeros((), jnp.int32),
+        last_miss=jnp.full((n,), -1, jnp.int32),
+        origin=jnp.full((n,), -1, jnp.int32),
+    )
+
+
+def trace_emit(ring: TraceRing, kind: int, mask, tick, actor, subject,
+               cause=-1, aux=0):
+    """Append one event per True element of ``mask`` (any shape).
+
+    ``actor``/``subject``/``cause``/``aux`` broadcast against ``mask``.
+    Returns ``(ring, ev_pos)`` where ``ev_pos`` (flat ``mask`` shape) maps
+    each element to its ring position, -1 where unrecorded (False, past the
+    per-call compaction cap, or past ring capacity — the latter two counted
+    into ``overflow``). Fully deterministic: compaction order is flat mask
+    index order and the cursor is data-independent of everything but the
+    masks themselves.
+    """
+    flat = mask.reshape(-1)
+    size = int(flat.shape[0])
+    R = ring.ev_kind.shape[0]
+    cap = min(size, R)
+    idx = jnp.flatnonzero(flat, size=cap, fill_value=-1)
+    valid = idx >= 0
+    safe = jnp.where(valid, idx, 0)
+    pos = ring.cursor + jnp.arange(cap, dtype=jnp.int32)
+    rec = valid & (pos < R)
+    route = jnp.where(rec, pos, R)
+
+    def gather(x):
+        b = jnp.broadcast_to(jnp.asarray(x, jnp.int32), mask.shape)
+        return b.reshape(-1)[safe]
+
+    total = jnp.sum(flat, dtype=jnp.int32)
+    recorded = jnp.sum(rec, dtype=jnp.int32)
+    ring = ring.replace(
+        ev_kind=ring.ev_kind.at[route].set(kind, mode="drop"),
+        ev_tick=ring.ev_tick.at[route].set(
+            jnp.broadcast_to(jnp.asarray(tick, jnp.int32), (cap,)), mode="drop"
+        ),
+        ev_actor=ring.ev_actor.at[route].set(gather(actor), mode="drop"),
+        ev_subject=ring.ev_subject.at[route].set(gather(subject), mode="drop"),
+        ev_cause=ring.ev_cause.at[route].set(gather(cause), mode="drop"),
+        ev_aux=ring.ev_aux.at[route].set(gather(aux), mode="drop"),
+        cursor=jnp.minimum(ring.cursor + recorded, R),
+        overflow=ring.overflow + (total - recorded),
+    )
+    ev_pos = (
+        jnp.full((size,), -1, jnp.int32)
+        .at[jnp.where(rec, idx, size)]
+        .set(pos, mode="drop")
+    )
+    return ring, ev_pos
+
+
+def trace_reset_members(ring: TraceRing, member_mask) -> TraceRing:
+    """Clear the causal registers of restarted members (fresh identity,
+    fresh causal history — mirrors the latency recorder's restart reset)."""
+    return ring.replace(
+        last_miss=jnp.where(member_mask, -1, ring.last_miss),
+        origin=jnp.where(member_mask, -1, ring.origin),
+    )
+
+
+def trace_host_event(ring: TraceRing, kind: int, tick, actor: int,
+                     subject: int, cause: int = -1, aux: int = 0) -> TraceRing:
+    """Eager single-event append for host-side control ops (kill_sparse,
+    restart_many_sparse) — same accounting as :func:`trace_emit`."""
+    R = ring.ev_kind.shape[0]
+    pos = ring.cursor
+    rec = pos < R
+    route = jnp.where(rec, pos, R)
+    i32 = lambda v: jnp.asarray(v, jnp.int32)  # noqa: E731
+    return ring.replace(
+        ev_kind=ring.ev_kind.at[route].set(kind, mode="drop"),
+        ev_tick=ring.ev_tick.at[route].set(i32(tick), mode="drop"),
+        ev_actor=ring.ev_actor.at[route].set(i32(actor), mode="drop"),
+        ev_subject=ring.ev_subject.at[route].set(i32(subject), mode="drop"),
+        ev_cause=ring.ev_cause.at[route].set(i32(cause), mode="drop"),
+        ev_aux=ring.ev_aux.at[route].set(i32(aux), mode="drop"),
+        cursor=pos + rec.astype(jnp.int32),
+        overflow=ring.overflow + (~rec).astype(jnp.int32),
+    )

@@ -876,6 +876,12 @@ def scan_sparse_ticks_spmd(
             f"mesh needs a '{AXIS}' axis of size d={cfg.d}; got {dict(mesh.shape)}"
         )
     _validate(params, cfg)
+    if state.trace is not None:
+        raise ValueError(
+            "the explicit-SPMD engine does not support the flight recorder "
+            "(state.trace must be None): the ring's append cursor is a "
+            "global sequence that per-shard emission would fork"
+        )
     scheduled = isinstance(plan, FaultSchedule)
     pspecs = sparse_state_pspecs(like=state)
     body = _scan_body(params, cfg, n_ticks, collect, scheduled)
@@ -948,6 +954,12 @@ def run_ensemble_sparse_ticks_spmd(
             f"mesh '{AXIS}' axis is {mesh.shape[AXIS]}, cfg.d is {cfg.d}"
         )
     _validate(params, cfg)
+    if states.trace is not None:
+        raise ValueError(
+            "the explicit-SPMD engine does not support the flight recorder "
+            "(state.trace must be None): the ring's append cursor is a "
+            "global sequence that per-shard emission would fork"
+        )
     scheduled = isinstance(plans, FaultSchedule)
     pspecs = sparse_state_pspecs(like=states, prefix=(UNIVERSE_AXIS,))
     inner = _scan_body(params, cfg, n_ticks, collect, scheduled)

@@ -81,6 +81,11 @@ class ServeBridge:
             else run_metadata(n=params.base.n, slot_budget=params.slot_budget)
         )
         self.rows: list[dict] = []
+        # Launch spans for the flight-recorder trace assembler
+        # (obs/trace.py::chrome_trace): one dict per launch, monotonic-clock
+        # [t0=assembly, t1=verdicts ready] — merged with the device event
+        # ring and transport message spans into one Perfetto timeline.
+        self.spans: list[dict] = []
         self.serve_batches = 0  # host accounting: a batch is a launch
         self.ticks_run = 0
         self.events_served = 0
@@ -138,6 +143,16 @@ class ServeBridge:
         self.serve_batches += 1
         self.ticks_run += self.batcher.n_ticks
         self.events_served += stats["n_events"]
+        self.spans.append(
+            {
+                "batch": self.serve_batches - 1,
+                "base_tick": int(stats["base_tick"]),
+                "batch_ticks": self.batcher.n_ticks,
+                "n_events": stats["n_events"],
+                "t0": stats["t_assemble"],
+                "t1": t_done,
+            }
+        )
         payload = {
             "batch": self.serve_batches - 1,
             "base_tick": int(stats["base_tick"]),

@@ -109,7 +109,10 @@ def save_sparse_checkpoint(path: str | Path, state, params) -> None:
         for f in dataclasses.fields(SparseState)
         # Optional fields (verdict-latency recorder) may be None — absent
         # from the archive; load_sparse_checkpoint's defaults restore None.
-        if getattr(state, f.name) is not None
+        # The flight-recorder ring (``trace``) is a nested dataclass that
+        # doesn't fit the flat array container, and a debug artifact rather
+        # than protocol state — export it via obs/trace.py instead.
+        if getattr(state, f.name) is not None and f.name != "trace"
     }
     blob = dataclasses.asdict(params)
     # pallas_fold is a frozenset — JSON carries it as a sorted list;

@@ -87,6 +87,17 @@ from scalecube_cluster_tpu.sim.schedule import (
     plan_dirty_at,
 )
 from scalecube_cluster_tpu.sim.tick import _acct_add, _acct_zero, _link_acct
+from scalecube_cluster_tpu.obs.tracer import (
+    TK_ALARM,
+    TK_KILL,
+    TK_RESTART,
+    TK_VIEW_COMMIT,
+    TK_VOTE,
+    TraceRing,
+    init_trace_ring,
+    trace_emit,
+    trace_reset_members,
+)
 
 
 @dataclass(frozen=True)
@@ -169,6 +180,10 @@ class RapidState:
     alive: jax.Array  # [N] bool
     tick: jax.Array  # [] int32
     rng: jax.Array  # PRNG key
+    #: Causal flight recorder (obs/tracer.py) — alarm / vote / view-commit
+    #: events. None (the default, and the only pre-recorder checkpoint
+    #: form) keeps the pytree and the compiled graph bit-identical.
+    trace: TraceRing | None = None
 
     def replace(self, **changes) -> "RapidState":
         return dataclasses.replace(self, **changes)
@@ -219,9 +234,15 @@ def rapid_low_watermark(params: RapidParams, knobs: Knobs | None):
     return jnp.clip(scaled, 1, _SUSP_MAX)
 
 
-def init_rapid_full_view(params: RapidParams, seed: int = 0) -> RapidState:
+def init_rapid_full_view(
+    params: RapidParams, seed: int = 0, trace_capacity: int = 0
+) -> RapidState:
     """Post-bootstrap steady state: every member holds configuration 0 =
-    the full membership (the Rapid seed view), no alarms pending."""
+    the full membership (the Rapid seed view), no alarms pending.
+
+    ``trace_capacity > 0`` attaches the causal flight recorder's event ring
+    (obs/tracer.py); 0 keeps the state pytree identical to pre-recorder
+    builds."""
     n = params.n
     return RapidState(
         member_mask=jnp.ones((n, n), bool),
@@ -235,6 +256,7 @@ def init_rapid_full_view(params: RapidParams, seed: int = 0) -> RapidState:
         alive=jnp.ones((n,), bool),
         tick=jnp.zeros((), jnp.int32),
         rng=jax.random.PRNGKey(seed),
+        trace=init_trace_ring(n, trace_capacity) if trace_capacity else None,
     )
 
 
@@ -262,7 +284,7 @@ def apply_events_rapid(
         row = restart_mask[:, None]
         mm = jnp.where(row, True, st.member_mask)
         reset_edges = restart_mask[obs] | restart_mask[:, None]
-        return st.replace(
+        st = st.replace(
             alive=(st.alive & ~kill_mask) | restart_mask,
             epoch=new_epoch,
             member_mask=mm | jnp.eye(n, dtype=bool),
@@ -273,6 +295,19 @@ def apply_events_rapid(
             vote_add=jnp.where(row, False, st.vote_add),
             voted=st.voted & ~restart_mask,
         )
+        if st.trace is not None:
+            # Control-plane events land before anything this tick's round
+            # emits, so their ring positions precede the alarms they cause.
+            t_ev = st.tick + 1
+            col_ev = jnp.arange(n, dtype=jnp.int32)
+            ring, _ = trace_emit(
+                st.trace, TK_KILL, kill_mask, t_ev, -1, col_ev
+            )
+            ring, _ = trace_emit(
+                ring, TK_RESTART, restart_mask, t_ev, -1, col_ev
+            )
+            st = st.replace(trace=trace_reset_members(ring, restart_mask))
+        return st
 
     return lax.cond(any_ev, apply, lambda s: s, state)
 
@@ -454,6 +489,46 @@ def rapid_tick(
     mm3 = jnp.where(adopt[:, None], cand_mask, mm2) | eye
     vid3 = jnp.where(adopt, vid2[best], vid2)
 
+    # ---- causal flight recorder (structure-gated, obs/tracer.py) ---------
+    # Alarm → vote → commit, in ring order: the protocol's own causal
+    # pipeline. Presence of state.trace is pytree structure, so tracer-off
+    # runs compile the identical graph.
+    ring = state.trace
+    if ring is not None:
+        # Watermark-crossing edges this tick (the same masks alarms_raised
+        # counts): actor = the alarming observer, subject = the edge's
+        # subject; aux 1 marks a join alarm, 0 a remove alarm.
+        alarm_new = (alarmed & (state.edge_fail < low)) | (
+            join_alarm & (state.edge_join < low)
+        )
+        ring, _ = trace_emit(
+            ring,
+            TK_ALARM,
+            alarm_new,
+            t,
+            obs,
+            jnp.broadcast_to(subj, (n, k)),
+            aux=jnp.where(join_alarm, 1, 0),
+        )
+        ring, _ = trace_emit(
+            ring,
+            TK_VOTE,
+            newly_voting,
+            t,
+            col,
+            col,
+            aux=jnp.sum(vote_rm, axis=1, dtype=jnp.int32),  # cut size locked
+        )
+        ring, _ = trace_emit(
+            ring,
+            TK_VIEW_COMMIT,
+            commit,
+            t,
+            col,
+            winner.astype(jnp.int32),  # the vote source the commit adopted
+            aux=vid2,
+        )
+
     # Every view change (commit or adoption) starts a fresh configuration:
     # the old locked vote is void and the member may vote once again.
     view_changed = commit | adopt
@@ -467,6 +542,7 @@ def rapid_tick(
         voted=voted & ~view_changed,
         tick=t,
         rng=rng_next,
+        trace=ring,
     )
     if not collect:
         return new_state, {"tick": t}
@@ -514,6 +590,10 @@ def rapid_tick(
         "view_size": jnp.sum(mm3, axis=1, dtype=jnp.int32),
         "alive_mask": alive,
     }
+    if ring is not None:
+        # Lossless ring accounting (emitted == recorded + overflow); keyed
+        # in only for traced states so the default schema is unchanged.
+        metrics["trace_overflow"] = ring.overflow
     return new_state, metrics
 
 

@@ -159,6 +159,26 @@ def events_at(
     return kill, restart
 
 
+def scheduled_kill_ticks(schedule: FaultSchedule) -> dict[int, list[int]]:
+    """Host-side ``{member: [kill ticks, ascending]}`` from the event table.
+
+    The flight-recorder ground truth: tools/trace_explain.py and the trace
+    tests cross-check that every explained DEAD verdict's causal chain roots
+    at (or after) one of these scheduled kills. Unused slots (tick -1) are
+    skipped; restarts are not kills.
+    """
+    ticks = np.asarray(schedule.ev_tick)
+    nodes = np.asarray(schedule.ev_node)
+    kinds = np.asarray(schedule.ev_kind)
+    out: dict[int, list[int]] = {}
+    for t, node, kind in zip(ticks, nodes, kinds):
+        if t >= 0 and kind == EV_KILL:
+            out.setdefault(int(node), []).append(int(t))
+    for v in out.values():
+        v.sort()
+    return out
+
+
 def resolve_tick(
     schedule: FaultSchedule, t: jax.Array, n: int
 ) -> tuple[FaultPlan, tuple[jax.Array, jax.Array]]:

@@ -107,6 +107,23 @@ from scalecube_cluster_tpu.sim.faults import (
 )
 from scalecube_cluster_tpu.sim.knobs import Knobs, edge_live, suspicion_fill
 from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.obs.tracer import (
+    TK_GOSSIP_EDGE,
+    TK_KILL,
+    TK_PROBE_MISSED,
+    TK_PROBE_SENT,
+    TK_RESTART,
+    TK_SUSPECT_START,
+    TK_SYNC_ACCEPT,
+    TK_VERDICT_ALIVE,
+    TK_VERDICT_DEAD,
+    TraceRing,
+    init_trace_ring,
+    trace_emit,
+    trace_host_event,
+    trace_reset_members,
+)
+from scalecube_cluster_tpu.obs.trace import DEAD_VIA_EXPIRY, DEAD_VIA_GOSSIP
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
     plan_dirty_at,
@@ -338,6 +355,12 @@ class SparseState:
     # the recorder arrays).
     wb_pinned: jax.Array | None = None  # [S] bool
     wb_valid: jax.Array | None = None  # [] bool
+    # Causal flight recorder (obs/tracer.py): a bounded on-device event ring
+    # written inside the scan. None (the default) keeps the pytree — and the
+    # compiled hot graph — bit-identical to tracer-off builds; requires the
+    # XLA tick core (sparse_tick raises under pallas_core, and the SPMD
+    # engine rejects it in _validate).
+    trace: TraceRing | None = None
 
     def replace(self, **changes) -> "SparseState":
         return dataclasses.replace(self, **changes)
@@ -350,6 +373,7 @@ def init_sparse_full_view(
     user_gossip_slots: int = 4,
     infected_k: int = 16,
     record_latency: bool = False,
+    trace_capacity: int = 0,
 ) -> SparseState:
     """Post-join steady state, nothing active: the common 100k starting point.
 
@@ -360,6 +384,10 @@ def init_sparse_full_view(
     ``record_latency=True`` attaches the per-member first-suspect/first-dead
     tick arrays (detection-latency histograms from one run, obs/latency.py);
     off by default so the bench state carries nothing extra.
+
+    ``trace_capacity > 0`` attaches the causal flight recorder's event ring
+    (obs/tracer.py) sized for that many events across the whole run; 0 (the
+    default) keeps the bench pytree identical to pre-recorder builds.
     """
     return SparseState(
         view_T=jnp.full((n, n), encode_key(0, 0), jnp.int32),
@@ -385,6 +413,7 @@ def init_sparse_full_view(
         ),
         wb_pinned=jnp.zeros((slot_budget,), bool),
         wb_valid=jnp.zeros((), bool),
+        trace=init_trace_ring(n, trace_capacity) if trace_capacity else None,
     )
 
 
@@ -440,7 +469,16 @@ def _activate_on_host(state: SparseState, subject: int) -> tuple[SparseState, in
 
 def kill_sparse(state: SparseState, idx: int) -> SparseState:
     """Hard-stop process ``idx`` (dense twin: sim/state.py::kill)."""
-    return _invalidate_wb(state).replace(alive=state.alive.at[idx].set(False))
+    state = _invalidate_wb(state).replace(alive=state.alive.at[idx].set(False))
+    if state.trace is not None:
+        # Control-plane event; stamped at the next tick to execute, matching
+        # the in-scan scheduled-kill tick convention (apply_events_sparse).
+        state = state.replace(
+            trace=trace_host_event(
+                state.trace, TK_KILL, state.tick + 1, -1, int(idx)
+            )
+        )
+    return state
 
 
 def leave_sparse(state: SparseState, idx: int) -> SparseState:
@@ -543,6 +581,17 @@ def restart_many_sparse(state: SparseState, idxs) -> SparseState:
             lat_first_suspect=state.lat_first_suspect.at[ii].set(-1),
             lat_first_dead=state.lat_first_dead.at[ii].set(-1),
         )
+    if state.trace is not None:
+        ring = state.trace
+        for j in idx_list:
+            ring = trace_host_event(ring, TK_RESTART, state.tick + 1, -1, j)
+        # Fresh identity, fresh causal history (same reason as the latency
+        # reset above).
+        n_all = state.alive.shape[0]
+        ring = trace_reset_members(
+            ring, jnp.zeros((n_all,), bool).at[ii].set(True)
+        )
+        state = state.replace(trace=ring)
 
     # 2. Slot allocation (host bookkeeping on the tiny tables), split into
     # already-active subjects vs fresh activations.
@@ -665,6 +714,32 @@ def apply_events_sparse(
             # alive/age/susp changed: the carried pin mask is stale
             # (the in-scan twin of _invalidate_wb).
             st = st.replace(wb_valid=jnp.zeros((), bool))
+        if st.trace is not None:
+            # Control-plane events land in the ring BEFORE anything the tick
+            # body emits at this tick, so a kill's position is always below
+            # the verdicts it causes. Serve-injected gossip (gossip_mask
+            # pre-sets useen, making those edges invisible to the tick's
+            # infection mask) is emitted here with aux=1 marking injection.
+            t_ev = st.tick + 1  # the tick about to execute
+            col_ev = jnp.arange(n, dtype=jnp.int32)
+            ring = st.trace
+            ring, _ = trace_emit(ring, TK_KILL, kill_mask, t_ev, -1, col_ev)
+            ring, _ = trace_emit(
+                ring, TK_RESTART, restart_mask, t_ev, -1, col_ev
+            )
+            ring = trace_reset_members(ring, restart_mask)
+            if gossip_mask is not None:
+                g = gossip_mask.shape[1]
+                ring, _ = trace_emit(
+                    ring,
+                    TK_GOSSIP_EDGE,
+                    gossip_mask,
+                    t_ev,
+                    -1,
+                    jnp.arange(g, dtype=jnp.int32)[None, :],
+                    aux=1,
+                )
+            st = st.replace(trace=ring)
         return st
 
     return lax.cond(any_ev, apply, lambda s: s, state)
@@ -746,6 +821,7 @@ def _fd_decide(
     alive_all,
     epoch_all,
     collect,
+    trace=False,
 ):
     """The FD probe decision for one set of viewer rows — THE shared body of
     sparse_tick's step 1, factored so the explicit-SPMD engine
@@ -849,10 +925,16 @@ def _fd_decide(
             _link_acct(att4, blk4, leg_ro),
         )
         out = out + (n_pings, n_ping_reqs, jnp.sum(reached)) + acct
+    if trace:
+        # Flight-recorder masks, appended LAST so fixed-index consumers
+        # (out[4:7] counters, out[7:11] accounting) never shift: the probe
+        # dispatch, the failed round, and the reached-but-wrong-epoch
+        # discovery (the direct-DEAD origin). Read via out[-3:].
+        out = out + (probing, probing & ~reached, gone)
     return out
 
 
-def _fd_zeros(m, collect):
+def _fd_zeros(m, collect, trace=False):
     """Skip-phase output of :func:`_fd_decide` for ``m`` viewer rows."""
     out = (
         jnp.zeros((m,), jnp.int32),
@@ -863,6 +945,9 @@ def _fd_zeros(m, collect):
     if collect:
         zero = jnp.asarray(0, jnp.int32)
         out = out + (zero, zero, zero) + _acct_zero()
+    if trace:
+        zmask = jnp.zeros((m,), bool)
+        out = out + (zmask, zmask, zmask)
     return out
 
 
@@ -1035,15 +1120,19 @@ def sparse_tick(
     # [N]-sized work (module docstring FD deviation). The decision body
     # lives in :func:`_fd_decide`, shared with the explicit-SPMD engine
     # (parallel/spmd.py) — the oracle is the identity-cut instantiation.
+    tracing = state.trace is not None  # static: pytree structure
+
     def fd_fire_phase(_):
         return _fd_decide(
             p, plan, t, k_tgt, k_ping, k_relay, n,
             lrow=col, col=col, cut=lambda a: a, record_of=my_record_of,
             v_alive=alive, alive_all=alive, epoch_all=state.epoch,
-            collect=collect,
+            collect=collect, trace=tracing,
         )
 
-    fd_out = lax.cond(do_fd, fd_fire_phase, lambda _: _fd_zeros(n, collect), None)
+    fd_out = lax.cond(
+        do_fd, fd_fire_phase, lambda _: _fd_zeros(n, collect, tracing), None
+    )
     fd_tgt, fd_key, fd_fire, msgs_fd = fd_out[:4]
 
     # ------------------------------------- 2. own-record SYNC (cond-gated)
@@ -1225,6 +1314,13 @@ def sparse_tick(
         and S % 128 == 0
         and S < 4096  # packed-slot field width (ops/pallas_sparse.py)
     )
+    if tracing and use_kernel:
+        raise ValueError(
+            "flight-recorder tracing requires the XLA tick core: the fused "
+            "Pallas kernel does not expose the per-cell expiry mask the "
+            "verdict events need (set pallas_core=False or drop the trace "
+            "ring)"
+        )
     fold = params.pallas_fold if use_kernel else frozenset()
     need_wb = "wb_mask" in fold
     need_rows = "view_rows" in fold
@@ -1552,6 +1648,89 @@ def sparse_tick(
         lat_s = lat_s.at[jnp.where(first_s, slot_subj, n)].set(t, mode="drop")
         lat_d = lat_d.at[jnp.where(first_d, slot_subj, n)].set(t, mode="drop")
 
+    # --------------------- 9.5 causal flight recorder (structure-gated)
+    # Same presence rule as the latency recorder: state.trace is pytree
+    # STRUCTURE, so tracer-off runs compile the identical hot loop. Emission
+    # order within the tick is the causal order — probes before misses
+    # before suspicions before verdicts — so every ``cause`` reference
+    # points strictly backwards in the ring (the per-event C6 check in
+    # tools/trace_explain.py machine-verifies exactly this).
+    ring = state.trace
+    if ring is not None:
+        probing_tr, missed_tr, gone_tr = fd_out[-3:]
+        ring, sent_pos = trace_emit(
+            ring, TK_PROBE_SENT, probing_tr, t, col, fd_tgt
+        )
+        ring, miss_pos = trace_emit(
+            ring, TK_PROBE_MISSED, missed_tr, t, col, fd_tgt, cause=sent_pos
+        )
+        # Latest recorded miss per subject: scatter-max keeps determinism
+        # when several provers miss the same subject this tick (the largest
+        # ring position wins, a total order).
+        ring = ring.replace(
+            last_miss=ring.last_miss.at[
+                jnp.where(miss_pos >= 0, fd_tgt, n)
+            ].max(miss_pos, mode="drop")
+        )
+        # A fired SUSPECT verdict is caused by THIS row's missed round
+        # (fire & ~gone ⊆ probing & ~reached, so miss_pos is live here).
+        ring, susp_pos = trace_emit(
+            ring, TK_SUSPECT_START, fd_fire & ~gone_tr, t, col, fd_tgt,
+            cause=miss_pos,
+        )
+        # Verdict-episode origin per subject: the suspicion that started the
+        # countdown, or — for the reached-but-wrong-epoch direct-DEAD path —
+        # the probe that discovered it.
+        origin = ring.origin.at[jnp.where(susp_pos >= 0, fd_tgt, n)].max(
+            susp_pos, mode="drop"
+        )
+        gone_fire = fd_fire & gone_tr & (sent_pos >= 0)
+        origin = origin.at[jnp.where(gone_fire, fd_tgt, n)].max(
+            sent_pos, mode="drop"
+        )
+        ring = ring.replace(origin=origin)
+        ring, _ = trace_emit(ring, TK_SYNC_ACCEPT, sy_accept, t, col, sy_subj)
+        # Per-viewer verdict transitions, post-load snapshot vs final slab
+        # (the same comparison the verdicts_dead/verdicts_alive counters
+        # make below — tracing works under collect=False, so recompute).
+        viewer_live_tr = alive[:, None] & active[None, :]
+        was_dead_tr = ((slab0 & DEAD_BIT) != 0) & (slab0 >= 0)
+        now_dead_tr = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
+        subj_mat = jnp.broadcast_to(slot_subj[None, :], (n, S))
+        cause_mat = ring.origin[jnp.clip(subj_mat, 0, n - 1)]
+        ring, _ = trace_emit(
+            ring,
+            TK_VERDICT_DEAD,
+            now_dead_tr & ~was_dead_tr & viewer_live_tr,
+            t,
+            col[:, None],
+            subj_mat,
+            cause=cause_mat,
+            aux=jnp.where(expired, DEAD_VIA_EXPIRY, DEAD_VIA_GOSSIP),
+        )
+        ring, _ = trace_emit(
+            ring,
+            TK_VERDICT_ALIVE,
+            is_alive_key(slab2)
+            & ~is_alive_key(slab0)
+            & (slab0 >= 0)
+            & viewer_live_tr,
+            t,
+            col[:, None],
+            subj_mat,
+            cause=cause_mat,  # the episode this refutation closes (-1 = none)
+        )
+        # User-gossip infection edges (serve-injected ones are emitted in
+        # apply_events_sparse, where they are still visible).
+        ring, _ = trace_emit(
+            ring,
+            TK_GOSSIP_EDGE,
+            new_seen & ~state.useen,
+            t,
+            col[:, None],
+            jnp.arange(state.useen.shape[1], dtype=jnp.int32)[None, :],
+        )
+
     # Carry the write-back pin mask ('wb_mask' fold): the kernel evaluated
     # the pin rule on this tick's outputs; the corrections above account
     # for everything that touched the slab after the kernel ran. Without
@@ -1583,6 +1762,7 @@ def sparse_tick(
         lat_first_dead=lat_d,
         wb_pinned=wb_pinned,
         wb_valid=wb_valid,
+        trace=ring,
     )
     if not collect:
         return new_state, {"tick": t}
@@ -1630,7 +1810,7 @@ def sparse_tick(
     for c in range(p.gossip_fanout):
         g_blk = _edge_lookup(plan.block, inv_perm[c], col)
         g_acct = _acct_add(g_acct, _link_acct(g_att_c[c], g_blk, gpass[c]))
-    acct = _acct_add(fd_out[7:], g_acct, sy_out[7:])
+    acct = _acct_add(fd_out[7:11], g_acct, sy_out[7:11])
     viewer_live = alive[:, None] & active[None, :]
     was_dead = ((slab0 & DEAD_BIT) != 0) & (slab0 >= 0)
     now_dead = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
@@ -1689,6 +1869,11 @@ def sparse_tick(
         "ingest_overflow": jnp.zeros((), jnp.int32),
         "serve_batches": jnp.zeros((), jnp.int32),
     }
+    if ring is not None:
+        # Lossless ring accounting (emitted == recorded + overflow): the
+        # running count of events the bounded ring could not hold. Keyed in
+        # only for traced states, so the default metrics schema is unchanged.
+        metrics["trace_overflow"] = ring.overflow
     return new_state, metrics
 
 

@@ -21,8 +21,10 @@ correct request/response fault semantics by only wrapping ``send``/``listen``.
 from __future__ import annotations
 
 import asyncio
+import time
 from abc import ABC, abstractmethod
 
+from scalecube_cluster_tpu.obs.trace import record_message_span
 from scalecube_cluster_tpu.transport.message import Message
 from scalecube_cluster_tpu.utils.address import Address
 from scalecube_cluster_tpu.utils.streams import Multicast, Stream
@@ -75,6 +77,10 @@ class Transport(ABC):
         if not cid:
             raise ValueError("request_response requires a correlation id")
         stream = self.listen()
+        # Flight-recorder message span, keyed by the existing correlation id
+        # (obs/trace.py) — a no-op unless a trace session armed the recorder.
+        t0 = time.monotonic()
+        ok = False
         try:
             await self.send(to, request)
 
@@ -84,9 +90,14 @@ class Transport(ABC):
                         return msg
                 raise TransportStoppedError("transport stopped awaiting response")
 
-            return await asyncio.wait_for(first_match(), timeout)
+            response = await asyncio.wait_for(first_match(), timeout)
+            ok = True
+            return response
         finally:
             stream.close()
+            record_message_span(
+                cid, request.qualifier, t0, time.monotonic(), ok=ok
+            )
 
 
 class _ListenMixin:

@@ -10,6 +10,8 @@ Regenerate the golden file with::
 
 import json
 import os
+import subprocess
+import sys
 import types
 
 import numpy as np
@@ -36,7 +38,16 @@ from scalecube_cluster_tpu.obs.latency import detection_latencies, latency_histo
 GOLDEN_PATH = os.path.join(os.path.dirname(__file__), "golden", "obs_schema_golden.jsonl")
 
 #: Fixed metadata — the golden file must not depend on the checkout or host.
-GOLDEN_META = {"commit": "deadbee", "platform": "cpu", "n": 1024, "slot_budget": 256, "seed": 7}
+GOLDEN_META = {
+    "commit": "deadbee",
+    "platform": "cpu",
+    "jax_version": "0.0.0",
+    "jaxlib_version": "0.0.0",
+    "device_kind": "cpu",
+    "n": 1024,
+    "slot_budget": 256,
+    "seed": 7,
+}
 
 
 def golden_rows() -> list[dict]:
@@ -112,13 +123,22 @@ def test_make_row_reserved_keys_and_precedence():
 
 def test_run_metadata_explicit_fields():
     meta = run_metadata(n=32, slot_budget=64, seed=3, platform="cpu", commit="abc1234")
-    # The census stamp is auto-detected from the committed tpulint golden;
-    # split it off so the explicit fields can be compared exactly.
+    # The census stamp and toolchain provenance are auto-detected (committed
+    # tpulint golden / already-imported jax modules); split them off so the
+    # explicit fields can be compared exactly.
     stamp = {
         k: meta.pop(k)
-        for k in ("lint_schema", "census_digest", "collective_digest")
+        for k in (
+            "lint_schema",
+            "census_digest",
+            "collective_digest",
+            "jax_version",
+            "jaxlib_version",
+            "device_kind",
+        )
         if k in meta
     }
+    assert {"jax_version", "jaxlib_version", "device_kind"} <= set(stamp)
     assert meta == {
         "commit": "abc1234",
         "platform": "cpu",
@@ -233,6 +253,57 @@ def test_detection_latencies_and_histogram():
         "bin_edges": [],
         "bin_counts": [],
     }
+
+
+def test_trace_scope_noop_without_jax():
+    """In a process that never imported jax, trace_scope must stay a no-op
+    AND the obs package import itself must not pull jax in (the bench
+    driver's backend-free contract — obs/trace.py is eagerly re-exported
+    now, so this guards the whole import chain)."""
+    script = (
+        "import sys\n"
+        "import contextlib\n"
+        "import scalecube_cluster_tpu.obs as obs\n"
+        "assert 'jax' not in sys.modules, 'obs import pulled in jax'\n"
+        "cm = obs.trace_scope('phase')\n"
+        "assert isinstance(cm, contextlib.nullcontext)\n"
+        "with cm:\n"
+        "    pass\n"
+        "assert 'jax' not in sys.modules\n"
+    )
+    res = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True, timeout=60
+    )
+    assert res.returncode == 0, res.stderr[-2000:]
+
+
+def test_trace_scope_real_annotation_when_jax_live():
+    import jax
+
+    from scalecube_cluster_tpu.obs.profiling import trace_scope
+
+    cm = trace_scope("outer")
+    assert isinstance(cm, jax.profiler.TraceAnnotation)
+    # Scopes enter/exit cleanly and nest (the bench chunk loop nests a
+    # dispatch scope inside a chunk scope).
+    with trace_scope("outer"):
+        with trace_scope("inner"):
+            pass
+
+
+def test_trace_scope_degrades_on_broken_profiler(monkeypatch):
+    import contextlib
+    import types
+
+    from scalecube_cluster_tpu.obs import profiling
+
+    class _Boom:
+        def __getattr__(self, name):
+            raise RuntimeError("profiler unavailable")
+
+    fake_sys = types.SimpleNamespace(modules={"jax": _Boom()})
+    monkeypatch.setattr(profiling, "sys", fake_sys)
+    assert isinstance(profiling.trace_scope("x"), contextlib.nullcontext)
 
 
 def _write_golden() -> None:

@@ -1,0 +1,269 @@
+"""Causal flight recorder (obs/tracer.py + obs/trace.py + tools/trace_explain).
+
+The recorder's contract has three legs, each pinned here:
+
+- determinism — the event ring is a pure function of (params, state, plan):
+  two identical runs produce bit-identical rings, on both engines;
+- zero interference — a traced run's protocol trajectory is bit-identical
+  to the tracer-off run (``trace`` is pytree structure, not data, so the
+  hot graph is the same compilation either way);
+- causal completeness (C6 per-event) — every DEAD verdict in a scheduled
+  kill scenario walks back through ``cause`` references to an originating
+  probe, and a tampered ring fails the machine check loudly.
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.obs.trace import (
+    DEAD_VIA_EXPIRY,
+    TK_ALARM,
+    TK_KILL,
+    TK_PROBE_SENT,
+    TK_RESTART,
+    TK_SUSPECT_START,
+    TK_VERDICT_ALIVE,
+    TK_VERDICT_DEAD,
+    TK_VIEW_COMMIT,
+    TK_VOTE,
+    chrome_trace,
+    load_events_jsonl,
+    ring_events,
+    ring_overflow,
+    write_events_jsonl,
+)
+from scalecube_cluster_tpu.obs.tracer import TraceRing
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.rapid import (
+    RapidParams,
+    init_rapid_full_view,
+    run_rapid_ticks,
+)
+from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+    run_sparse_ticks,
+)
+from tools.trace_explain import check_c6, explain_verdict, main as explain_main
+
+N, S, TICKS = 48, 96, 36
+CAP = 8192
+
+
+def _params() -> SparseParams:
+    # Short suspicion + fast probes so the kill expires to DEAD verdicts
+    # well inside the horizon (LAN defaults need 150 ticks).
+    base = SimParams(
+        n=N, fd_period_ticks=2, suspicion_ticks=10, sync_period_ticks=20
+    )
+    return SparseParams(base=base, slot_budget=S)
+
+
+def _sched():
+    return (
+        ScheduleBuilder(N)
+        .add_segment(1, FaultPlan.clean(N))
+        .kill(4, 7)
+        .kill(6, 3)
+        .restart(24, 3)
+        .build()
+    )
+
+
+def _run(trace_capacity: int = CAP, ticks: int = TICKS):
+    state = init_sparse_full_view(N, S, seed=0, trace_capacity=trace_capacity)
+    return run_sparse_ticks(_params(), state, _sched(), ticks)
+
+
+def test_sparse_ring_bit_deterministic():
+    a, _ = _run()
+    b, _ = _run()
+    for f in dataclasses.fields(TraceRing):
+        assert np.array_equal(
+            np.asarray(getattr(a.trace, f.name)),
+            np.asarray(getattr(b.trace, f.name)),
+        ), f"ring field {f.name} differs between identical runs"
+
+
+def test_sparse_tracer_off_bit_parity():
+    """Arming the recorder must not perturb the protocol by one bit."""
+    traced, _ = _run()
+    off, _ = _run(trace_capacity=0)
+    assert off.trace is None and traced.trace is not None
+    for f in dataclasses.fields(type(off)):
+        if f.name == "trace":
+            continue
+        x, y = getattr(traced, f.name), getattr(off, f.name)
+        if x is None and y is None:
+            continue
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"state.{f.name} perturbed by tracing"
+        )
+
+
+def test_every_dead_verdict_resolves_to_a_missed_probe():
+    state, _ = _run()
+    events = ring_events(state.trace)
+    assert ring_overflow(state.trace) == 0
+    kinds = {e["kind"] for e in events}
+    assert {TK_KILL, TK_RESTART, TK_PROBE_SENT, TK_SUSPECT_START,
+            TK_VERDICT_DEAD, TK_VERDICT_ALIVE} <= kinds
+    deads = [e for e in events if e["kind"] == TK_VERDICT_DEAD]
+    assert deads, "scenario produced no DEAD verdicts"
+    assert any(e["aux"] == DEAD_VIA_EXPIRY for e in deads)
+    assert check_c6(events) == []
+    for ev in deads:
+        explained = explain_verdict(events, ev)
+        assert explained["complete"], explained["violations"]
+        assert explained["chain"][-1]["kind"] == TK_PROBE_SENT
+
+
+def test_tampered_ring_fails_c6(tmp_path):
+    state, _ = _run()
+    events = ring_events(state.trace)
+    deads = [e for e in events if e["kind"] == TK_VERDICT_DEAD]
+
+    # Tamper 1: sever a chain (drop the verdict's origin reference).
+    t1 = [dict(e) for e in events]
+    t1[deads[0]["i"]]["cause"] = -1
+    assert any("unresolved cause" in v for v in check_c6(t1))
+
+    # Tamper 2: redirect a cause to a wrong-kind event.
+    kill = next(e for e in events if e["kind"] == TK_KILL)
+    t2 = [dict(e) for e in events]
+    t2[deads[-1]["i"]]["cause"] = kill["i"]
+    assert any("protocol allows" in v or "subject changes" in v
+               for v in check_c6(t2))
+
+    # Tamper 3: a forward (future) reference can never be a cause.
+    t3 = [dict(e) for e in events]
+    t3[deads[0]["i"]]["cause"] = len(events) - 1
+    assert any("strictly backwards" in v for v in check_c6(t3))
+
+    # And the CLI turns violations into a non-zero exit.
+    good, bad = tmp_path / "good.jsonl", tmp_path / "bad.jsonl"
+    write_events_jsonl(str(good), events)
+    write_events_jsonl(str(bad), t1)
+    assert explain_main([str(good), "--quiet"]) == 0
+    assert explain_main([str(bad), "--quiet"]) == 1
+
+
+def test_events_jsonl_round_trip(tmp_path):
+    state, _ = _run()
+    events = ring_events(state.trace)
+    path = tmp_path / "events.jsonl"
+    write_events_jsonl(str(path), events)
+    assert load_events_jsonl(str(path)) == events
+
+
+def test_overflow_accounting_is_lossless():
+    """Bounded capacity drops events but never loses count:
+    recorded + overflow == the unbounded run's recorded total."""
+    small_cap = 64
+    small, _ = _run(trace_capacity=small_cap)
+    big, _ = _run()
+    assert ring_overflow(big.trace) == 0
+    n_total = len(ring_events(big.trace))
+    assert n_total > small_cap
+    assert len(ring_events(small.trace)) == small_cap
+    assert ring_overflow(small.trace) == n_total - small_cap
+    # The recorded prefix is the SAME events (append-log, not circular —
+    # positions must stay stable for cause references).
+    assert ring_events(small.trace) == ring_events(big.trace)[:small_cap]
+
+
+def test_chrome_trace_export_is_valid(tmp_path):
+    state, _ = _run()
+    events = ring_events(state.trace)
+    launch = [{"batch": 0, "base_tick": 0, "batch_ticks": 8, "n_events": 2,
+               "t0": 10.0, "t1": 10.5}]
+    msgs = [{"correlation_id": "c1", "qualifier": "sc/ping", "t0": 10.1,
+             "t1": 10.2, "ok": True}]
+    doc = chrome_trace(events, launch, msgs)
+    # Valid Chrome-trace-event JSON: round-trips, and every entry has a
+    # phase + numeric timestamp on one of the three declared processes.
+    doc = json.loads(json.dumps(doc))
+    assert doc["displayTimeUnit"] == "ms"
+    entries = doc["traceEvents"]
+    assert len(entries) == 3 + len(events) + len(launch) + len(msgs)
+    for e in entries:
+        assert e["ph"] in ("M", "i", "X")
+        assert e["pid"] in (0, 1, 2)
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], (int, float))
+    # Host spans are re-based: the earliest starts at ts 0.
+    spans = [e for e in entries if e["ph"] == "X"]
+    assert min(sp["ts"] for sp in spans) == 0.0
+
+
+def test_trace_requires_xla_tick_core():
+    base = SimParams(n=64, fd_period_ticks=2, suspicion_ticks=10)
+    params = SparseParams(base=base, slot_budget=128, pallas_core=True)
+    state = init_sparse_full_view(64, 128, seed=0, trace_capacity=256)
+    with pytest.raises(ValueError, match="flight-recorder"):
+        run_sparse_ticks(params, state, FaultPlan.clean(64), 4)
+
+
+def test_spmd_engine_rejects_trace():
+    import jax
+
+    from scalecube_cluster_tpu.parallel.mesh import make_mesh
+    from scalecube_cluster_tpu.parallel.spmd import (
+        ShardConfig,
+        scan_sparse_ticks_spmd,
+    )
+
+    mesh = make_mesh(jax.devices()[:1])
+    state = init_sparse_full_view(N, S, seed=0, trace_capacity=64)
+    with pytest.raises(ValueError, match="flight recorder"):
+        scan_sparse_ticks_spmd(
+            _params(), ShardConfig(d=1), mesh, state,
+            FaultPlan.clean(N), 4,
+        )
+
+
+def _run_rapid(trace_capacity: int):
+    params = RapidParams(n=32, k=8)
+    sched = (
+        ScheduleBuilder(32)
+        .add_segment(1, FaultPlan.clean(32))
+        .kill(4, 7)
+        .build()
+    )
+    state = init_rapid_full_view(params, seed=0, trace_capacity=trace_capacity)
+    return run_rapid_ticks(params, state, sched, 60)
+
+
+def test_rapid_ring_deterministic_and_off_parity():
+    a, _ = _run_rapid(2048)
+    b, _ = _run_rapid(2048)
+    for f in dataclasses.fields(TraceRing):
+        assert np.array_equal(
+            np.asarray(getattr(a.trace, f.name)),
+            np.asarray(getattr(b.trace, f.name)),
+        ), f"rapid ring field {f.name} differs"
+    off, _ = _run_rapid(0)
+    assert off.trace is None
+    for f in dataclasses.fields(type(off)):
+        if f.name == "trace":
+            continue
+        x, y = getattr(a, f.name), getattr(off, f.name)
+        if x is None and y is None:
+            continue
+        assert np.array_equal(np.asarray(x), np.asarray(y)), (
+            f"rapid state.{f.name} perturbed by tracing"
+        )
+    events = ring_events(a.trace)
+    kinds = {e["kind"] for e in events}
+    assert {TK_KILL, TK_ALARM, TK_VOTE, TK_VIEW_COMMIT} <= kinds
+    # Consensus causality: alarms precede the votes they trigger, votes
+    # precede the commit, within the ring's append order.
+    first_alarm = min(e["i"] for e in events if e["kind"] == TK_ALARM)
+    first_vote = min(e["i"] for e in events if e["kind"] == TK_VOTE)
+    first_commit = min(e["i"] for e in events if e["kind"] == TK_VIEW_COMMIT)
+    assert first_alarm < first_vote < first_commit

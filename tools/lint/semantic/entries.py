@@ -113,14 +113,17 @@ def _build_run_ticks_pallas():
     )
 
 
-def _sparse_inputs(pallas_core, schedule=False):
+def _sparse_inputs(pallas_core, schedule=False, trace_capacity=0):
     from scalecube_cluster_tpu.sim.faults import FaultPlan
     from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
     from scalecube_cluster_tpu.sim.sparse import SparseParams, init_sparse_full_view
 
     params = SparseParams.for_n(N, slot_budget=S, pallas_core=pallas_core)
     state = init_sparse_full_view(
-        N, slot_budget=S, user_gossip_slots=params.base.user_gossip_slots
+        N,
+        slot_budget=S,
+        user_gossip_slots=params.base.user_gossip_slots,
+        trace_capacity=trace_capacity,
     )
     if schedule:
         plan = (
@@ -136,10 +139,14 @@ def _sparse_inputs(pallas_core, schedule=False):
     return params, state, plan
 
 
-def _build_run_sparse_ticks(pallas_core, schedule=False):
+def _build_run_sparse_ticks(pallas_core, schedule=False, trace_capacity=0):
     from scalecube_cluster_tpu.sim.sparse import run_sparse_ticks
 
-    params, state, plan = _sparse_inputs(pallas_core, schedule=schedule)
+    # trace_capacity > 0 arms the causal flight recorder (obs/tracer.py):
+    # a distinct state treedef, hence a distinct executable to census.
+    params, state, plan = _sparse_inputs(
+        pallas_core, schedule=schedule, trace_capacity=trace_capacity
+    )
     return (
         run_sparse_ticks,
         (params, state, plan, T),
@@ -302,7 +309,7 @@ def _build_ensemble_writeback_free():
     )
 
 
-def _build_run_rapid_ticks():
+def _build_run_rapid_ticks(trace_capacity=0):
     from scalecube_cluster_tpu.sim.faults import FaultPlan
     from scalecube_cluster_tpu.sim.rapid import (
         RapidParams,
@@ -311,7 +318,7 @@ def _build_run_rapid_ticks():
     )
 
     params = RapidParams(n=N)
-    state = init_rapid_full_view(params)
+    state = init_rapid_full_view(params, trace_capacity=trace_capacity)
     return (
         run_rapid_ticks,
         (params, state, FaultPlan.uniform(), T),
@@ -379,6 +386,10 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
         "sim.sparse.run_sparse_ticks[schedule]",
         lambda: _build_run_sparse_ticks(True, schedule=True),
     ),
+    EntrySpec(
+        "sim.sparse.run_sparse_ticks[traced]",
+        lambda: _build_run_sparse_ticks(False, trace_capacity=256),
+    ),
     EntrySpec("sim.sparse.writeback_free", _build_writeback_free),
     EntrySpec(
         "parallel.spmd.run_sparse_ticks_spmd[plan]",
@@ -406,6 +417,10 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
     ),
     EntrySpec("sim.ensemble.ensemble_writeback_free", _build_ensemble_writeback_free),
     EntrySpec("sim.rapid.run_rapid_ticks", _build_run_rapid_ticks),
+    EntrySpec(
+        "sim.rapid.run_rapid_ticks[traced]",
+        lambda: _build_run_rapid_ticks(trace_capacity=256),
+    ),
     EntrySpec("sim.rapid.run_ensemble_rapid_ticks", _build_run_ensemble_rapid_ticks),
     EntrySpec("serve.engine.run_serve_batch", _build_run_serve_batch),
 )

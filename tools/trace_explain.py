@@ -1,0 +1,222 @@
+"""Verdict explainer: walk flight-recorder cause chains and check C6.
+
+Input is the event JSONL written by ``obs.trace.write_events_jsonl`` (one
+decoded ring event per line). For every DEAD verdict — optionally filtered
+by ``--subject`` / ``--tick`` — the tool walks the ``cause`` chain back to
+the originating probe:
+
+    verdict_dead -> suspect_start -> probe_missed -> probe_sent   (expiry)
+    verdict_dead -> probe_sent                                    (epoch-gone)
+
+and machine-checks the C6 invariant ("no DEAD without a missed/refuting
+probe round") *per event*: every link must point strictly backwards in the
+ring, keep the subject fixed, keep the failure-detector actor fixed across
+the probe episode, be of the kind the protocol allows at that link, and be
+tick-ordered. A tampered or truncated ring therefore fails loudly — the
+exit code is 1 whenever any queried verdict's chain is broken.
+
+Usage::
+
+    python -m tools.trace_explain events.jsonl [--subject N] [--tick T]
+        [--max-chains K] [--quiet]
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from scalecube_cluster_tpu.obs.trace import (
+    DEAD_VIA_EXPIRY,
+    TK_PROBE_MISSED,
+    TK_PROBE_SENT,
+    TK_SUSPECT_START,
+    TK_VERDICT_DEAD,
+    load_events_jsonl,
+)
+
+#: Allowed ``cause`` kinds per link of the chain (the protocol's grammar).
+_CAUSE_KINDS = {
+    TK_VERDICT_DEAD: (TK_SUSPECT_START, TK_PROBE_SENT),
+    TK_SUSPECT_START: (TK_PROBE_MISSED,),
+    TK_PROBE_MISSED: (TK_PROBE_SENT,),
+}
+
+
+def walk_chain(by_pos: dict[int, dict], ev: dict) -> tuple[list[dict], list[str]]:
+    """Follow ``ev``'s cause references back to the originating probe.
+
+    Returns ``(chain, violations)`` where ``chain`` starts at ``ev`` and
+    ends at the last resolvable event. An empty ``violations`` list means
+    the chain is complete and every per-event C6 check held.
+    """
+    chain = [ev]
+    violations: list[str] = []
+    cur = ev
+    seen = {ev["i"]}
+    while True:
+        kinds = _CAUSE_KINDS.get(cur["kind"])
+        if kinds is None:
+            # probe_sent (or any other root kind) legitimately ends a chain.
+            if cur["kind"] != TK_PROBE_SENT and cur is not ev:
+                violations.append(
+                    f"event {cur['i']}: chain ends at kind "
+                    f"{cur['kind_name']}, not at a probe_sent root"
+                )
+            break
+        c = cur["cause"]
+        if c < 0:
+            violations.append(
+                f"event {cur['i']} ({cur['kind_name']}): unresolved cause "
+                "(ref -1) — originating probe missing from the ring"
+            )
+            break
+        if c >= cur["i"]:
+            violations.append(
+                f"event {cur['i']}: cause {c} does not point strictly "
+                "backwards in the ring"
+            )
+            break
+        if c in seen:
+            violations.append(f"event {cur['i']}: cause cycle at {c}")
+            break
+        nxt = by_pos.get(c)
+        if nxt is None:
+            violations.append(
+                f"event {cur['i']}: cause {c} not present in the event file"
+            )
+            break
+        if nxt["kind"] not in kinds:
+            allowed = "/".join(str(k) for k in kinds)
+            violations.append(
+                f"event {cur['i']} ({cur['kind_name']}): cause {c} has kind "
+                f"{nxt['kind_name']}, protocol allows kinds {allowed}"
+            )
+            break
+        if nxt["subject"] != cur["subject"]:
+            violations.append(
+                f"event {cur['i']}: subject changes along the chain "
+                f"({cur['subject']} -> {nxt['subject']} at ref {c})"
+            )
+            break
+        if nxt["tick"] > cur["tick"]:
+            violations.append(
+                f"event {cur['i']} (tick {cur['tick']}): cause {c} is from "
+                f"the future (tick {nxt['tick']})"
+            )
+            break
+        if (
+            cur["kind"] in (TK_SUSPECT_START, TK_PROBE_MISSED)
+            and nxt["actor"] != cur["actor"]
+        ):
+            # Within one probe episode the failure-detector actor is fixed;
+            # only the verdict link crosses actors (viewer != prober).
+            violations.append(
+                f"event {cur['i']}: probe-episode actor changes "
+                f"({cur['actor']} -> {nxt['actor']} at ref {c})"
+            )
+            break
+        seen.add(c)
+        chain.append(nxt)
+        cur = nxt
+    return chain, violations
+
+
+def explain_verdict(events: list[dict], verdict: dict) -> dict:
+    """Explain one DEAD verdict: its full chain plus any C6 violations."""
+    by_pos = {e["i"]: e for e in events}
+    chain, violations = walk_chain(by_pos, verdict)
+    return {
+        "verdict": verdict,
+        "chain": chain,
+        "violations": violations,
+        "complete": not violations and chain[-1]["kind"] == TK_PROBE_SENT,
+    }
+
+
+def check_c6(events: list[dict]) -> list[str]:
+    """Machine-check C6 over EVERY dead verdict in the file. Returns the
+    flat violation list (empty == the invariant held per-event)."""
+    by_pos = {e["i"]: e for e in events}
+    out: list[str] = []
+    for ev in events:
+        if ev["kind"] != TK_VERDICT_DEAD:
+            continue
+        _, violations = walk_chain(by_pos, ev)
+        out.extend(
+            f"DEAD(subject={ev['subject']}, viewer={ev['actor']}, "
+            f"tick={ev['tick']}): {v}"
+            for v in violations
+        )
+    return out
+
+
+def format_chain(explained: dict) -> str:
+    v = explained["verdict"]
+    via = "expiry" if v["aux"] == DEAD_VIA_EXPIRY else "gossip/sync"
+    lines = [
+        f"why DEAD({v['subject']}) at tick {v['tick']} "
+        f"as seen by member {v['actor']} (via {via}):"
+    ]
+    for ev in explained["chain"]:
+        lines.append(
+            f"  [{ev['i']:>5}] tick {ev['tick']:>5}  {ev['kind_name']:<14} "
+            f"actor={ev['actor']} subject={ev['subject']} cause={ev['cause']}"
+        )
+    for bad in explained["violations"]:
+        lines.append(f"  C6 VIOLATION: {bad}")
+    if explained["complete"]:
+        lines.append("  => chain complete: rooted at an originating probe (C6 ok)")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="trace_explain", description=__doc__.splitlines()[0]
+    )
+    ap.add_argument("events", help="event JSONL from obs.trace.write_events_jsonl")
+    ap.add_argument("--subject", type=int, default=None,
+                    help="only explain DEAD verdicts about this member")
+    ap.add_argument("--tick", type=int, default=None,
+                    help="only explain DEAD verdicts at this tick")
+    ap.add_argument("--max-chains", type=int, default=8,
+                    help="print at most this many chains (all are checked)")
+    ap.add_argument("--quiet", action="store_true",
+                    help="print only the C6 summary line and violations")
+    args = ap.parse_args(argv)
+
+    events = load_events_jsonl(args.events)
+    deads = [
+        e for e in events
+        if e["kind"] == TK_VERDICT_DEAD
+        and (args.subject is None or e["subject"] == args.subject)
+        and (args.tick is None or e["tick"] == args.tick)
+    ]
+    if not deads:
+        print("no matching DEAD verdicts in the trace")
+        return 0
+
+    shown = 0
+    all_violations: list[str] = []
+    for ev in deads:
+        explained = explain_verdict(events, ev)
+        all_violations.extend(explained["violations"])
+        if not args.quiet and shown < args.max_chains:
+            print(format_chain(explained))
+            shown += 1
+    if len(deads) > shown and not args.quiet:
+        print(f"... ({len(deads) - shown} more chains checked, not printed)")
+
+    if all_violations:
+        print(f"C6: {len(all_violations)} violation(s) across "
+              f"{len(deads)} DEAD verdict(s)")
+        for v in all_violations:
+            print(f"  {v}")
+        return 1
+    print(f"C6: all {len(deads)} DEAD verdict(s) resolve to a complete "
+          "causal chain")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
