@@ -50,7 +50,12 @@ and the rung forces d virtual host devices itself), or ``python bench.py
 --serve [n]`` (the streaming serving-bridge rung, serve/: a synthetic
 event stream replayed through the double-buffered launch pipeline; the
 ``kind="serve"`` session row — events/s, member·rounds/s, batch-latency
-percentiles — plus the probe attempt land in bench_history.jsonl).
+percentiles — plus the probe attempt land in bench_history.jsonl), or
+``python bench.py --load [producers] [n]`` (the wire-rate rung,
+serve/load.py: a seeded fleet of honest + adversarial loopback-TCP
+producers with churn drives one live session; the ``kind="load"`` row —
+events/s, backpressure pauses, rejections, conservation verdicts — plus
+the probe attempt land in bench_history.jsonl).
 """
 
 from __future__ import annotations
@@ -435,6 +440,32 @@ def _measure_serve(
     ]
     bridge.run_replay(events, total_ticks)
     return bridge.close()
+
+
+def _measure_load(producers: int = 32, n_members: int = 1024) -> dict:
+    """The ``--load [producers] [n]`` rung: the seeded multi-producer wire
+    harness (serve/load.py) — honest + adversarial loopback-TCP producers
+    with connection churn against one live bounded-queue session. The row
+    is the harness's own ``kind="load"`` audit row (events/s, backpressure
+    pauses, rejections, conservation verdicts), so wire-rate regressions
+    read directly against the offline and replay rungs in
+    bench_history.jsonl."""
+    import asyncio
+
+    from scalecube_cluster_tpu.serve.load import run_load
+
+    res = asyncio.run(
+        run_load(
+            n=n_members,
+            slot_budget=_rung_slot_budget(n_members),
+            producers=producers,
+            adversarial=max(producers // 4, 5),
+            events_per_producer=400,
+            max_pending=4096,
+            churn_every=100,
+        )
+    )
+    return res["row"]
 
 
 def _measure(engine: str, n_members: int, slot_budget: int | None = None) -> dict:
@@ -839,6 +870,69 @@ if __name__ == "__main__":
                             "latency_ms_p95",
                             "latency_ms_p99",
                             "latency_ms_mean",
+                        )
+                        if k in row
+                    },
+                },
+            )
+        try:
+            append_jsonl(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "artifacts",
+                    "bench_history.jsonl",
+                ),
+                [row],
+            )
+        except Exception:
+            pass
+        print(jsonl_line(row), flush=True)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--load":
+        try:
+            from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+            enable_repo_jax_cache()
+        except Exception:
+            pass
+        from scalecube_cluster_tpu.obs.export import (
+            append_jsonl,
+            jsonl_line,
+            make_row,
+            run_metadata,
+        )
+
+        producers_arg = int(sys.argv[2]) if len(sys.argv) > 2 else 32
+        n_arg = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+        t_probe = time.monotonic()
+        probe_err = _probe_once()
+        _record_probe_attempt(1, probe_err, time.monotonic() - t_probe)
+        if probe_err is not None:
+            row = make_row(
+                "load",
+                {"error": probe_err, "n_members": n_arg, **_self_evidence()},
+                run_metadata(seed=0),
+            )
+        else:
+            row = _measure_load(producers_arg, n_arg)
+            # The probe history is the long-lived per-round record: stamp
+            # the wire-rate SLO + verdicts there too, same discipline as
+            # the --serve rung's latency stamp.
+            _record_probe_attempt(
+                2,
+                None,
+                time.monotonic() - t_probe,
+                extra={
+                    "scenario": "load",
+                    "n_members": n_arg,
+                    **{
+                        k: row[k]
+                        for k in (
+                            "events_per_sec",
+                            "backpressure_pauses",
+                            "rejected",
+                            "latency_ms_p95",
+                            "conservation_ok",
+                            "bounded_ok",
                         )
                         if k in row
                     },

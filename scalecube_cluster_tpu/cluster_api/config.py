@@ -133,6 +133,20 @@ class TransportConfig(_WithMixin):
     #: Handlers still running at expiry are cancelled as before; 0 restores
     #: the old cancel-immediately behavior.
     stop_drain_ms: int = 250
+    #: Idle/read deadline for ACCEPTED connections (0 = disabled, the
+    #: default: cluster peers legitimately idle between protocol periods).
+    #: When set, an accepted connection that delivers no bytes for this
+    #: long is closed and counted (``accept_idle_timeouts``) — the
+    #: slow-loris guard: a hostile client writing a frame header one byte a
+    #: minute can no longer pin a handler (and its memory) until ``stop()``.
+    #: Serving listeners under untrusted traffic should set this
+    #: (serve/load.py defaults it on for the load harness).
+    accept_idle_timeout_ms: int = 0
+    #: Cap on concurrently ACCEPTED connections (0 = unlimited). Accepts
+    #: over the cap are closed immediately and counted (``accept_shed``) —
+    #: bounded handler/buffer memory under a connection flood, chosen shed
+    #: over OOM.
+    max_accepted_connections: int = 0
 
     @classmethod
     def default_lan(cls) -> "TransportConfig":

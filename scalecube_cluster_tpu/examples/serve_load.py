@@ -1,0 +1,60 @@
+"""Wire-rate serving under fire: a mixed honest/adversarial producer fleet.
+
+Runs the seeded multi-producer load harness (serve/load.py) against one
+live serving session over loopback TCP: honest producers at wire rate next
+to hostile ones (unknown kinds, out-of-range nodes, broken JSON, oversized
+frames, garbage bytes, a slow-loris half-frame), with mid-stream connection
+churn. Prints the audit: throughput, the backpressure/shed/reject
+accounting, and the conservation verdict — every event acked into the
+batcher is served, pending, or explicitly counted, never silently lost.
+"""
+
+import asyncio
+
+from scalecube_cluster_tpu.serve.load import run_load
+
+
+def main() -> None:
+    res = asyncio.run(
+        run_load(
+            n=32,
+            producers=12,
+            adversarial=6,  # one of each hostile profile, plus a repeat
+            events_per_producer=120,
+            max_pending=512,
+            churn_every=50,
+            accept_idle_timeout_ms=500,
+            seed=7,
+        )
+    )
+    row = res["row"]
+    print(
+        f"{row['producers']} producers ({row['adversarial']} hostile, "
+        f"{row['reconnects']} reconnects): "
+        f"pushed={row['pushed']} served={row['served']} "
+        f"pending={row['pending']} shed={row['shed']}"
+    )
+    print(
+        f"hostility handled: rejected={row['rejected']} "
+        f"decode_failures={row['decode_failures']} "
+        f"oversized={row['frames_oversized']} "
+        f"idle_evictions={row['accept_idle_timeouts']}"
+    )
+    print(
+        f"pressure: peak_pending={row['peak_pending']}/{row['max_pending']} "
+        f"({row['overflow_policy']}) pauses={row['backpressure_pauses']}"
+    )
+    verdict = (
+        "CONSERVED"
+        if res["conservation_ok"] and res["rejected_ok"] and res["bounded_ok"]
+        else "VIOLATED"
+    )
+    print(
+        f"audit: {verdict} — {row['events_per_sec']:.0f} ev/s, "
+        f"p95 {row['latency_ms_p95']:.2f} ms, "
+        f"{len(res['errors'])} producer errors"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,16 @@ from __future__ import annotations
 #                      DEFERRED to a later tick — never dropped (serve/,
 #                      the host-outran-the-budget signal; offline engines
 #                      have no ingest path and emit constant 0)
+#   ingest_rejected    malformed serve-event payloads a live session refused
+#                      (unknown kind, out-of-range node/slot, non-object
+#                      data) — wire accounting stamped by the bridge from
+#                      TcpEventSource.rejected; per-tick engine metrics emit
+#                      constant 0 (no ingest path offline)
+#   ingest_backpressure  full->pause->resume flow-control cycles a live
+#                      session's pump took against producers under the
+#                      lossless ``defer`` overflow policy (serve/ingest.py);
+#                      host accounting like serve_batches — engines emit
+#                      constant 0
 #   serve_batches      event batches the serving bridge completed (stamped
 #                      into serve session rows from host accounting;
 #                      per-tick engine metrics emit constant 0 — a batch is
@@ -64,6 +74,8 @@ SHARED_COUNTERS: tuple[str, ...] = (
     "cut_detected",
     "exchange_overflow",
     "ingest_overflow",
+    "ingest_rejected",
+    "ingest_backpressure",
     "serve_batches",
 )
 

@@ -810,6 +810,8 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         "exchange_overflow": summed["exchange_overflow"],
         # Serving-bridge counters (serve/): no ingest path offline.
         "ingest_overflow": jnp.zeros((), jnp.int32),
+        "ingest_rejected": jnp.zeros((), jnp.int32),
+        "ingest_backpressure": jnp.zeros((), jnp.int32),
         "serve_batches": jnp.zeros((), jnp.int32),
     }
     return new_state, metrics

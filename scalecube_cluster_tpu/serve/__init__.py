@@ -26,7 +26,9 @@ from scalecube_cluster_tpu.serve.events import (
     event_masks,
 )
 from scalecube_cluster_tpu.serve.ingest import (
+    OVERFLOW_POLICIES,
     SERVE_QUALIFIER,
+    BatcherFull,
     EventBatcher,
     ServeEvent,
     TcpEventSource,
@@ -39,8 +41,10 @@ __all__ = [
     "EV_GOSSIP",
     "EV_KILL",
     "EV_RESTART",
+    "BatcherFull",
     "EventBatch",
     "EventBatcher",
+    "OVERFLOW_POLICIES",
     "SERVE_QUALIFIER",
     "ServeBridge",
     "ServeEvent",

@@ -64,6 +64,9 @@ class ServeBridge:
         collect: bool = True,
         export_path: str | None = None,
         meta: dict | None = None,
+        max_pending: int = 65536,
+        low_watermark: int | None = None,
+        overflow_policy: str = "defer",
     ):
         self.params = params
         self.state = state
@@ -72,8 +75,18 @@ class ServeBridge:
         self.collect = collect
         self.export_path = export_path
         g_slots = int(state.useen.shape[1])
+        # Bounded-queue default: a serving session must degrade by CHOICE
+        # (defer = lossless backpressure to producers; shed-oldest = bounded
+        # latency, shed counted), never by unbounded deque growth.
+        # max_pending=0 restores the unbounded PR-10 behavior.
         self.batcher = EventBatcher(
-            params.base.n, g_slots, batch_ticks, capacity
+            params.base.n,
+            g_slots,
+            batch_ticks,
+            capacity,
+            max_pending=max_pending,
+            low_watermark=low_watermark,
+            overflow_policy=overflow_policy,
         )
         self.meta = (
             meta
@@ -92,12 +105,23 @@ class ServeBridge:
         self._lat_ms: list[float] = []
         self._exec_s = 0.0
         self._counter_totals = {k: 0 for k in SHARED_COUNTERS}
+        # Live event sources this bridge has pumped from (run_live attaches
+        # one per call): their malformed-payload rejections are session
+        # accounting and reach the export rows — adversarial traffic must
+        # be visible in artifacts, not just in a log line.
+        self._sources: list[TcpEventSource] = []
+        self._rejected_seen = 0  # rejected total already stamped into rows
 
     # -- ingestion ----------------------------------------------------------
 
     def push(self, ev: ServeEvent) -> None:
         """Enqueue one event (trace replay / programmatic producers)."""
         self.batcher.push(ev)
+
+    @property
+    def ingest_rejected(self) -> int:
+        """Malformed-payload rejections across every live source this session."""
+        return sum(src.rejected for src in self._sources)
 
     # -- launch pipeline ----------------------------------------------------
 
@@ -162,6 +186,12 @@ class ServeBridge:
             "ingest_overflow": stats["n_deferred"],
             "latency_ms": lat_ms,
         }
+        # Per-launch adversarial-traffic visibility: the rejections that
+        # accrued since the previous launch, not the running total (rows
+        # stay window-additive like every other per-launch counter).
+        rej = self.ingest_rejected
+        payload["ingest_rejected"] = rej - self._rejected_seen
+        self._rejected_seen = rej
         if self.collect:
             for k in SHARED_COUNTERS:
                 if k in traces:
@@ -216,25 +246,53 @@ class ServeBridge:
         return out
 
     async def run_live(
-        self, transport, n_batches: int, settle_s: float = 0.0
+        self,
+        transport,
+        n_batches: int | None = None,
+        settle_s: float = 0.0,
+        *,
+        pace_s: float | None = None,
+        stop_when=None,
     ) -> list:
-        """Serve ``n_batches`` launches from a live transport session.
+        """Serve launches from a live transport session.
 
         A pump task drains ``serve/event`` messages into the batcher; each
-        launch picks up whatever arrived since the last one. ``settle_s``
-        yields to the loop between launches so socket reads land (loopback
-        tests use a small value; a real deployment would pace on its tick
-        deadline).
+        launch picks up whatever arrived since the last one. Pacing:
+
+        - ``pace_s`` — deadline-paced: launch ``i`` fires at
+          ``t0 + i*pace_s`` on the monotonic clock (a launch that overran
+          its slot fires the next one immediately; no drift accumulates).
+          This is the serving cadence — the tick deadline — and replaces
+          sleeping a fixed ``settle_s`` per launch.
+        - ``settle_s`` — legacy fixed sleep per launch (loopback tests).
+        - neither — launches back-to-back, yielding once to the loop so
+          queued frames land.
+
+        Termination: after ``n_batches`` launches, or when ``stop_when()``
+        returns true (checked before each launch); at least one must be
+        given. Returns the per-launch trace dicts.
         """
+        if n_batches is None and stop_when is None:
+            raise ValueError("run_live needs n_batches or stop_when")
         src = TcpEventSource(transport)
+        self._sources.append(src)
         pump = asyncio.ensure_future(src.pump(self.batcher))
         out = []
+        t0 = time.monotonic()
+        i = 0
         try:
-            for _ in range(n_batches):
-                if settle_s:
+            while n_batches is None or i < n_batches:
+                if stop_when is not None and stop_when():
+                    break
+                if pace_s is not None:
+                    delay = t0 + i * pace_s - time.monotonic()
+                    if delay > 0:
+                        await asyncio.sleep(delay)
+                elif settle_s:
                     await asyncio.sleep(settle_s)
                 await asyncio.sleep(0)  # let queued frames reach the batcher
                 out.append(self.step_batch())
+                i += 1
         finally:
             pump.cancel()
             try:
@@ -249,12 +307,15 @@ class ServeBridge:
         """Session counter totals on the SHARED_COUNTERS schema.
 
         Trace sums carry the true per-tick values (including the serve
-        runner's ``ingest_overflow`` override); ``serve_batches`` is pure
-        host accounting — a batch is a launch, not a tick event — stamped
-        here over the engines' constant-zero schema slot.
+        runner's ``ingest_overflow`` override); ``serve_batches``,
+        ``ingest_rejected`` and ``ingest_backpressure`` are pure host
+        accounting — wire/session events, not tick events — stamped here
+        over the engines' constant-zero schema slots.
         """
         totals = dict(self._counter_totals)
         totals["serve_batches"] = self.serve_batches
+        totals["ingest_rejected"] = self.ingest_rejected
+        totals["ingest_backpressure"] = self.batcher.backpressure_total
         return totals
 
     def summary_row(self) -> dict:
@@ -269,6 +330,12 @@ class ServeBridge:
             "events_total": self.events_served,
             "events_pending": len(self.batcher),
             "ingest_overflow": self.batcher.overflow_total,
+            "ingest_rejected": self.ingest_rejected,
+            "ingest_backpressure": self.batcher.backpressure_total,
+            "ingest_shed": self.batcher.shed_total,
+            "max_pending": self.batcher.max_pending,
+            "peak_pending": self.batcher.peak_pending,
+            "overflow_policy": self.batcher.overflow_policy,
             "events_per_sec": self.events_served / exec_s,
             "member_rounds_per_sec": self.params.base.n * self.ticks_run / exec_s,
             "latency_ms_p50": lat.get("p50", 0.0),

@@ -31,6 +31,26 @@ overflow is LOSSLESS: an event that does not fit its target tick's ``C``
 slots slides to the next tick with room (or the next batch), FIFO-stable,
 and each such slide increments the target tick's ``deferred`` counter —
 surfaced as the ``ingest_overflow`` counter (obs/counters.py).
+
+Queue-depth overflow (a live session whose producers outrun the device) is
+a SEPARATE, bounded axis: ``max_pending`` caps the pending deque and the
+``overflow_policy`` chooses the trade when the cap is hit —
+
+- ``"defer"`` (default, lossless): a full batcher REFUSES new pushes
+  (:class:`BatcherFull`); the live pump propagates the refusal to producers
+  as TCP flow control (:class:`TcpEventSource` pauses the transport's
+  socket reads until the queue drains to ``low_watermark``), so memory is
+  bounded and no accepted event is ever dropped.
+- ``"shed-oldest"`` (bounded-latency): a full batcher drops its OLDEST
+  pending event to admit the new one, counting ``shed_total`` — freshness
+  wins over completeness, explicitly.
+
+Either way the conservation invariant holds at every batch boundary::
+
+    pushed_total == served + len(pending) + shed_total
+
+— every event acked into the batcher is served, still pending, or
+explicitly counted as shed; never silently lost (tests/test_load.py).
 """
 
 from __future__ import annotations
@@ -131,6 +151,20 @@ def event_from_message(msg: Message) -> ServeEvent:
     return event_from_obj(msg.data)
 
 
+class BatcherFull(RuntimeError):
+    """``push()`` on a full batcher under the lossless ``defer`` policy.
+
+    The caller owns the event and must retry after the queue drains — the
+    live pump turns this refusal into TCP flow control (pause the socket
+    reads, :meth:`EventBatcher.wait_room`), a sync caller sees the error.
+    The event was NOT enqueued and NOT counted.
+    """
+
+
+#: Queue-full trades an operator can choose (module docstring).
+OVERFLOW_POLICIES = ("defer", "shed-oldest")
+
+
 class EventBatcher:
     """Packs pending events into fixed-shape per-tick tensors, losslessly.
 
@@ -143,29 +177,76 @@ class EventBatcher:
     whole launch is full. Events are never dropped; when capacity is
     adequate the packing reproduces a FaultSchedule's placement exactly
     (the bit-parity precondition, tests/test_serve.py).
+
+    ``max_pending`` bounds the pending deque (0 = unbounded); at the cap,
+    ``overflow_policy`` picks the trade (module docstring): ``defer``
+    refuses the push (:class:`BatcherFull`, backpressure), ``shed-oldest``
+    drops the oldest pending event and counts it. ``low_watermark`` is the
+    drain level at which a paused producer resumes (hysteresis — resuming
+    at the cap itself would thrash pause/resume per event).
     """
 
-    def __init__(self, n: int, g_slots: int, n_ticks: int, capacity: int):
+    def __init__(
+        self,
+        n: int,
+        g_slots: int,
+        n_ticks: int,
+        capacity: int,
+        *,
+        max_pending: int = 0,
+        low_watermark: int | None = None,
+        overflow_policy: str = "defer",
+    ):
         if n_ticks < 1 or capacity < 1:
             raise ValueError("need n_ticks >= 1 and capacity >= 1")
+        if overflow_policy not in OVERFLOW_POLICIES:
+            raise ValueError(
+                f"unknown overflow_policy {overflow_policy!r}; "
+                f"valid: {OVERFLOW_POLICIES}"
+            )
         self.n = int(n)
         self.g_slots = int(g_slots)
         self.n_ticks = int(n_ticks)
         self.capacity = int(capacity)
+        self.max_pending = int(max_pending)
+        if low_watermark is None:
+            low_watermark = self.max_pending // 2
+        self.low_watermark = int(low_watermark)
+        if self.max_pending and not 0 <= self.low_watermark < self.max_pending:
+            raise ValueError(
+                f"low_watermark {self.low_watermark} outside "
+                f"[0, max_pending={self.max_pending})"
+            )
+        self.overflow_policy = overflow_policy
         self._pending: deque[ServeEvent] = deque()
         #: Session totals (host accounting; the bridge stamps them into rows).
         self.pushed_total = 0
         self.overflow_total = 0
+        self.shed_total = 0
+        #: Backpressure pause EPISODES (each full->wait->resume cycle of a
+        #: producer, counted by the party that paused — TcpEventSource).
+        self.backpressure_total = 0
+        #: High-water mark of the pending deque — the certification witness
+        #: that the queue never exceeded ``max_pending`` (tests/test_load.py).
+        self.peak_pending = 0
+        # One-shot waiter armed by wait_room(), fired by next_batch() when
+        # the queue drains to the low watermark.
+        self._room: asyncio.Event | None = None
 
     def __len__(self) -> int:
         return len(self._pending)
 
-    def push(self, ev: ServeEvent, stamp: bool = True) -> None:
-        """Validate and enqueue; stamps ``t_ingest`` if the source didn't.
+    @property
+    def is_full(self) -> bool:
+        return bool(self.max_pending) and len(self._pending) >= self.max_pending
 
-        ``stamp=False`` leaves an unset ``t_ingest`` unset — trace replay
-        uses it so per-batch SLO windows open at batch assembly instead of
-        measuring how long a pre-loaded trace sat in the queue.
+    def validate(self, ev: ServeEvent) -> None:
+        """Raise ``ValueError`` unless ``ev`` is in-range for this session.
+
+        Split out of :meth:`push` so the live pump can REJECT a hostile
+        event (out-of-range node/slot, unknown kind) before deciding to
+        backpressure-pause for it — a malformed flood must cost accounting,
+        never queue room or a pause cycle.
         """
         if not 0 <= ev.node < self.n:
             raise ValueError(f"event node {ev.node} outside [0, {self.n})")
@@ -175,10 +256,43 @@ class EventBatcher:
             )
         if ev.kind not in (EV_KILL, EV_RESTART, EV_GOSSIP):
             raise ValueError(f"unknown event kind {ev.kind}")
+
+    def push(self, ev: ServeEvent, stamp: bool = True) -> None:
+        """Validate and enqueue; stamps ``t_ingest`` if the source didn't.
+
+        ``stamp=False`` leaves an unset ``t_ingest`` unset — trace replay
+        uses it so per-batch SLO windows open at batch assembly instead of
+        measuring how long a pre-loaded trace sat in the queue.
+
+        At ``max_pending`` the overflow policy decides: ``defer`` raises
+        :class:`BatcherFull` (nothing enqueued or counted), ``shed-oldest``
+        drops the oldest pending event (counted in ``shed_total``) to admit
+        this one.
+        """
+        self.validate(ev)
+        if self.is_full:
+            if self.overflow_policy == "shed-oldest":
+                self._pending.popleft()
+                self.shed_total += 1
+            else:
+                raise BatcherFull(
+                    f"{len(self._pending)} events pending >= "
+                    f"max_pending={self.max_pending} (policy=defer)"
+                )
         if stamp and ev.t_ingest is None:
             ev.t_ingest = time.monotonic()
         self._pending.append(ev)
         self.pushed_total += 1
+        if len(self._pending) > self.peak_pending:
+            self.peak_pending = len(self._pending)
+
+    async def wait_room(self) -> None:
+        """Block until the queue drains to ``low_watermark`` (no-op when
+        unbounded). The defer-policy pump parks here with the transport's
+        socket reads paused; :meth:`next_batch` fires the waiter."""
+        while self.max_pending and len(self._pending) > self.low_watermark:
+            self._room = asyncio.Event()
+            await self._room.wait()
 
     def next_batch(self, base_tick: int) -> tuple[EventBatch, dict]:
         """Assemble the batch for ticks ``base_tick + 1 .. base_tick + k``.
@@ -220,6 +334,11 @@ class EventBatcher:
             if ev.t_ingest is not None:
                 oldest = ev.t_ingest if oldest is None else min(oldest, ev.t_ingest)
         self._pending = keep
+        if self._room is not None and (
+            not self.max_pending or len(self._pending) <= self.low_watermark
+        ):
+            self._room.set()
+            self._room = None
         n_deferred = int(batch.deferred.sum())
         self.overflow_total += n_deferred
         return batch, {
@@ -237,23 +356,53 @@ class TcpEventSource:
     graceful drain (transport/tcp.py::stop), frames a client wrote before
     the shutdown are still dispatched, so :meth:`pump` returns only after
     the in-flight traffic reached the batcher.
+
+    Backpressure (defer policy): when the batcher is full the pump PAUSES
+    the transport's socket reads (transport/tcp.py::pause_reading) and
+    parks in :meth:`EventBatcher.wait_room` until a launch drains the queue
+    to the low watermark. Paused reads stop emptying the kernel socket
+    buffers, the TCP receive windows close, and producers block in their
+    own ``write()``/``drain()`` — flow control end to end, with nothing
+    accepted ever dropped. Under ``shed-oldest`` the batcher itself sheds,
+    so the pump never pauses and producers keep wire rate.
     """
 
     def __init__(self, transport):
         self._transport = transport
         self.rejected = 0  # malformed payloads (logged, never fatal)
+        self.backpressure_pauses = 0  # full->pause->resume cycles taken
 
     async def pump(self, batcher: EventBatcher) -> None:
         stream = self._transport.listen()
+        pause = getattr(self._transport, "pause_reading", None)
+        resume = getattr(self._transport, "resume_reading", None)
         try:
             async for msg in stream:
                 if msg.qualifier != SERVE_QUALIFIER:
                     continue
                 try:
-                    batcher.push(event_from_message(msg))
+                    ev = event_from_message(msg)
+                    batcher.validate(ev)
                 except (ValueError, TypeError):
+                    # Accounting (self.rejected -> ingest_rejected rows) is
+                    # the record; per-event logs at warning would let an
+                    # adversarial flood spam the operator's console.
                     self.rejected += 1
-                    logger.warning("rejected malformed serve event: %s", msg)
+                    logger.debug("rejected malformed serve event: %s", msg)
+                    continue
+                if batcher.is_full and batcher.overflow_policy == "defer":
+                    self.backpressure_pauses += 1
+                    batcher.backpressure_total += 1
+                    if pause is not None:
+                        pause()
+                    try:
+                        await batcher.wait_room()
+                    finally:
+                        if resume is not None:
+                            resume()
+                # No await between wait_room() and push: nothing can refill
+                # the queue in between, so this push cannot raise BatcherFull.
+                batcher.push(ev)
         except asyncio.CancelledError:
             pass
         finally:

@@ -580,6 +580,8 @@ def rapid_tick(
         "exchange_overflow": zero,
         # Serving-bridge counters (serve/): no ingest path offline.
         "ingest_overflow": zero,
+        "ingest_rejected": zero,
+        "ingest_backpressure": zero,
         "serve_batches": zero,
         # Monotonicity gauges (inc_max has no Rapid analog: constant 0).
         "inc_max": zero,

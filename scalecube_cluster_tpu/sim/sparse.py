@@ -1865,8 +1865,11 @@ def sparse_tick(
         "exchange_overflow": jnp.zeros((), jnp.int32),
         # Serving-bridge counters (serve/): the offline tick has no ingest
         # path, so the schema slots are constant zero here; the serve
-        # runner overrides ingest_overflow with the batch's deferral count.
+        # runner overrides ingest_overflow with the batch's deferral count;
+        # rejected/backpressure are wire-session accounting the bridge stamps.
         "ingest_overflow": jnp.zeros((), jnp.int32),
+        "ingest_rejected": jnp.zeros((), jnp.int32),
+        "ingest_backpressure": jnp.zeros((), jnp.int32),
         "serve_batches": jnp.zeros((), jnp.int32),
     }
     if ring is not None:

@@ -843,6 +843,8 @@ def sim_tick(
         "exchange_overflow": jnp.zeros((), jnp.int32),
         # Serving-bridge counters (serve/): no ingest path offline.
         "ingest_overflow": jnp.zeros((), jnp.int32),
+        "ingest_rejected": jnp.zeros((), jnp.int32),
+        "ingest_backpressure": jnp.zeros((), jnp.int32),
         "serve_batches": jnp.zeros((), jnp.int32),
     }
     return new_state, metrics

@@ -30,6 +30,7 @@ import asyncio
 import contextlib
 import logging
 import random
+import time
 
 from scalecube_cluster_tpu.cluster_api.config import TransportConfig
 from scalecube_cluster_tpu.native import load_framing
@@ -45,6 +46,18 @@ from scalecube_cluster_tpu.utils.address import Address
 logger = logging.getLogger(__name__)
 
 _READ_CHUNK = 64 * 1024
+
+#: Size bound on the per-destination dial-failure book (backoff state). A
+#: long-lived node dialing a churning peer population would otherwise grow
+#: the dict one entry per dead destination forever; past this many tracked
+#: destinations the stalest entry is evicted (losing only its backoff
+#: position — the next dial to it starts the backoff ladder over).
+_DIAL_FAILURES_MAX = 1024
+
+#: Age factor after which a dial-failure entry is pruned outright: once a
+#: destination has not been dialed for this many max-backoff periods, its
+#: failure streak carries no useful pacing information any more.
+_DIAL_FAILURE_TTL_BACKOFFS = 32
 
 
 class _Connection:
@@ -79,12 +92,27 @@ class TcpTransport(_ListenMixin, Transport):
         # (TransportImpl.java:299-322).
         self._connections: dict[Address, asyncio.Future[_Connection]] = {}
         # Consecutive failed-dial count per destination; drives the bounded
-        # reconnect backoff and resets on a successful connect.
+        # reconnect backoff and resets on a successful connect. Bounded in
+        # size and age (_note_dial_failure) — churning peer populations must
+        # not leak one entry per dead destination forever.
         self._dial_failures: dict[Address, int] = {}
+        self._dial_failure_ts: dict[Address, float] = {}
         self._jitter_rng = random.Random()  # tpulint: disable=R3 -- backoff jitter exists to DECORRELATE redialing senders; tests pin the envelope, not values
         self._accepted: set[asyncio.Task] = set()
         self._accepted_writers: set[asyncio.StreamWriter] = set()
         self._stopped = False
+        # Backpressure gate over EVERY read loop: cleared by pause_reading()
+        # (serve/ingest.py's defer-policy pump), set by resume_reading() and
+        # stop(). While cleared no socket is read, so kernel receive buffers
+        # fill and the peers' TCP windows close — flow control to producers.
+        self._read_gate = asyncio.Event()
+        self._read_gate.set()
+        # -- wire accounting (wire_stats(); serve/load.py exports these) --
+        self.backpressure_pauses = 0  # pause_reading() transitions taken
+        self.accept_shed = 0  # accepts closed over max_accepted_connections
+        self.accept_idle_timeouts = 0  # accepted conns closed for idleness
+        self.decode_failures = 0  # well-framed but undecodable payloads
+        self.frames_oversized = 0  # streams poisoned by an oversized header
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -128,6 +156,9 @@ class TcpTransport(_ListenMixin, Transport):
         if self._stopped:
             return
         self._stopped = True
+        # A backpressure pause must never deadlock shutdown: reopen the gate
+        # so the drain below can actually read out the in-flight frames.
+        self._read_gate.set()
         if self._server is not None:
             self._server.close()
         for fut in list(self._connections.values()):
@@ -158,6 +189,36 @@ class TcpTransport(_ListenMixin, Transport):
             with contextlib.suppress(Exception):
                 await self._server.wait_closed()
         self._complete_streams()
+
+    # -- backpressure --------------------------------------------------------
+
+    def pause_reading(self) -> None:
+        """Stop reading EVERY connection (ingestion backpressure).
+
+        Kernel receive buffers fill, the peers' TCP windows close, and
+        producers block in their own writes — per-connection flow control
+        with no frames dropped. Idempotent; the pause also freezes the
+        accept-idle clock (a paused server must not time out the clients it
+        chose to stop reading).
+        """
+        if self._read_gate.is_set():
+            self._read_gate.clear()
+            self.backpressure_pauses += 1
+
+    def resume_reading(self) -> None:
+        """Reopen the read gate (idempotent); paused read loops continue."""
+        self._read_gate.set()
+
+    def wire_stats(self) -> dict:
+        """Hostile-traffic / pressure accounting for export rows
+        (serve/load.py stamps these into the ``kind="load"`` row)."""
+        return {
+            "backpressure_pauses": self.backpressure_pauses,
+            "accept_shed": self.accept_shed,
+            "accept_idle_timeouts": self.accept_idle_timeouts,
+            "decode_failures": self.decode_failures,
+            "frames_oversized": self.frames_oversized,
+        }
 
     # -- outbound ------------------------------------------------------------
 
@@ -195,6 +256,35 @@ class TcpTransport(_ListenMixin, Transport):
             delay_ms *= 1.0 + self._jitter_rng.uniform(-spread, spread)
         return delay_ms / 1000.0
 
+    def _dial_failure_ttl_s(self) -> float:
+        """Age past which a dial-failure entry is pure leak (module consts)."""
+        slowest_ms = max(
+            self._config.reconnect_backoff_max_ms,
+            self._config.reconnect_backoff_min_ms,
+            1,
+        )
+        return slowest_ms / 1000.0 * _DIAL_FAILURE_TTL_BACKOFFS
+
+    def _note_dial_failure(self, to: Address) -> None:
+        """Count one failed dial and prune the failure book (age + size).
+
+        The regression this guards (tests/test_transport.py): a long-lived
+        node dialing a churning peer set used to accrete one entry per dead
+        destination forever — entries now expire once stale (TTL) and the
+        book is hard-capped, evicting stalest-first.
+        """
+        now = time.monotonic()
+        self._dial_failures[to] = self._dial_failures.get(to, 0) + 1
+        self._dial_failure_ts[to] = now
+        ttl = self._dial_failure_ttl_s()
+        for addr in [a for a, t in self._dial_failure_ts.items() if now - t > ttl]:
+            self._dial_failures.pop(addr, None)
+            self._dial_failure_ts.pop(addr, None)
+        while len(self._dial_failures) > _DIAL_FAILURES_MAX:
+            stalest = min(self._dial_failure_ts, key=self._dial_failure_ts.get)
+            self._dial_failures.pop(stalest, None)
+            self._dial_failure_ts.pop(stalest, None)
+
     async def _get_or_connect(self, to: Address) -> _Connection:
         fut = self._connections.get(to)
         if fut is not None and fut.done():
@@ -225,6 +315,7 @@ class TcpTransport(_ListenMixin, Transport):
                     timeout=self._config.connect_timeout / 1000.0,
                 )
                 self._dial_failures.pop(to, None)
+                self._dial_failure_ts.pop(to, None)
                 conn = _Connection(reader, writer)
                 if fut.cancelled() or self._stopped:
                     # stop() cancelled the cached future while we dialed.
@@ -238,7 +329,7 @@ class TcpTransport(_ListenMixin, Transport):
                 fut.set_result(conn)
             except BaseException as exc:
                 if not isinstance(exc, asyncio.CancelledError):
-                    self._dial_failures[to] = self._dial_failures.get(to, 0) + 1
+                    self._note_dial_failure(to)
                 self._evict(to)
                 if not fut.done():
                     if isinstance(exc, asyncio.CancelledError):
@@ -269,12 +360,27 @@ class TcpTransport(_ListenMixin, Transport):
     async def _on_accept(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        cap = self._config.max_accepted_connections
+        if cap and len(self._accepted_writers) >= cap:
+            # Accept-shed: over the cap the connection is closed before a
+            # handler (and its read buffers) exists — bounded memory under a
+            # connection flood, and the shed is counted, never silent.
+            self.accept_shed += 1
+            logger.warning(
+                "shedding accepted connection over cap %d", cap
+            )
+            with contextlib.suppress(Exception):
+                writer.close()
+            return
         task = asyncio.current_task()
         assert task is not None
         self._accepted.add(task)
         self._accepted_writers.add(writer)
+        idle_ms = self._config.accept_idle_timeout_ms
         try:
-            await self._read_loop(reader)
+            await self._read_loop(
+                reader, idle_timeout_s=idle_ms / 1000.0 if idle_ms > 0 else None
+            )
         finally:
             self._accepted.discard(task)
             self._accepted_writers.discard(writer)
@@ -282,16 +388,50 @@ class TcpTransport(_ListenMixin, Transport):
                 writer.close()
 
     async def _read_loop(
-        self, reader: asyncio.StreamReader, evict: Address | None = None
+        self,
+        reader: asyncio.StreamReader,
+        evict: Address | None = None,
+        idle_timeout_s: float | None = None,
     ) -> None:
         """Frame-decode loop: chunked reads through the native accumulator
-        (LengthFieldBasedFrameDecoder stage, TransportImpl.java:383-397)."""
+        (LengthFieldBasedFrameDecoder stage, TransportImpl.java:383-397).
+
+        ``idle_timeout_s`` (accepted connections, when configured) bounds
+        the wait for EACH chunk — the slow-loris guard: a client trickling
+        a frame header byte-by-byte re-arms the deadline per byte but can
+        never pin the handler indefinitely without paying wire traffic, and
+        a silent one is closed at the first expiry. The backpressure gate
+        is awaited first and does not consume idle budget: a paused server
+        chose not to read; that must not count against the client.
+        """
         accum = self._accumulator_cls(self._config.max_frame_length)
         try:
             while True:
-                chunk = await reader.read(_READ_CHUNK)
+                if not self._read_gate.is_set():
+                    await self._read_gate.wait()
+                try:
+                    if idle_timeout_s is not None:
+                        chunk = await asyncio.wait_for(
+                            reader.read(_READ_CHUNK), idle_timeout_s
+                        )
+                    else:
+                        chunk = await reader.read(_READ_CHUNK)
+                except (asyncio.TimeoutError, TimeoutError):
+                    self.accept_idle_timeouts += 1
+                    logger.warning(
+                        "closing idle accepted connection after %.0f ms",
+                        idle_timeout_s * 1000.0,
+                    )
+                    break
                 if not chunk:
                     break
+                # Re-check the gate after the read returns: a read that was
+                # already parked when pause_reading() ran still completes
+                # with its chunk — holding it here (instead of dispatching)
+                # keeps a pause strict, so paused ingestion stops growing
+                # the subscriber queues, not just the socket reads.
+                if not self._read_gate.is_set():
+                    await self._read_gate.wait()
                 # Frames parsed ahead of an oversized header are still
                 # dispatched (the accumulator's Netty-decode-loop contract);
                 # the poisoned stream then closes.
@@ -299,11 +439,20 @@ class TcpTransport(_ListenMixin, Transport):
                 for payload in frames:
                     try:
                         message = self._codec.deserialize(payload)
-                    except Exception:
-                        logger.exception("undecodable frame; closing connection")
+                    except Exception as exc:
+                        # One line, no traceback: a malformed-frame flood
+                        # must cost accounting (decode_failures), not a
+                        # stack trace per frame in the operator's log.
+                        self.decode_failures += 1
+                        logger.warning(
+                            "undecodable frame (%s: %s); closing connection",
+                            type(exc).__name__,
+                            exc,
+                        )
                         return
                     self._dispatch(message)
                 if accum.poisoned():
+                    self.frames_oversized += 1
                     logger.warning(
                         "dropping oversized frame of %d bytes", accum.poisoned()
                     )

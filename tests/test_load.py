@@ -1,0 +1,158 @@
+"""Wire-rate certification (ISSUE 12 acceptance): chaos at the wire.
+
+The load harness (serve/load.py) drives one live serving session with a
+seeded fleet of concurrent loopback-TCP producers — honest and adversarial
+mixed, with mid-stream connection churn — and the session must hold the
+documented contracts exactly:
+
+- conservation: ``pushed == served + pending + shed`` and
+  ``rejected == injected-malformed`` — every event acked into the batcher
+  is served, pending, or explicitly counted; never silently lost;
+- bounded memory: the pending queue NEVER exceeds ``max_pending``
+  (``peak_pending`` is the witness), with the defer policy turning the cap
+  into TCP flow control against producers;
+- zero unhandled exceptions anywhere in the fleet or the session;
+- the session still emits its complete ``kind="serve"`` SLO row.
+
+The headline certifier runs >=32 producers and >=100k events — sized so
+producers genuinely outrun the device (the queue hits the cap and real
+backpressure pauses are taken), not a polite trickle.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS
+from scalecube_cluster_tpu.serve.load import PROFILES, run_load
+
+#: The certification geometry (module docstring). events: 24 honest x 4300
+#: + the oversized profile's per-cycle valid events pushes past 100k.
+CERT = dict(
+    producers=32,
+    adversarial=8,
+    events_per_producer=4300,
+    max_pending=8192,
+    capacity=256,
+    burst=128,
+    churn_every=500,
+    settle_s=0.005,
+    deadline_s=240.0,
+    seed=0,
+)
+
+
+@pytest.mark.asyncio
+async def test_load_certification_32_producers_100k_events(tmp_path):
+    path = tmp_path / "load.jsonl"
+    res = await run_load(export_path=str(path), **CERT)
+    row = res["row"]
+
+    # Zero unhandled exceptions: every producer ran to completion and every
+    # failure mode it provoked became accounting, not a crash.
+    assert res["errors"] == []
+
+    # Scale floor: >=32 mixed producers, >=100k events, churn exercised.
+    assert row["producers"] >= 32 and row["adversarial"] >= 5
+    assert set(row["profiles"]) == set(PROFILES)  # all profiles in the mix
+    assert row["pushed"] >= 100_000
+    assert row["reconnects"] > 0
+
+    # Conservation, exact: acked == served + pending + shed; malformed
+    # events that reached the pump are all counted, nothing else is.
+    assert res["conservation_ok"]
+    assert row["pushed"] == row["served"] + row["pending"] + row["shed"]
+    assert res["rejected_ok"]
+    assert row["rejected"] == row["events_injected_malformed"] > 0
+
+    # Bounded memory: the hard cap held, and it was genuinely tested —
+    # producers outran the device far enough that the defer policy took
+    # real flow-control pauses against the transport.
+    assert res["bounded_ok"]
+    assert row["peak_pending"] <= row["max_pending"]
+    assert row["backpressure_pauses"] >= 1
+    assert row["shed"] == 0  # defer is lossless
+
+    # The session still closed with its complete kind="serve" SLO row.
+    serve = res["serve_row"]
+    assert serve["kind"] == "serve"
+    for key in (
+        "latency_ms_p50",
+        "latency_ms_p95",
+        "latency_ms_p99",
+        "events_per_sec",
+        "ingest_rejected",
+        "ingest_backpressure",
+        "peak_pending",
+    ):
+        assert key in serve, key
+    assert serve["ingest_rejected"] == row["rejected"]
+    assert set(serve["counters"]) == set(SHARED_COUNTERS)
+    assert serve["counters"]["ingest_rejected"] == row["rejected"]
+    assert serve["counters"]["ingest_backpressure"] == row["backpressure_pauses"]
+
+    # Wire-level hostility was absorbed and counted, connection-local.
+    assert row["decode_failures"] > 0
+    assert row["frames_oversized"] > 0
+    assert row["accept_idle_timeouts"] >= 1  # the slow-loris eviction
+
+    # The kind="load" row landed in the export file, schema-versioned.
+    rows = [json.loads(line) for line in path.read_text().splitlines()]
+    kinds = [r["kind"] for r in rows]
+    assert kinds.count("load") == 1 and kinds.count("serve") == 1
+    load_row = next(r for r in rows if r["kind"] == "load")
+    assert load_row["schema"] == 1
+    assert load_row["conservation_ok"] and load_row["bounded_ok"]
+
+
+@pytest.mark.asyncio
+async def test_load_shed_oldest_policy_bounded_latency():
+    """Under ``shed-oldest`` the batcher sheds instead of pausing: the cap
+    still holds, the shed is counted, and conservation stays exact WITH the
+    shed term carrying the loss."""
+    res = await run_load(
+        producers=6,
+        adversarial=2,
+        events_per_producer=400,
+        max_pending=64,
+        capacity=4,          # slow service: the queue must overflow
+        batch_ticks=4,
+        burst=64,
+        overflow_policy="shed-oldest",
+        settle_s=0.01,
+        deadline_s=120.0,
+        seed=1,
+    )
+    row = res["row"]
+    assert res["errors"] == []
+    assert res["conservation_ok"] and res["rejected_ok"] and res["bounded_ok"]
+    assert row["shed"] > 0  # freshness won, explicitly
+    assert row["backpressure_pauses"] == 0  # shed-oldest never pauses
+    assert row["pushed"] == row["served"] + row["pending"] + row["shed"]
+    assert row["peak_pending"] <= row["max_pending"]
+
+
+@pytest.mark.asyncio
+async def test_load_seeded_reproducible_accounting():
+    """Same seed, same fleet -> identical ground-truth injection counts
+    (the wire interleaving may differ; the audit totals may not)."""
+    kw = dict(
+        producers=5,
+        adversarial=2,
+        events_per_producer=60,
+        max_pending=256,
+        deadline_s=60.0,
+        seed=42,
+    )
+    a = await run_load(**kw)
+    b = await run_load(**kw)
+    for res in (a, b):
+        assert res["errors"] == []
+        assert res["conservation_ok"] and res["rejected_ok"] and res["bounded_ok"]
+    assert a["row"]["events_sent_valid"] == b["row"]["events_sent_valid"]
+    assert a["row"]["events_injected_malformed"] == (
+        b["row"]["events_injected_malformed"]
+    )
+    assert a["row"]["pushed"] == b["row"]["pushed"]

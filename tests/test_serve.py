@@ -32,6 +32,7 @@ from scalecube_cluster_tpu.serve import (
     EV_KILL,
     EV_RESTART,
     SERVE_QUALIFIER,
+    BatcherFull,
     EventBatcher,
     ServeBridge,
     ServeEvent,
@@ -324,3 +325,218 @@ async def test_serve_counters_match_host():
     assert serve["counters"]["serve_batches"] == 1
     assert serve["counters"]["ingest_overflow"] == 0
     assert serve["summary"]["kind"] == "serve"
+
+
+# -- queue-depth overflow: bounded batcher + backpressure (ISSUE 12) ---------
+
+
+def test_batcher_defer_policy_refuses_at_cap():
+    """Lossless defer: a full batcher refuses the push — nothing enqueued,
+    nothing counted — and the conservation ledger stays exact."""
+    b = EventBatcher(n=8, g_slots=2, n_ticks=2, capacity=4, max_pending=3)
+    for i in range(3):
+        b.push(ServeEvent(EV_GOSSIP, i, arg=0))
+    assert b.is_full
+    with pytest.raises(BatcherFull):
+        b.push(ServeEvent(EV_GOSSIP, 3, arg=0))
+    assert b.pushed_total == 3 and len(b) == 3 and b.shed_total == 0
+    assert b.peak_pending == 3
+    # A launch drains the queue; pushes are accepted again.
+    _, stats = b.next_batch(0)
+    assert stats["n_events"] == 3 and not b.is_full
+    b.push(ServeEvent(EV_GOSSIP, 3, arg=0))
+    assert b.pushed_total == 4 == stats["n_events"] + len(b) + b.shed_total
+
+
+def test_batcher_shed_oldest_policy():
+    """Bounded-latency shed: at the cap the OLDEST pending event is dropped
+    and counted; freshness wins, explicitly, and conservation still holds."""
+    b = EventBatcher(
+        n=8, g_slots=2, n_ticks=2, capacity=4,
+        max_pending=3, overflow_policy="shed-oldest",
+    )
+    for i in range(5):
+        b.push(ServeEvent(EV_GOSSIP, i, arg=0))
+    assert len(b) == 3 and b.shed_total == 2 and b.pushed_total == 5
+    assert b.peak_pending == 3  # the cap held even while shedding
+    _, stats = b.next_batch(0)
+    # The survivors are the NEWEST three (0 and 1 were shed).
+    assert stats["n_events"] == 3
+    assert b.pushed_total == stats["n_events"] + len(b) + b.shed_total
+
+
+def test_batcher_rejects_bad_config():
+    with pytest.raises(ValueError, match="overflow_policy"):
+        EventBatcher(n=4, g_slots=1, n_ticks=1, capacity=1,
+                     overflow_policy="drop-all")
+    with pytest.raises(ValueError, match="low_watermark"):
+        EventBatcher(n=4, g_slots=1, n_ticks=1, capacity=1,
+                     max_pending=4, low_watermark=4)
+
+
+@pytest.mark.asyncio
+async def test_batcher_wait_room_fires_at_low_watermark():
+    """wait_room parks until a launch drains the queue to the low
+    watermark (hysteresis: resuming at the cap would thrash per event)."""
+    import asyncio
+
+    b = EventBatcher(n=8, g_slots=2, n_ticks=2, capacity=2,
+                     max_pending=4, low_watermark=1)
+    for i in range(4):
+        b.push(ServeEvent(EV_GOSSIP, i % 8, arg=0))
+    waiter = asyncio.create_task(b.wait_room())
+    await asyncio.sleep(0.01)
+    assert not waiter.done()
+    # One launch serves 4 events (2 ticks x capacity 2): drains to 0 <= 1.
+    b.next_batch(0)
+    await asyncio.wait_for(waiter, timeout=1)
+    # Unbounded batcher: wait_room is a no-op.
+    b0 = EventBatcher(n=8, g_slots=2, n_ticks=2, capacity=2)
+    await asyncio.wait_for(b0.wait_room(), timeout=1)
+
+
+@pytest.mark.asyncio
+async def test_live_backpressure_pauses_and_serves_all():
+    """Producers outrunning the device with the defer policy: the pump
+    pauses the transport's reads (TCP flow control) instead of growing the
+    queue past ``max_pending`` — and every event is still served."""
+    import asyncio
+
+    params = _params()
+    bridge = ServeBridge(
+        params,
+        init_sparse_full_view(N, S, seed=2),
+        batch_ticks=2,
+        capacity=2,
+        max_pending=8,
+        low_watermark=2,
+    )
+    total = 48
+    server = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    client = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    try:
+        def done() -> bool:
+            return bridge.batcher.pushed_total >= total and len(bridge.batcher) == 0
+
+        live = asyncio.ensure_future(
+            bridge.run_live(server, settle_s=0.005, stop_when=done)
+        )
+        await asyncio.sleep(0.05)  # pump subscribed before the client writes
+        for i in range(total):
+            await client.send(
+                server.address,
+                Message.create(
+                    qualifier=SERVE_QUALIFIER,
+                    data={"kind": "gossip", "node": i % N, "slot": i % 4},
+                    sender=client.address,
+                ),
+            )
+        await asyncio.wait_for(live, timeout=60)
+    finally:
+        await client.stop()
+        await server.stop()
+    b = bridge.batcher
+    assert b.pushed_total == total
+    assert bridge.events_served == total  # conservation: all served
+    assert b.peak_pending <= b.max_pending  # the hard cap held
+    assert b.backpressure_total >= 1  # pressure was actually exercised
+    assert server.backpressure_pauses >= 1  # ...and reached the transport
+    assert bridge.counters()["ingest_backpressure"] == b.backpressure_total
+
+
+@pytest.mark.asyncio
+async def test_run_live_deadline_pacing_and_termination():
+    """pace_s fires launch i at t0 + i*pace_s (deadline-paced, no drift
+    accumulation), and run_live demands a termination condition."""
+    import asyncio
+    import time as _time
+
+    params = _params()
+    bridge = ServeBridge(
+        params, init_sparse_full_view(N, S, seed=3), batch_ticks=2, capacity=2
+    )
+    server = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    try:
+        with pytest.raises(ValueError, match="n_batches or stop_when"):
+            await bridge.run_live(server)
+        bridge.step_batch()  # pay the compile outside the timed window
+        t0 = _time.monotonic()
+        await bridge.run_live(server, n_batches=4, pace_s=0.05)
+        elapsed = _time.monotonic() - t0
+        # Launches 1..3 each waited for their deadline slot.
+        assert elapsed >= 3 * 0.05 * 0.9
+        assert bridge.serve_batches == 5  # warmup + 4 paced
+    finally:
+        await server.stop()
+
+
+@pytest.mark.asyncio
+async def test_rejected_surfaced_in_rows_and_counters():
+    """Satellite (ISSUE 12): TcpEventSource.rejected reaches the per-launch
+    serve_batch rows, the serve summary, and the counters() schema — an
+    adversarial flood is visible in artifacts, not just a log line."""
+    import asyncio
+
+    params = _params()
+    bridge = ServeBridge(
+        params, init_sparse_full_view(N, S, seed=5), batch_ticks=4, capacity=2
+    )
+    server = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    client = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    bad = [
+        {"kind": "bogus", "node": 1},
+        {"kind": "kill", "node": N + 3},
+        {"kind": "gossip", "node": 0, "slot": 10_000},
+    ]
+    try:
+        def done() -> bool:
+            return (
+                bridge.ingest_rejected >= len(bad)
+                and bridge.batcher.pushed_total >= 1
+                and len(bridge.batcher) == 0
+            )
+
+        live = asyncio.ensure_future(
+            bridge.run_live(server, settle_s=0.01, stop_when=done)
+        )
+        await asyncio.sleep(0.05)
+        for obj in bad + [{"kind": "kill", "node": 2}]:
+            await client.send(
+                server.address,
+                Message.create(
+                    qualifier=SERVE_QUALIFIER, data=obj, sender=client.address
+                ),
+            )
+        await asyncio.wait_for(live, timeout=30)
+    finally:
+        await client.stop()
+        await server.stop()
+    assert bridge.ingest_rejected == len(bad)
+    assert bridge.counters()["ingest_rejected"] == len(bad)
+    summary = bridge.close()
+    assert summary["ingest_rejected"] == len(bad)
+    assert summary["ingest_backpressure"] == 0
+    assert summary["overflow_policy"] == "defer"
+    batch_rows = [r for r in bridge.rows if r["kind"] == "serve_batch"]
+    assert sum(r["ingest_rejected"] for r in batch_rows) == len(bad)
+
+
+def test_summary_row_has_pressure_accounting():
+    """The serve summary carries the full queue-pressure block even for an
+    offline replay session (zeros, but schema-present)."""
+    params = _params()
+    bridge = ServeBridge(
+        params, init_sparse_full_view(N, S, seed=6), batch_ticks=4, capacity=2,
+        max_pending=128, overflow_policy="shed-oldest",
+    )
+    bridge.run_replay([ServeEvent(EV_GOSSIP, 1, arg=0)], 4)
+    row = bridge.close()
+    for key, want in (
+        ("ingest_rejected", 0),
+        ("ingest_backpressure", 0),
+        ("ingest_shed", 0),
+        ("max_pending", 128),
+        ("overflow_policy", "shed-oldest"),
+    ):
+        assert row[key] == want, key
+    assert row["peak_pending"] == 1

@@ -350,6 +350,277 @@ def test_backoff_delay_bounded_with_jitter():
     assert t_off._backoff_delay(10) == 0.0
 
 
+# -- hostile wire input (ISSUE 12: the serving plane faces untrusted peers) --
+
+
+def _valid_frame(t: TcpTransport, data) -> bytes:
+    payload = t._codec.serialize(Message.create(qualifier="serve/event", data=data))
+    return t._encode(payload, t._config.max_frame_length)
+
+
+async def _collect_stream(t: TcpTransport, got: list):
+    async for msg in t.listen():
+        got.append(msg.data)
+
+
+@pytest.mark.asyncio
+async def test_slow_loris_evicted_by_idle_deadline():
+    """A client trickling a frame header then going silent must be evicted
+    by ``accept_idle_timeout_ms`` (and counted), not pin a handler until
+    stop(); honest traffic on a fresh connection still flows after."""
+    b = await TcpTransport.bind(
+        TransportConfig(connect_timeout=1000, accept_idle_timeout_ms=100)
+    )
+    got: list = []
+    task = asyncio.create_task(_collect_stream(b, got))
+    try:
+        reader, writer = await asyncio.open_connection(
+            b.address.host, b.address.port
+        )
+        writer.write(b"\x00\x00")  # half a frame header, then silence
+        await writer.drain()
+        # The server must close us at the idle deadline: EOF on our reader.
+        assert await asyncio.wait_for(reader.read(), timeout=2) == b""
+        assert b.accept_idle_timeouts == 1
+        writer.close()
+        # The listener is unharmed: a fresh honest connection serves.
+        _, w2 = await asyncio.open_connection(b.address.host, b.address.port)
+        w2.write(_valid_frame(b, "after-loris"))
+        await w2.drain()
+        await asyncio.sleep(0.05)
+        assert got == ["after-loris"]
+        w2.close()
+    finally:
+        task.cancel()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_garbage_bytes_poison_only_their_connection():
+    """Pure garbage (no framing at all) must cost the hostile connection,
+    never the listener: the stream is dropped (counted when the bogus
+    length header is over-limit) and valid traffic keeps flowing."""
+    b = await bind()
+    got: list = []
+    task = asyncio.create_task(_collect_stream(b, got))
+    try:
+        _, wbad = await asyncio.open_connection(b.address.host, b.address.port)
+        wbad.write(b"\xff\xff\xff\xff" + bytes(range(64)))  # 4 GiB "frame"
+        await wbad.drain()
+        await asyncio.sleep(0.05)
+        assert b.frames_oversized == 1
+        wbad.close()
+        _, wok = await asyncio.open_connection(b.address.host, b.address.port)
+        wok.write(_valid_frame(b, "still-serving"))
+        await wok.drain()
+        await asyncio.sleep(0.05)
+        assert got == ["still-serving"]
+        wok.close()
+    finally:
+        task.cancel()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_oversized_frame_then_valid_on_fresh_connection():
+    """An over-limit frame poisons ITS stream (frames decoded ahead of the
+    poison are still dispatched — the Netty-decode-loop contract) and the
+    next connection starts clean."""
+    b = await TcpTransport.bind(
+        TransportConfig(connect_timeout=1000, max_frame_length=256)
+    )
+    got: list = []
+    task = asyncio.create_task(_collect_stream(b, got))
+    try:
+        reader, writer = await asyncio.open_connection(
+            b.address.host, b.address.port
+        )
+        # One valid frame, then an oversized header IN THE SAME WRITE: the
+        # valid frame must still be dispatched before the stream dies.
+        writer.write(
+            _valid_frame(b, "before-poison") + (4096).to_bytes(4, "big") + b"\xff" * 8
+        )
+        await writer.drain()
+        assert await asyncio.wait_for(reader.read(), timeout=2) == b""  # closed
+        assert b.frames_oversized == 1
+        writer.close()
+        _, w2 = await asyncio.open_connection(b.address.host, b.address.port)
+        w2.write(_valid_frame(b, "fresh-conn"))
+        await w2.drain()
+        await asyncio.sleep(0.05)
+        assert got == ["before-poison", "fresh-conn"]
+        w2.close()
+    finally:
+        task.cancel()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_undecodable_payload_counted_and_closed():
+    """Well-framed but undecodable bytes: counted (``decode_failures``),
+    the connection dropped, the listener unharmed."""
+    b = await bind()
+    got: list = []
+    task = asyncio.create_task(_collect_stream(b, got))
+    try:
+        reader, writer = await asyncio.open_connection(
+            b.address.host, b.address.port
+        )
+        writer.write(b._encode(b"\x80 not json", b._config.max_frame_length))
+        await writer.drain()
+        assert await asyncio.wait_for(reader.read(), timeout=2) == b""  # closed
+        assert b.decode_failures == 1
+        writer.close()
+        _, w2 = await asyncio.open_connection(b.address.host, b.address.port)
+        w2.write(_valid_frame(b, "ok"))
+        await w2.drain()
+        await asyncio.sleep(0.05)
+        assert got == ["ok"]
+        w2.close()
+    finally:
+        task.cancel()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_connect_churn_during_stop_drain():
+    """Clients dialing (and dropping) connections WHILE stop() drains must
+    neither crash the listener nor stall the drain past its grace."""
+    b = await TcpTransport.bind(
+        TransportConfig(connect_timeout=1000, stop_drain_ms=150)
+    )
+    got: list = []
+    task = asyncio.create_task(_collect_stream(b, got))
+    # Established connections with in-flight frames stop() must drain.
+    writers = []
+    for i in range(3):
+        _, w = await asyncio.open_connection(b.address.host, b.address.port)
+        w.write(_valid_frame(b, i))
+        writers.append(w)
+    await asyncio.sleep(0.05)
+    stop_task = asyncio.create_task(b.stop())
+    # Churn against the closing listener: dial, write, drop, repeat.
+    for _ in range(5):
+        try:
+            _, w = await asyncio.open_connection(b.address.host, b.address.port)
+            w.write(b"\x00")
+            w.close()
+        except OSError:
+            pass  # listener already closed — the expected end state
+        await asyncio.sleep(0.01)
+    await asyncio.wait_for(stop_task, timeout=3)
+    assert sorted(got[:3]) == [0, 1, 2]
+    await asyncio.wait_for(task, timeout=2)  # streams completed
+    for w in writers:
+        w.close()
+
+
+@pytest.mark.asyncio
+async def test_accept_cap_sheds_connections():
+    """Over ``max_accepted_connections`` the accept is closed immediately
+    and counted — bounded handler memory under a connection flood."""
+    b = await TcpTransport.bind(
+        TransportConfig(connect_timeout=1000, max_accepted_connections=2)
+    )
+    got: list = []
+    task = asyncio.create_task(_collect_stream(b, got))
+    writers = []
+    try:
+        for _ in range(2):
+            _, w = await asyncio.open_connection(b.address.host, b.address.port)
+            w.write(_valid_frame(b, "kept"))
+            await w.drain()
+            writers.append(w)
+        await asyncio.sleep(0.05)  # both handlers registered
+        r3, w3 = await asyncio.open_connection(b.address.host, b.address.port)
+        writers.append(w3)
+        assert await asyncio.wait_for(r3.read(), timeout=2) == b""  # shed
+        assert b.accept_shed == 1
+        await asyncio.sleep(0.05)
+        assert got == ["kept", "kept"]  # capped, not broken
+    finally:
+        for w in writers:
+            w.close()
+        task.cancel()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_pause_resume_reading_gates_delivery():
+    """pause_reading() stops frame delivery (the batcher-full backpressure
+    hook); resume_reading() delivers everything buffered meanwhile."""
+    a, b = await bind(), await bind()
+    got: list = []
+    task = asyncio.create_task(_collect_stream(b, got))
+    try:
+        await a.send(
+            b.address, Message.create(qualifier="x", data=0, sender=a.address)
+        )
+        await asyncio.sleep(0.05)
+        assert got == [0]
+        b.pause_reading()
+        b.pause_reading()  # idempotent: one transition counted
+        assert b.backpressure_pauses == 1
+        await a.send(
+            b.address, Message.create(qualifier="x", data=1, sender=a.address)
+        )
+        await asyncio.sleep(0.1)
+        assert got == [0], "paused transport must not deliver"
+        b.resume_reading()
+        await asyncio.sleep(0.1)
+        assert got == [0, 1], "resume must deliver the buffered frame"
+    finally:
+        task.cancel()
+        await a.stop()
+        await b.stop()
+
+
+@pytest.mark.asyncio
+async def test_dial_failure_book_bounded():
+    """Regression (ISSUE 12): ``_dial_failures`` used to grow one entry per
+    dead destination forever. The book is now bounded in size (stalest-first
+    eviction) and age (TTL pruning), and a successful connect clears its
+    entry."""
+    from scalecube_cluster_tpu.transport import tcp as tcp_mod
+
+    a = await bind()
+    try:
+        # Size bound: overfill via the accounting hook (no real dials).
+        for i in range(tcp_mod._DIAL_FAILURES_MAX + 50):
+            a._note_dial_failure(Address("10.255.0.1", 1 + i))
+        assert len(a._dial_failures) <= tcp_mod._DIAL_FAILURES_MAX
+        assert set(a._dial_failures) == set(a._dial_failure_ts)
+        # Age bound: entries stamped before the TTL horizon are pruned by
+        # the next failure note.
+        stale = Address("10.255.0.2", 9)
+        a._dial_failures[stale] = 3
+        a._dial_failure_ts[stale] = -1e9  # long before any TTL horizon
+        a._note_dial_failure(Address("10.255.0.3", 10))
+        assert stale not in a._dial_failures
+        assert stale not in a._dial_failure_ts
+    finally:
+        await a.stop()
+
+
+@pytest.mark.asyncio
+async def test_successful_connect_clears_failure_book_timestamps():
+    """The success path must clear BOTH the count and the timestamp — a
+    count cleared without its timestamp would leak the ts dict instead."""
+    a, b = await bind(), await bind()
+    try:
+        a._dial_failures[b.address] = 2
+        a._dial_failure_ts[b.address] = 0.0
+        a._config = dataclasses.replace(a._config, reconnect_backoff_min_ms=1)
+        await a.send(
+            b.address, Message.create(qualifier="x", data=0, sender=a.address)
+        )
+        assert b.address not in a._dial_failures
+        assert b.address not in a._dial_failure_ts
+    finally:
+        await a.stop()
+        await b.stop()
+
+
 @register_data_type("test/payload")
 @dataclasses.dataclass(frozen=True)
 class _Payload:
