@@ -13,9 +13,13 @@ to replay the exact trajectory. Exit status is the number of violations.
 
 ``--engines rapid`` soaks the Rapid consistent-membership engine
 (sim/rapid.py) under the same schedule matrix, certified against C1-C7 AND
-R1-R4. ``--race`` runs the SWIM-vs-Rapid comparison instead: both engines
-on IDENTICAL seed/schedule matrices as one vmapped ensemble call each
-(testlib/chaos.py::chaos_race), one side-by-side row per seed.
+R1-R5 (``rapid_fb`` adds the classic-Paxos fallback plane and arms the R5
+liveness raises). ``--race`` runs the SWIM-vs-Rapid comparison instead:
+both engines on IDENTICAL seed/schedule matrices as one vmapped ensemble
+call each (testlib/chaos.py::chaos_race), one side-by-side row per seed —
+the Rapid side runs with the fallback attached, so each row also reports
+``rapid_views_parked`` / ``rapid_fallback_commits`` (how often the classic
+rounds had to rescue a split vote).
 
 ``--out FILE`` appends each trial as schema-versioned JSONL (obs/export.py),
 so soak results can be committed/diffed like the experiment grid's.
@@ -36,7 +40,7 @@ def main(argv=None) -> int:
     ap.add_argument(
         "--engines",
         default="dense,sparse",
-        help="comma list from {dense,sparse,rapid}",
+        help="comma list from {dense,sparse,rapid,rapid_fb}",
     )
     ap.add_argument(
         "--race",
@@ -89,7 +93,9 @@ def main(argv=None) -> int:
                 f"digest={r['digest']} | swim[{r['swim_engine']}] "
                 f"susp={r['swim_suspicions']} dead={r['swim_verdicts_dead']} "
                 f"| rapid vc={r['rapid_view_changes']} "
-                f"views={r['rapid_max_view_id']}"
+                f"views={r['rapid_max_view_id']} "
+                f"parked={r['rapid_views_parked']} "
+                f"fb_commits={r['rapid_fallback_commits']}"
             )
             if not r["ok"]:
                 for side in ("swim", "rapid"):

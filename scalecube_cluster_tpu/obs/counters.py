@@ -56,6 +56,19 @@ from __future__ import annotations
 #                      into serve session rows from host accounting;
 #                      per-tick engine metrics emit constant 0 — a batch is
 #                      a launch, not a tick event)
+#   fallback_rounds    classic-Paxos fallback prepare rounds opened by a
+#                      rotating coordinator this tick (Rapid with
+#                      fallback=True, sim/rapid.py; every other engine —
+#                      and Rapid with fallback=False — emits constant 0)
+#   fallback_commits   members committing a view change through the classic
+#                      fallback's decide broadcast rather than the fast-path
+#                      quorum (Rapid fallback only; constant 0 elsewhere)
+#   join_requests      join-handshake request messages sent by joiners to
+#                      their current seed (Rapid fallback only; constant 0
+#                      elsewhere)
+#   join_confirms      join-confirm messages newly latched at a seed — the
+#                      certificate that gates the joiner's stable_add cut
+#                      (Rapid fallback only; constant 0 elsewhere)
 SHARED_COUNTERS: tuple[str, ...] = (
     "pings",
     "ping_reqs",
@@ -77,6 +90,10 @@ SHARED_COUNTERS: tuple[str, ...] = (
     "ingest_rejected",
     "ingest_backpressure",
     "serve_batches",
+    "fallback_rounds",
+    "fallback_commits",
+    "join_requests",
+    "join_confirms",
 )
 
 # Emitted by the sparse engine only — they measure the compact working-set

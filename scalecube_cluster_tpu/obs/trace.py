@@ -35,6 +35,19 @@ TK_VERDICT_ALIVE = 9  # viewer's record became ALIVE (refutation arrival)
 TK_ALARM = 10  # Rapid watermark edge alarm actor=observer subject=subject
 TK_VOTE = 11  # Rapid vote locked           actor=member
 TK_VIEW_COMMIT = 12  # Rapid view commit     actor=member   subject=vote src
+#                      cause=-1 fast path; cause>=0 points at the deciding
+#                      coordinator's TK_FB_ACCEPT (classic fallback commit)
+TK_FB_PREPARE = 13  # Paxos fallback prepare sent  actor=coordinator aux=rank
+#                     cause = the coordinator's own TK_VOTE (the cut)
+TK_FB_ACCEPT = 14  # fallback accept majority      actor=coordinator aux=rank
+#                    cause = the round's TK_FB_PREPARE
+TK_JOIN_EV = 15  # scheduled/host join event       actor=-1 subject=joiner
+TK_JOIN_REQ = 16  # join handshake request         actor=joiner subject=seed
+#                   aux = attempt counter; a chain root
+TK_JOIN_ACK = 17  # seed ack delivered             actor=seed subject=joiner
+#                   cause = the TK_JOIN_REQ it answers; aux = view digest
+TK_JOIN_CONFIRM = 18  # seed latched the confirm   actor=seed subject=joiner
+#                       cause = the TK_JOIN_ACK the joiner echoed
 
 TK_NAMES = {
     TK_KILL: "kill",
@@ -49,6 +62,12 @@ TK_NAMES = {
     TK_ALARM: "alarm",
     TK_VOTE: "vote",
     TK_VIEW_COMMIT: "view_commit",
+    TK_FB_PREPARE: "fb_prepare",
+    TK_FB_ACCEPT: "fb_accept",
+    TK_JOIN_EV: "join",
+    TK_JOIN_REQ: "join_req",
+    TK_JOIN_ACK: "join_ack",
+    TK_JOIN_CONFIRM: "join_confirm",
 }
 
 #: ``aux`` vocabulary of TK_VERDICT_DEAD: where the viewer's DEAD record

@@ -30,7 +30,13 @@ from jax.tree_util import register_dataclass
 
 from scalecube_cluster_tpu.obs.trace import (  # noqa: F401 (re-export)
     TK_ALARM,
+    TK_FB_ACCEPT,
+    TK_FB_PREPARE,
     TK_GOSSIP_EDGE,
+    TK_JOIN_ACK,
+    TK_JOIN_CONFIRM,
+    TK_JOIN_EV,
+    TK_JOIN_REQ,
     TK_KILL,
     TK_PROBE_MISSED,
     TK_PROBE_SENT,

@@ -805,6 +805,12 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         "view_changes": jnp.zeros((), jnp.int32),
         "alarms_raised": jnp.zeros((), jnp.int32),
         "cut_detected": jnp.zeros((), jnp.int32),
+        # Classic-fallback + join-handshake counters (sim/rapid.py
+        # fallback=True): SWIM runs neither plane, constant zero.
+        "fallback_rounds": jnp.zeros((), jnp.int32),
+        "fallback_commits": jnp.zeros((), jnp.int32),
+        "join_requests": jnp.zeros((), jnp.int32),
+        "join_confirms": jnp.zeros((), jnp.int32),
         # The one counter the bucketed exchange OWNS: blocks dropped to
         # capacity this tick (provably 0 at the default capacity).
         "exchange_overflow": summed["exchange_overflow"],

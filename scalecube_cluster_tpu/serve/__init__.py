@@ -17,15 +17,18 @@ application consumes no RNG, so the trajectories cannot diverge.
 """
 
 from scalecube_cluster_tpu.serve.bridge import ServeBridge
-from scalecube_cluster_tpu.serve.engine import run_serve_batch
+from scalecube_cluster_tpu.serve.engine import run_rapid_serve_batch, run_serve_batch
 from scalecube_cluster_tpu.serve.events import (
     EV_GOSSIP,
+    EV_JOIN,
     EV_KILL,
     EV_RESTART,
     EventBatch,
     event_masks,
+    event_masks_rapid,
 )
 from scalecube_cluster_tpu.serve.ingest import (
+    BATCHER_ENGINES,
     OVERFLOW_POLICIES,
     SERVE_QUALIFIER,
     BatcherFull,
@@ -38,7 +41,9 @@ from scalecube_cluster_tpu.serve.ingest import (
 )
 
 __all__ = [
+    "BATCHER_ENGINES",
     "EV_GOSSIP",
+    "EV_JOIN",
     "EV_KILL",
     "EV_RESTART",
     "BatcherFull",
@@ -51,7 +56,9 @@ __all__ = [
     "TcpEventSource",
     "event_from_message",
     "event_masks",
+    "event_masks_rapid",
     "load_trace",
     "parse_trace_line",
     "run_serve_batch",
+    "run_rapid_serve_batch",
 ]

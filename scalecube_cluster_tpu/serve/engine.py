@@ -18,9 +18,15 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from scalecube_cluster_tpu.serve.events import EventBatch, event_masks
+from scalecube_cluster_tpu.serve.events import EventBatch, event_masks, event_masks_rapid
 from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.knobs import Knobs
+from scalecube_cluster_tpu.sim.rapid import (
+    RapidParams,
+    RapidState,
+    apply_events_rapid,
+    rapid_tick,
+)
 from scalecube_cluster_tpu.sim.sparse import SparseParams, SparseState, sparse_tick
 
 
@@ -75,6 +81,61 @@ def run_serve_batch(
             metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
             metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
             metrics["gossip_fired"] = jnp.sum(gossip_m, dtype=jnp.int32)
+            metrics["ingest_overflow"] = deferred
+        return new_state, metrics
+
+    return lax.scan(
+        step, state, (batch.node, batch.kind, batch.arg, batch.deferred)
+    )
+
+
+@partial(jax.jit, static_argnums=(0,), static_argnames=("collect",))
+def run_rapid_serve_batch(
+    params: RapidParams,
+    state: RapidState,
+    plan: FaultPlan,
+    batch: EventBatch,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Rapid flavor of :func:`run_serve_batch`: step the Rapid engine
+    ``batch.n_ticks`` ticks, one batch row per tick.
+
+    The event lanes differ from the SWIM path the way the schedule lanes do
+    (sim/schedule.py::rapid_events_at vs events_at): EV_JOIN replaces the
+    user-gossip plane — a join cell arms the member's seed-routed join
+    handshake (sim/rapid.py §4) via :func:`apply_events_rapid`'s
+    ``join_mask``, so live ``join`` traffic gets real protocol admission
+    semantics instead of the SWIM restart alias. ``joins_fired`` replaces
+    ``gossip_fired`` in the trace extras accordingly.
+
+    The input state is NOT donated (unlike run_serve_batch): rapid serve
+    sessions are replay/parity surfaces first (tests/test_rapid_fallback.py
+    re-runs the same state object against the scheduled twin), so keeping
+    the argument alive is worth the extra buffer.
+    """
+    n = params.n
+    dirty = (
+        jnp.any(plan.block)
+        | jnp.any(plan.loss > 0)
+        | jnp.any(plan.mean_delay > 0)
+    )
+
+    def step(carry, xs):
+        node, kind, _arg, deferred = xs
+        kill_m, restart_m, join_m = event_masks_rapid(node, kind, n)
+        carry = apply_events_rapid(
+            params, carry, kill_m, restart_m, join_mask=join_m
+        )
+        new_state, metrics = rapid_tick(
+            params, carry, plan, collect=collect, knobs=knobs
+        )
+        if collect:
+            metrics = dict(metrics)
+            metrics["plan_dirty"] = dirty
+            metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
+            metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+            metrics["joins_fired"] = jnp.sum(join_m, dtype=jnp.int32)
             metrics["ingest_overflow"] = deferred
         return new_state, metrics
 

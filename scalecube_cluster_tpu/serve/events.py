@@ -26,12 +26,15 @@ import jax.numpy as jnp
 import numpy as np
 from jax.tree_util import register_dataclass
 
-from scalecube_cluster_tpu.sim.schedule import EV_KILL, EV_RESTART
+from scalecube_cluster_tpu.sim.schedule import EV_JOIN, EV_KILL, EV_RESTART
 
 #: Serve-level event kind beyond the schedule's kill/restart: enqueue user
 #: gossip payload ``arg`` at ``node`` (the in-scan twin of
 #: sim/sparse.py::inject_gossip_sparse, applied via the 3-tuple events path
 #: of sparse_tick). Schedules have no gossip events, so the id lives here.
+#: (EV_JOIN = 3 lives in sim/schedule.py — a schedule kind consumed by the
+#: join-aware Rapid engine — and shares this numeric kind space; 2 stays
+#: reserved for gossip on both sides.)
 EV_GOSSIP = 2
 
 
@@ -104,3 +107,27 @@ def event_masks(
         fire & (kind == EV_GOSSIP)
     )
     return kill, restart, gossip
+
+
+def event_masks_rapid(
+    node: jax.Array,
+    kind: jax.Array,
+    n: int,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Resolve one batch row for the join-aware Rapid engine:
+    ``(kill [N], restart [N], join [N])``.
+
+    Same fire-guarded scatters as :func:`event_masks`, with the EV_JOIN
+    lane of sim/schedule.py::rapid_events_at instead of the gossip plane
+    (Rapid sessions carry no user gossip) — so a batch cell matching a
+    schedule's ``(t, node, EV_JOIN)`` event yields the same mask values and
+    a bit-identical trajectory (the replay-parity leg with join events,
+    tests/test_serve.py).
+    """
+    fire = node >= 0
+    safe = jnp.clip(node, 0, n - 1)
+    zeros = jnp.zeros((n,), bool)
+    kill = zeros.at[safe].max(fire & (kind == EV_KILL))
+    restart = zeros.at[safe].max(fire & (kind == EV_RESTART))
+    join = zeros.at[safe].max(fire & (kind == EV_JOIN))
+    return kill, restart, join

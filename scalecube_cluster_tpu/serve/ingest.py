@@ -8,12 +8,16 @@ skipped)::
     {"kind": "gossip", "node": 3, "slot": 1}
 
 - ``kind`` — one of ``kill``, ``leave``, ``restart``, ``join``, ``gossip``.
-  ``leave`` aliases to a kill and ``join`` to a restart: joins re-enter the
-  cluster through the kill/restart pipeline (a join is a fresh identity at a
-  bumped epoch — exactly what an in-scan restart applies; ROADMAP.md), and a
-  crash-stop is how the serving plane models an abrupt leave. The aliases
-  keep the wire vocabulary operator-shaped while the device side stays the
-  two-kind schedule contract plus gossip.
+  ``leave`` aliases to a kill (a crash-stop is how the serving plane models
+  an abrupt leave). ``join`` parses to the protocol-level EV_JOIN kind
+  (sim/schedule.py): for RAPID sessions (``EventBatcher(engine="rapid")``)
+  it fires the real seed-routed join handshake — request → seed ack with a
+  view digest → confirm certificate counted in the next view change
+  (sim/rapid.py §4) — giving live ``join`` traffic real admission
+  semantics. SWIM sessions (the default engine) have no join protocol, so
+  the batcher normalizes EV_JOIN to EV_RESTART at push — the historical
+  alias (a join is a fresh identity at a bumped epoch, exactly what an
+  in-scan restart applies), byte-for-byte compatible with pre-join traces.
 - ``node`` — member index in ``[0, n)``.
 - ``tick`` — optional GLOBAL tick (1-based, the schedule convention) the
   event should fire at; omitted means "as soon as possible" (the earliest
@@ -64,6 +68,7 @@ from dataclasses import dataclass
 
 from scalecube_cluster_tpu.serve.events import (
     EV_GOSSIP,
+    EV_JOIN,
     EV_KILL,
     EV_RESTART,
     EventBatch,
@@ -77,15 +82,20 @@ logger = logging.getLogger(__name__)
 #: multicasts everything; the source filters on this).
 SERVE_QUALIFIER = "serve/event"
 
-#: Wire vocabulary -> device event kind (module docstring: join/leave alias
-#: into the kill/restart pipeline).
+#: Wire vocabulary -> device event kind. ``leave`` aliases to a kill;
+#: ``join`` is the protocol-level EV_JOIN — routed to the Rapid join
+#: handshake by rapid sessions, normalized to the restart alias at push by
+#: SWIM sessions (module docstring).
 KIND_ALIASES = {
     "kill": EV_KILL,
     "leave": EV_KILL,
     "restart": EV_RESTART,
-    "join": EV_RESTART,
+    "join": EV_JOIN,
     "gossip": EV_GOSSIP,
 }
+
+#: Engine flavors a batcher can feed (the serve session's protocol plane).
+BATCHER_ENGINES = ("swim", "rapid")
 
 
 @dataclass
@@ -184,6 +194,13 @@ class EventBatcher:
     drops the oldest pending event and counts it. ``low_watermark`` is the
     drain level at which a paused producer resumes (hysteresis — resuming
     at the cap itself would thrash pause/resume per event).
+
+    ``engine`` names the session's protocol plane: ``"swim"`` (default)
+    normalizes EV_JOIN to the restart alias at push and accepts gossip;
+    ``"rapid"`` keeps EV_JOIN intact (the real join handshake consumes it)
+    and REJECTS gossip events (Rapid carries no user-gossip plane — a
+    gossip cell would be silently inert in the tick, so it is refused at
+    validation like any other out-of-contract payload).
     """
 
     def __init__(
@@ -196,6 +213,7 @@ class EventBatcher:
         max_pending: int = 0,
         low_watermark: int | None = None,
         overflow_policy: str = "defer",
+        engine: str = "swim",
     ):
         if n_ticks < 1 or capacity < 1:
             raise ValueError("need n_ticks >= 1 and capacity >= 1")
@@ -203,6 +221,10 @@ class EventBatcher:
             raise ValueError(
                 f"unknown overflow_policy {overflow_policy!r}; "
                 f"valid: {OVERFLOW_POLICIES}"
+            )
+        if engine not in BATCHER_ENGINES:
+            raise ValueError(
+                f"unknown engine {engine!r}; valid: {BATCHER_ENGINES}"
             )
         self.n = int(n)
         self.g_slots = int(g_slots)
@@ -218,6 +240,7 @@ class EventBatcher:
                 f"[0, max_pending={self.max_pending})"
             )
         self.overflow_policy = overflow_policy
+        self.engine = engine
         self._pending: deque[ServeEvent] = deque()
         #: Session totals (host accounting; the bridge stamps them into rows).
         self.pushed_total = 0
@@ -254,7 +277,12 @@ class EventBatcher:
             raise ValueError(
                 f"gossip slot {ev.arg} outside [0, {self.g_slots})"
             )
-        if ev.kind not in (EV_KILL, EV_RESTART, EV_GOSSIP):
+        if ev.kind == EV_GOSSIP and self.engine == "rapid":
+            # Rapid carries no user-gossip plane — a gossip cell would be
+            # silently inert in rapid_tick, so refuse it like any other
+            # out-of-contract payload instead of eating queue room.
+            raise ValueError("gossip events are not valid on a rapid session")
+        if ev.kind not in (EV_KILL, EV_RESTART, EV_GOSSIP, EV_JOIN):
             raise ValueError(f"unknown event kind {ev.kind}")
 
     def push(self, ev: ServeEvent, stamp: bool = True) -> None:
@@ -270,6 +298,11 @@ class EventBatcher:
         this one.
         """
         self.validate(ev)
+        if self.engine == "swim" and ev.kind == EV_JOIN:
+            # Historical alias: SWIM has no join protocol, so a join lands as
+            # the restart event it always was — pre-join replay traces stay
+            # byte-compatible (tests/test_serve.py::test_trace_format_parsing).
+            ev.kind = EV_RESTART
         if self.is_full:
             if self.overflow_policy == "shed-oldest":
                 self._pending.popleft()

@@ -31,34 +31,70 @@ three device-friendly ingredients, each of which maps onto one array op:
    view size delivered BIT-IDENTICAL votes (threshold agreement over whole
    proposals — Rapid's fast path, no leader, no host round trip).
    Vote-once + same-config counting + a >1/2 threshold make two different
-   batches committing for one view id structurally impossible (R1/R3);
-   there is no classic-Paxos fallback, so a vote split inside one
-   configuration parks the view until membership events (restart, join
-   re-admission) clear it — consistency over liveness, Rapid's tradeoff.
+   batches committing for one view id structurally impossible (R1/R3).
    Committing bumps the member's ``view_id`` and applies the batch
    (removes + joins) atomically.
+4. **classic-consensus fallback (``fallback=True``)** — a split fast-path
+   vote no longer parks the view. A member whose locked vote sits
+   uncommitted for ``fallback_delay_ticks`` ARMS a rank-ordered
+   single-decree Paxos round: global ticks partition into 3-tick rounds
+   (``t % 3`` = prepare/promise, accept/accepted, decide), the round's
+   rank is ``t // 3 + 1``, and the coordinator rotates
+   splitmix-style per ``(view_id, rank)`` so every armed member
+   eventually gets a turn. All three phases are computed every tick as
+   fixed-shape [N, N] exchanges gated by phase masks — the same
+   slot-machinery shape discipline as the alarm broadcast, so the
+   compiled graph is tick-invariant. Safety composes with the fast
+   path: granting a promise FREEZES vote locking (``newly_voting``
+   requires ``promised == 0``), promise replies report the member's
+   locked vote as a rank-0 acceptance, and the coordinator picks the
+   highest-rank accepted value — falling back to the strict plurality
+   among reported rank-0 votes, which any fast-committable value must
+   win inside every classic majority (fast quorum ``ceil(3/4·vs)`` ∩
+   majority > vs/4). So the classic round can only decide a value the
+   fast path could still commit, and every detected cut COMMITS —
+   never parks (the R5 liveness bound,
+   testlib/invariants.py::r5_bound).
 
 Laggards and restarted processes catch up through a view-sync broadcast
 (every ``sync_period_ticks``): a member adopts the highest ``view_id``
-configuration it receives that still contains itself. Restarted processes
-are re-admitted symmetrically: observers count consecutive SUCCESSFUL
-probes of a non-member and raise join alarms through the same
-watermark/tally/quorum pipeline.
+configuration it receives that still contains itself. Re-admission is the
+join pipeline: observers count consecutive SUCCESSFUL probes of a
+non-member and raise join alarms through the same watermark/tally/quorum
+machinery. Under ``fallback=True`` the join is the paper's actual
+protocol: a joiner (scheduled ``EV_JOIN``, a restarted process, or a
+member that discovers a higher view excluding itself) runs a seed-routed
+handshake — join-request → seed-ack carrying the seed's view digest →
+join-confirm latched at the seed and gossiped as a certificate — and the
+``stable_add`` cut only arms for subjects whose certificate the receiver
+holds, so admission is handshake-gated, not merely probe-observed. Under
+``fallback=False`` joins stay restart-aliased (the PR-6 behavior,
+bit-identical).
 
 The engine is a drop-in sibling of ``sim_tick``/``sparse_tick``: it runs
 behind the same :class:`~scalecube_cluster_tpu.sim.faults.FaultPlan` /
 :class:`~scalecube_cluster_tpu.sim.schedule.FaultSchedule` timelines, the
 same :class:`~scalecube_cluster_tpu.sim.knobs.Knobs` threading
-(``suspicion_mult`` scales the L watermark; ``fanout_cap`` has no Rapid
-analog — there is no push-gossip fan-out — and is ignored), and the same
-``SHARED_COUNTERS`` trace schema (obs/counters.py), so the ensemble engine,
-the population statistics and the chaos harness work unchanged. Counters
-with no Rapid event (``ping_reqs``, ``suspicions_raised``,
-``gossip_infections``, ``inc_max``) are emitted as constant zeros, exactly
-like the SWIM engines zero-emit ``view_changes``/``alarms_raised``/
-``cut_detected``. Consistency-plane traces (``view_id``/``view_digest``/
-``view_size``/``alive_mask``, all ``[N]`` per tick) feed the R1–R4
+(``suspicion_mult`` scales the L watermark; ``fanout_cap`` caps the alarm
+fan-out — only observer slots ``j < fanout_cap`` raise/broadcast alarms,
+identity at ``cap >= k``, and a cap below H starves cut detection by
+construction), and the same ``SHARED_COUNTERS`` trace schema
+(obs/counters.py), so the ensemble engine, the population statistics and
+the chaos harness work unchanged. Counters with no Rapid event
+(``ping_reqs``, ``suspicions_raised``, ``gossip_infections``, ``inc_max``)
+are emitted as constant zeros, exactly like the SWIM engines zero-emit
+``view_changes``/``alarms_raised``/``cut_detected``; the fallback plane
+adds ``fallback_rounds``/``fallback_commits``/``join_requests``/
+``join_confirms`` (constant zero when ``fallback=False`` and in every
+other engine). Consistency-plane traces (``view_id``/``view_digest``/
+``view_size``/``alive_mask``, all ``[N]`` per tick) feed the R1–R5
 certifier (testlib/invariants.py::certify_rapid_traces).
+
+``fallback=False`` is structure-gated the same way as the tracer: the
+``fb`` field is ``None`` (an empty pytree node), every fallback branch is
+a Python-level ``if``, and the RNG split count is untouched — so the
+pytree, the compiled tick and every trajectory stay bit-identical to the
+pre-fallback engine (pinned against tests/golden/rapid_pr6_state.json).
 
 Scale note: alarm/proposal/sync broadcasts are O(N²·k) and O(N²) per tick —
 this engine is a consistency instrument for the chaos-race scales (tens to
@@ -83,12 +119,20 @@ from scalecube_cluster_tpu.sim.faults import FaultPlan, _edge_lookup, link_pass
 from scalecube_cluster_tpu.sim.knobs import _SUSP_MAX, Knobs
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
+    plan_at,
+    rapid_events_at,
     resolve_tick,
     plan_dirty_at,
 )
 from scalecube_cluster_tpu.sim.tick import _acct_add, _acct_zero, _link_acct
 from scalecube_cluster_tpu.obs.tracer import (
     TK_ALARM,
+    TK_FB_ACCEPT,
+    TK_FB_PREPARE,
+    TK_JOIN_ACK,
+    TK_JOIN_CONFIRM,
+    TK_JOIN_EV,
+    TK_JOIN_REQ,
     TK_KILL,
     TK_RESTART,
     TK_VIEW_COMMIT,
@@ -133,6 +177,10 @@ class RapidParams:
     #: commit for one view id (R3).
     quorum_num: int = 3
     quorum_den: int = 4
+    #: Ticks a locked vote may sit uncommitted before its holder ARMS the
+    #: classic-Paxos fallback round (``fallback=True`` states only; the
+    #: field is inert when the state carries no FallbackState).
+    fallback_delay_ticks: int = 6
 
     def __post_init__(self):
         if not 1 <= self.k < self.n:
@@ -152,6 +200,91 @@ class RapidParams:
             )
         if self.fd_period_ticks < 1 or self.sync_period_ticks < 1:
             raise ValueError("periods must be >= 1 tick")
+        if self.fallback_delay_ticks < 1:
+            raise ValueError("fallback_delay_ticks must be >= 1")
+
+
+@register_dataclass
+@dataclass
+class FallbackState:
+    """Classic-consensus fallback + join-handshake plane of one Rapid
+    cluster — present only on ``fallback=True`` states (the structure gate:
+    ``None`` keeps the pre-fallback pytree and compiled tick).
+
+    Paxos half (single-decree per configuration, rank = ``t // 3 + 1``):
+    acceptors track the highest ``promised`` rank and their latest
+    acceptance (``acc_rank``/``acc_rm``/``acc_add``; a locked fast-path
+    vote doubles as the rank-0 acceptance); coordinators stage their picked
+    proposal (``prop_*``/``prop_ready``) between the promise and accept
+    phases and their decide flag (``decided``) between accept and decide.
+    ``wait`` counts ticks a locked vote has sat uncommitted — the re-arm
+    counter that gates coordination on ``wait >= fallback_delay_ticks``.
+
+    Join half: a per-member handshake state machine (``join_phase`` 0 =
+    idle, 1 = requesting, 2 = confirming, 3 = certified, awaiting
+    admission) against a rotating ``join_seed`` (``join_tries`` failures
+    rotate the candidate), plus the certificate matrix ``join_ok[m, j]`` —
+    m holds proof that j completed a handshake with some seed. Seeds latch
+    and re-broadcast their certificate rows every tick; receivers OR-merge,
+    and ``stable_add`` only arms for certified subjects. Certificates for
+    current members are consumed (cleared) so a re-removed subject must
+    re-handshake.
+    """
+
+    wait: jax.Array  # [N] int32 ticks this member's vote sat uncommitted
+    promised: jax.Array  # [N] int32 highest promised rank (0 = none)
+    acc_rank: jax.Array  # [N] int32 rank of latest acceptance (-1 = none)
+    acc_rm: jax.Array  # [N, N] bool accepted removal batch
+    acc_add: jax.Array  # [N, N] bool accepted addition batch
+    prop_rm: jax.Array  # [N, N] bool coordinator's staged proposal
+    prop_add: jax.Array  # [N, N] bool
+    prop_ready: jax.Array  # [N] bool prepare majority reached (phase 0->1)
+    decided: jax.Array  # [N] bool accept majority reached (phase 1->2)
+    join_phase: jax.Array  # [N] int32 handshake state machine
+    join_seed: jax.Array  # [N] int32 current seed candidate
+    join_tries: jax.Array  # [N] int32 failed handshake attempts
+    join_digest: jax.Array  # [N] int32 view digest from the seed's ack
+    join_ok: jax.Array  # [N, N] bool certificate: m knows j handshook
+
+    def replace(self, **changes) -> "FallbackState":
+        return dataclasses.replace(self, **changes)
+
+
+def init_fallback_state(n: int) -> FallbackState:
+    """Quiescent fallback plane: nothing armed, nothing promised, every
+    joiner idle with its ring successor as the first seed candidate."""
+    col = jnp.arange(n, dtype=jnp.int32)
+    zeros_n = jnp.zeros((n,), jnp.int32)
+    false_nn = jnp.zeros((n, n), bool)
+    return FallbackState(
+        wait=zeros_n,
+        promised=zeros_n,
+        acc_rank=jnp.full((n,), -1, jnp.int32),
+        acc_rm=false_nn,
+        acc_add=false_nn,
+        prop_rm=false_nn,
+        prop_add=false_nn,
+        prop_ready=jnp.zeros((n,), bool),
+        decided=jnp.zeros((n,), bool),
+        join_phase=zeros_n,
+        join_seed=(col + 1) % n,
+        join_tries=zeros_n,
+        join_digest=zeros_n,
+        join_ok=false_nn,
+    )
+
+
+def _mix32(x: jax.Array) -> jax.Array:
+    """Splitmix-style uint32 avalanche (coordinator rotation seed): members
+    of one configuration derive the same pseudo-random base from their
+    shared ``view_id``, so the per-rank rotation is deterministic and
+    config-local without any extra agreement."""
+    x = x.astype(jnp.uint32)
+    x = x * jnp.uint32(0x9E3779B9)
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    return x
 
 
 @register_dataclass
@@ -184,6 +317,10 @@ class RapidState:
     #: events. None (the default, and the only pre-recorder checkpoint
     #: form) keeps the pytree and the compiled graph bit-identical.
     trace: TraceRing | None = None
+    #: Classic-Paxos fallback + join-handshake plane. None (the default)
+    #: is the structure gate: the pytree, the compiled tick and every
+    #: trajectory stay bit-identical to the pre-fallback engine.
+    fb: FallbackState | None = None
 
     def replace(self, **changes) -> "RapidState":
         return dataclasses.replace(self, **changes)
@@ -235,14 +372,19 @@ def rapid_low_watermark(params: RapidParams, knobs: Knobs | None):
 
 
 def init_rapid_full_view(
-    params: RapidParams, seed: int = 0, trace_capacity: int = 0
+    params: RapidParams,
+    seed: int = 0,
+    trace_capacity: int = 0,
+    fallback: bool = False,
 ) -> RapidState:
     """Post-bootstrap steady state: every member holds configuration 0 =
     the full membership (the Rapid seed view), no alarms pending.
 
     ``trace_capacity > 0`` attaches the causal flight recorder's event ring
     (obs/tracer.py); 0 keeps the state pytree identical to pre-recorder
-    builds."""
+    builds. ``fallback=True`` attaches the classic-Paxos fallback + join
+    handshake plane (:class:`FallbackState`); False keeps the pre-fallback
+    pytree and compiled tick bit-identical."""
     n = params.n
     return RapidState(
         member_mask=jnp.ones((n, n), bool),
@@ -257,6 +399,7 @@ def init_rapid_full_view(
         tick=jnp.zeros((), jnp.int32),
         rng=jax.random.PRNGKey(seed),
         trace=init_trace_ring(n, trace_capacity) if trace_capacity else None,
+        fb=init_fallback_state(n) if fallback else None,
     )
 
 
@@ -265,36 +408,83 @@ def apply_events_rapid(
     state: RapidState,
     kill_mask: jax.Array,
     restart_mask: jax.Array,
+    join_mask: jax.Array | None = None,
 ) -> RapidState:
-    """In-scan scripted kill/restart, the Rapid twin of
+    """In-scan scripted kill/restart/join, the Rapid twin of
     sim/schedule.py::apply_events_dense (same top-of-tick convention, no RNG
     consumed). A restart is a fresh identity: epoch bump, view reset to the
     bootstrap configuration 0 (it catches up through view sync), and every
-    per-edge counter it owns — or that is about it — cleared."""
+    per-edge counter it owns — or that is about it — cleared.
+
+    ``join_mask`` (join-aware callers only; ``None`` keeps the legacy graph
+    bit-identical) mints a fresh identity like a restart but with view =
+    {self}: the joiner has no bootstrap membership and must re-enter
+    through the handshake + join-alarm pipeline. On ``fallback=True``
+    states, restarts and joins both arm the handshake (``join_phase = 1``)
+    and every certificate about a killed/minted identity is invalidated."""
     n = params.n
-    any_ev = jnp.any(kill_mask | restart_mask)
+    if join_mask is None:
+        any_ev = jnp.any(kill_mask | restart_mask)
+    else:
+        any_ev = jnp.any(kill_mask | restart_mask | join_mask)
 
     def apply(st: RapidState) -> RapidState:
         obs = observer_matrix(n, params.k)
+        fresh = (
+            restart_mask if join_mask is None else restart_mask | join_mask
+        )
         new_epoch = jnp.where(
-            restart_mask,
+            fresh,
             jnp.minimum(st.epoch + 1, merge_ops.EPOCH_MAX),
             st.epoch,
         )
-        row = restart_mask[:, None]
-        mm = jnp.where(row, True, st.member_mask)
-        reset_edges = restart_mask[obs] | restart_mask[:, None]
+        row = fresh[:, None]
+        if join_mask is None:
+            mm = jnp.where(row, True, st.member_mask)
+        else:
+            # Restarts keep the bootstrap full view; protocol joins start
+            # as a singleton {self} and re-enter through the handshake.
+            mm = jnp.where(restart_mask[:, None], True, st.member_mask)
+            mm = jnp.where(join_mask[:, None], jnp.eye(n, dtype=bool), mm)
+        reset_edges = fresh[obs] | fresh[:, None]
         st = st.replace(
-            alive=(st.alive & ~kill_mask) | restart_mask,
+            alive=(st.alive & ~kill_mask) | fresh,
             epoch=new_epoch,
             member_mask=mm | jnp.eye(n, dtype=bool),
-            view_id=jnp.where(restart_mask, 0, st.view_id),
+            view_id=jnp.where(fresh, 0, st.view_id),
             edge_fail=jnp.where(reset_edges, 0, st.edge_fail),
             edge_join=jnp.where(reset_edges, 0, st.edge_join),
             vote_rm=jnp.where(row, False, st.vote_rm),
             vote_add=jnp.where(row, False, st.vote_add),
-            voted=st.voted & ~restart_mask,
+            voted=st.voted & ~fresh,
         )
+        if st.fb is not None:
+            fb = st.fb
+            touched = kill_mask | fresh
+            first_seed = (jnp.arange(n, dtype=jnp.int32) + 1) % n
+            st = st.replace(
+                fb=fb.replace(
+                    wait=jnp.where(fresh, 0, fb.wait),
+                    promised=jnp.where(fresh, 0, fb.promised),
+                    acc_rank=jnp.where(fresh, -1, fb.acc_rank),
+                    acc_rm=jnp.where(row, False, fb.acc_rm),
+                    acc_add=jnp.where(row, False, fb.acc_add),
+                    prop_rm=jnp.where(row, False, fb.prop_rm),
+                    prop_add=jnp.where(row, False, fb.prop_add),
+                    prop_ready=fb.prop_ready & ~fresh,
+                    decided=fb.decided & ~fresh,
+                    # A fresh identity must re-handshake; a killed one idles.
+                    join_phase=jnp.where(
+                        fresh, 1, jnp.where(kill_mask, 0, fb.join_phase)
+                    ),
+                    join_seed=jnp.where(fresh, first_seed, fb.join_seed),
+                    join_tries=jnp.where(fresh, 0, fb.join_tries),
+                    join_digest=jnp.where(fresh, 0, fb.join_digest),
+                    # Certificates ABOUT a touched identity are void — the
+                    # new (or dead) process never completed this handshake.
+                    join_ok=jnp.where(touched[None, :], False, fb.join_ok),
+                )
+            )
         if st.trace is not None:
             # Control-plane events land before anything this tick's round
             # emits, so their ring positions precede the alarms they cause.
@@ -306,7 +496,11 @@ def apply_events_rapid(
             ring, _ = trace_emit(
                 ring, TK_RESTART, restart_mask, t_ev, -1, col_ev
             )
-            st = st.replace(trace=trace_reset_members(ring, restart_mask))
+            if join_mask is not None:
+                ring, _ = trace_emit(
+                    ring, TK_JOIN_EV, join_mask, t_ev, -1, col_ev
+                )
+            st = st.replace(trace=trace_reset_members(ring, fresh))
         return st
 
     return lax.cond(any_ev, apply, lambda s: s, state)
@@ -323,12 +517,25 @@ def rapid_tick(
     watermark cut detection → proposal broadcast → fast-path quorum commit →
     view sync. Pure function of (state, plan); all messaging rides
     ``link_pass`` with the four-way conservation accounting the certifier
-    replays (attempts == delivered + blocked + lost)."""
+    replays (attempts == delivered + blocked + lost).
+
+    With ``state.fb`` attached, the classic fallback + join handshake run
+    interleaved as fixed-shape per-tick exchanges (module docstring §4);
+    without it every fallback branch is skipped at the Python level — same
+    RNG split, same graph, bit-identical trajectory."""
     n, k = params.n, params.k
     t = state.tick + 1
-    rng_next, k_probe, k_ack, k_alarm, k_prop, k_sync = jax.random.split(
-        state.rng, 6
-    )
+    fb = state.fb
+    if fb is None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+        rng_next, k_probe, k_ack, k_alarm, k_prop, k_sync = jax.random.split(
+            state.rng, 6
+        )
+    else:
+        (
+            rng_next, k_probe, k_ack, k_alarm, k_prop, k_sync,
+            k_prep_s, k_prep_r, k_acc_s, k_acc_r, k_dec,
+            k_jreq, k_jack, k_jcon, k_jcack, k_jbc,
+        ) = jax.random.split(state.rng, 16)
     obs = observer_matrix(n, k)  # [N, k] observer of (subject, slot)
     subj = jnp.arange(n, dtype=jnp.int32)[:, None]  # [N, 1] subject index
     col = jnp.arange(n, dtype=jnp.int32)
@@ -336,6 +543,14 @@ def rapid_tick(
     alive = state.alive
     mm = state.member_mask
     low = rapid_low_watermark(params, knobs)
+    # Configuration identity, hoisted ahead of the vote machinery because
+    # the fallback phases and the join ack both consume it (pure functions
+    # of the carried state — values identical to the legacy placement).
+    dg = view_digest(mm)
+    same_cfg = (state.view_id[:, None] == state.view_id[None, :]) & (
+        dg[:, None] == dg[None, :]
+    )
+    view_size = jnp.sum(mm, axis=1, dtype=jnp.int32)
 
     # ---- 1. k-ring probe round (fd cadence) ------------------------------
     fd_tick = (t % params.fd_period_ticks) == 0
@@ -370,9 +585,172 @@ def rapid_tick(
     )
     alarmed = in_view & alive[obs] & (edge_fail >= low)
     join_alarm = ~in_view & alive[obs] & (edge_join >= low)
+    if knobs is not None:  # tpulint: disable=R1 -- trace-time structure gate (knobs is None or a Knobs pytree), not a traced value
+        # Knobs.fanout_cap, Rapid semantics: cap the per-subject ALARM
+        # FAN-OUT — only the first ``cap`` observer slots raise/broadcast
+        # alarms (the edge counters keep monitoring; the cap limits who
+        # talks). ``cap >= k`` is the identity; a cap below H deliberately
+        # starves cut detection (at most ``cap`` alarming observers can
+        # ever tally, so the H watermark is unreachable) — the operator
+        # dial trading detection liveness for broadcast volume, documented
+        # in README's knob table and pinned by tests/test_rapid_fallback.py.
+        slot_ok = jnp.arange(k, dtype=jnp.int32)[None, :] < knobs.fanout_cap
+        alarmed = alarmed & slot_ok
+        join_alarm = join_alarm & slot_ok
     alarms_raised = jnp.sum(
         alarmed & (state.edge_fail < low), dtype=jnp.int32
     ) + jnp.sum(join_alarm & (state.edge_join < low), dtype=jnp.int32)
+
+    src_p = col[None, :]
+    dst_p = col[:, None]
+    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+        # ---- join handshake: request -> ack -> confirm -> confirm-ack ----
+        # Per-member single-target legs over [N] shapes; every leg rides
+        # link_pass with the same conservation accounting as the probes.
+        seed = jnp.clip(fb.join_seed, 0, n - 1)
+        ph1 = (fb.join_phase == 1) & alive
+        ph2 = (fb.join_phase == 2) & alive
+        req_blk = _edge_lookup(plan.block, col, seed)
+        req_pass = link_pass(k_jreq, plan, col, seed)
+        acct = _acct_add(acct, _link_acct(ph1, req_blk, req_pass))
+        req_ok = ph1 & req_pass & alive[seed]
+        ack_blk = _edge_lookup(plan.block, seed, col)
+        ack_pass = link_pass(k_jack, plan, seed, col)
+        acct = _acct_add(acct, _link_acct(req_ok, ack_blk, ack_pass))
+        ack_ok = req_ok & ack_pass  # joiner is alive by ph1
+        con_blk = _edge_lookup(plan.block, col, seed)
+        con_pass = link_pass(k_jcon, plan, col, seed)
+        acct = _acct_add(acct, _link_acct(ph2, con_blk, con_pass))
+        con_ok = ph2 & con_pass & alive[seed]
+        cack_blk = _edge_lookup(plan.block, seed, col)
+        cack_pass = link_pass(k_jcack, plan, seed, col)
+        acct = _acct_add(acct, _link_acct(con_ok, cack_blk, cack_pass))
+        cack_ok = con_ok & cack_pass
+        # Seed-side certificate latch; join_confirms counts first latches.
+        latched_prev = fb.join_ok[seed, col]
+        new_latch = con_ok & ~latched_prev
+        join_ok_l = fb.join_ok.at[seed, col].max(con_ok)
+        # Any failed leg rotates the seed candidate (never the joiner
+        # itself) and re-enters the request phase — the bounded retry.
+        fail1 = ph1 & ~ack_ok
+        fail2 = ph2 & ~cack_ok
+        tries_j = jnp.where(fail1 | fail2, fb.join_tries + 1, fb.join_tries)
+        next_seed = (col + 1 + tries_j % (n - 1)) % n
+        join_seed_j = jnp.where(fail1 | fail2, next_seed, seed)
+        join_phase_j = jnp.where(
+            ack_ok, 2, jnp.where(cack_ok, 3, jnp.where(fail2, 1, fb.join_phase))
+        )
+        join_digest_j = jnp.where(ack_ok, dg[seed], fb.join_digest)
+        # Certificate gossip: every holder re-broadcasts its rows each tick
+        # (latched, like alarms — one lost broadcast never loses a cert).
+        has_cert = jnp.any(join_ok_l, axis=1) & alive
+        send_jb = has_cert[None, :] & (dst_p != src_p)
+        blk_jb = _edge_lookup(plan.block, src_p, dst_p)
+        pass_jb = link_pass(k_jbc, plan, src_p, dst_p)
+        acct = _acct_add(acct, _link_acct(send_jb, blk_jb, pass_jb))
+        got_jb = ((send_jb & pass_jb) | (has_cert[None, :] & eye)) & alive[
+            :, None
+        ]
+        join_ok_now = join_ok_l | (
+            (got_jb.astype(jnp.int32) @ join_ok_l.astype(jnp.int32)) > 0
+        )
+        join_requests = jnp.sum(ph1, dtype=jnp.int32)
+        join_confirms = jnp.sum(new_latch, dtype=jnp.int32)
+
+        # ---- classic fallback, phase 0 (prepare/promise) -----------------
+        # Global ticks partition into 3-tick rounds: t%3 = 0 prepare, 1
+        # accept, 2 decide; rank = t//3 + 1 is shared by all three phases
+        # of a round and strictly increases round over round. The
+        # coordinator rotates splitmix-style over (view_id, rank) so each
+        # config nominates exactly one coordinator per rank and every armed
+        # member gets a turn within n ranks.
+        is_p0 = (t % 3) == 0
+        is_p1 = (t % 3) == 1
+        is_p2 = (t % 3) == 2
+        rank = (t // 3 + 1).astype(jnp.int32)
+        armed = (
+            alive & state.voted & (fb.wait >= params.fallback_delay_ticks)
+        )
+        cand = (
+            (_mix32(state.view_id) + rank.astype(jnp.uint32))
+            % jnp.uint32(n)
+        ).astype(jnp.int32)
+        is_coord = armed & (cand == col)
+        coord_now = is_p0 & is_coord
+        send_prep = coord_now[None, :] & (dst_p != src_p)
+        blk_pp = _edge_lookup(plan.block, src_p, dst_p)
+        pass_pp = link_pass(k_prep_s, plan, src_p, dst_p)
+        acct = _acct_add(acct, _link_acct(send_prep, blk_pp, pass_pp))
+        heard_prep = (send_prep & pass_pp) | (coord_now[None, :] & eye)
+        # Acceptors only honor THEIR configuration's coordinator for this
+        # rank — cross-config prepares are noise.
+        heard_prep = (
+            heard_prep & alive[:, None] & same_cfg & (cand[:, None] == src_p)
+        )
+        grant = jnp.any(heard_prep, axis=1) & (rank > fb.promised)
+        promised_p0 = jnp.where(grant, rank, fb.promised)
+        # Promise replies (acceptor -> coordinator) carry the acceptor's
+        # latest acceptance; a locked fast-path vote IS the rank-0 accept.
+        send_rep = grant[None, :] & heard_prep.T & (dst_p != src_p)
+        blk_rp = _edge_lookup(plan.block, src_p, dst_p)
+        pass_rp = link_pass(k_prep_r, plan, src_p, dst_p)
+        acct = _acct_add(acct, _link_acct(send_rep, blk_rp, pass_rp))
+        prom = (send_rep & pass_rp) | (grant[None, :] & heard_prep.T & eye)
+        prom = prom & alive[:, None]  # [coordinator, acceptor]
+        maj = view_size // 2 + 1
+        n_prom = jnp.sum(prom, axis=1, dtype=jnp.int32)
+        got_maj = coord_now & (n_prom >= maj)
+        # Value pick: highest-rank classic acceptance wins; else the strict
+        # plurality among reported rank-0 (fast-path) votes — the rule that
+        # keeps the classic round inside the fast path's safe value set
+        # (module docstring §4).
+        eff_rank = jnp.where(
+            fb.acc_rank >= 1, fb.acc_rank, jnp.where(state.voted, 0, -1)
+        )
+        rank_b = jnp.where(prom, eff_rank[None, :], -2)
+        best_rank = jnp.max(rank_b, axis=1)
+        cls_score = jnp.where(
+            prom
+            & (eff_rank[None, :] == best_rank[:, None])
+            & (best_rank[:, None] >= 1),
+            n - 1 - col[None, :],
+            -1,
+        )
+        a_cls = jnp.argmax(cls_score, axis=1)
+        same_v = jnp.all(
+            state.vote_rm[:, None, :] == state.vote_rm[None, :, :], axis=-1
+        ) & jnp.all(
+            state.vote_add[:, None, :] == state.vote_add[None, :, :], axis=-1
+        )
+        p0set = prom & (eff_rank[None, :] == 0)
+        support = p0set.astype(jnp.int32) @ same_v.astype(jnp.int32)
+        z_score = jnp.where(
+            p0set, support * (n + 1) + (n - 1 - col[None, :]), -1
+        )
+        a_fast = jnp.argmax(z_score, axis=1)
+        a_star = jnp.where(best_rank >= 1, a_cls, a_fast)
+        eff_rm = jnp.where(
+            (fb.acc_rank >= 1)[:, None], fb.acc_rm, state.vote_rm
+        )
+        eff_add = jnp.where(
+            (fb.acc_rank >= 1)[:, None], fb.acc_add, state.vote_add
+        )
+        prop_rm_new = jnp.where(
+            coord_now[:, None], eff_rm[a_star], fb.prop_rm
+        )
+        prop_add_new = jnp.where(
+            coord_now[:, None], eff_add[a_star], fb.prop_add
+        )
+        fallback_rounds = jnp.sum(coord_now, dtype=jnp.int32)
+        fb_msgs = (
+            jnp.sum(send_prep, dtype=jnp.int32)
+            + jnp.sum(send_rep, dtype=jnp.int32)
+            + jnp.sum(send_jb, dtype=jnp.int32)
+            + jnp.sum(ph1, dtype=jnp.int32)
+            + jnp.sum(req_ok, dtype=jnp.int32)
+            + jnp.sum(ph2, dtype=jnp.int32)
+            + jnp.sum(con_ok, dtype=jnp.int32)
+        )
 
     # ---- 2. alarm broadcast ---------------------------------------------
     # Observer obs[s, j] tells EVERYONE about its alarmed edge each tick it
@@ -404,6 +782,11 @@ def rapid_tick(
     h = params.high_watermark
     stable_rm = (tally_rm >= h) & mm
     stable_add = (tally_add >= h) & ~mm
+    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+        # Protocol-level joins: a non-member only enters a stable add-cut
+        # once SOME member holds its join certificate (the confirm latch,
+        # gossiped above). Probe reachability alone no longer admits.
+        stable_add = stable_add & join_ok_now
     unstable = ((tally_rm >= 1) & (tally_rm < h) & mm) | (
         (tally_add >= 1) & (tally_add < h) & ~mm
     )
@@ -418,6 +801,14 @@ def rapid_tick(
         & jnp.any(stable_rm | stable_add, axis=1)
         & ~jnp.any(unstable, axis=1)
     )
+    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+        # Vote freeze (safety): a member that has granted a classic promise
+        # — this tick's phase-0 grants included — must not lock a NEW
+        # fast-path vote; its promise reported "no rank-0 accept", and a
+        # same-tick lock would falsify that report. Promise and lock are
+        # therefore never simultaneous, which is what keeps the
+        # coordinator's plurality value-pick inside the safe set (§4).
+        newly_voting = newly_voting & (promised_p0 == 0)
     vote_rm = jnp.where(newly_voting[:, None], stable_rm, state.vote_rm)
     vote_add = jnp.where(newly_voting[:, None], stable_add, state.vote_add)
     voted = state.voted | newly_voting
@@ -430,12 +821,6 @@ def rapid_tick(
     # receiver's — a vote is meaningless against a different base view).
     # Whole-batch identity (not per-subject voting) is what makes committed
     # views bit-equal across members — the R1 agreement property.
-    dg = view_digest(mm)
-    same_cfg = (state.view_id[:, None] == state.view_id[None, :]) & (
-        dg[:, None] == dg[None, :]
-    )
-    src_p = col[None, :]
-    dst_p = col[:, None]
     send_p = proposing[None, :] & (dst_p != src_p)
     blk_p = _edge_lookup(plan.block, src_p, dst_p)
     pass_p = link_pass(k_prop, plan, src_p, dst_p)
@@ -447,7 +832,6 @@ def rapid_tick(
     )
     same = same & proposing[:, None] & proposing[None, :]  # [m2, m] identical
     cnt = recv_p.astype(jnp.int32) @ same.astype(jnp.int32)  # [recv, m]
-    view_size = jnp.sum(mm, axis=1, dtype=jnp.int32)
     thr = (
         params.quorum_num * view_size + params.quorum_den - 1
     ) // params.quorum_den
@@ -463,6 +847,80 @@ def rapid_tick(
     commit = alive & jnp.any(valid, axis=1) & ~batch_rm[col, col]
     batch_rm = batch_rm & commit[:, None]
     batch_add = batch_add & commit[:, None]
+    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+        # ---- classic fallback, phase 1 (accept/accepted) -----------------
+        # The coordinator that banked a promise majority broadcasts its
+        # picked value; acceptors take it unless they have since promised a
+        # higher rank. Accepted replies tally at the coordinator toward the
+        # classic majority.
+        acc_now = is_p1 & fb.prop_ready & alive
+        send_acc = acc_now[None, :] & (dst_p != src_p)
+        blk_ac = _edge_lookup(plan.block, src_p, dst_p)
+        pass_ac = link_pass(k_acc_s, plan, src_p, dst_p)
+        acct = _acct_add(acct, _link_acct(send_acc, blk_ac, pass_ac))
+        heard_acc = (send_acc & pass_ac) | (acc_now[None, :] & eye)
+        heard_acc = (
+            heard_acc & alive[:, None] & same_cfg & (cand[:, None] == src_p)
+        )
+        acc_ok = jnp.any(heard_acc, axis=1) & (rank >= promised_p0)
+        a_src = jnp.argmax(heard_acc, axis=1)
+        promised_p1 = jnp.where(
+            acc_ok, jnp.maximum(promised_p0, rank), promised_p0
+        )
+        acc_rank_new = jnp.where(acc_ok, rank, fb.acc_rank)
+        acc_rm_new = jnp.where(
+            acc_ok[:, None], prop_rm_new[a_src], fb.acc_rm
+        )
+        acc_add_new = jnp.where(
+            acc_ok[:, None], prop_add_new[a_src], fb.acc_add
+        )
+        send_ar = acc_ok[None, :] & heard_acc.T & (dst_p != src_p)
+        blk_ar = _edge_lookup(plan.block, src_p, dst_p)
+        pass_ar = link_pass(k_acc_r, plan, src_p, dst_p)
+        acct = _acct_add(acct, _link_acct(send_ar, blk_ar, pass_ar))
+        acc_votes = (send_ar & pass_ar) | (
+            acc_ok[None, :] & heard_acc.T & eye
+        )
+        acc_votes = acc_votes & alive[:, None]
+        decided_now = (
+            acc_now
+            & (jnp.sum(acc_votes, axis=1, dtype=jnp.int32) >= maj)
+        )
+        decided_next = jnp.where(
+            is_p1, decided_now, jnp.where(is_p2, False, fb.decided)
+        )
+        prop_ready_next = jnp.where(
+            is_p0, got_maj, jnp.where(is_p2, False, fb.prop_ready)
+        )
+
+        # ---- classic fallback, phase 2 (decide) + commit merge -----------
+        # A decided coordinator broadcasts the decree; every same-config
+        # member that hears it commits the chosen batch — unless the fast
+        # path already committed this tick (fast wins; identical safety by
+        # quorum intersection, §4) or the batch evicts the member itself.
+        dec_now = is_p2 & fb.decided & alive
+        send_dec = dec_now[None, :] & (dst_p != src_p)
+        blk_dc = _edge_lookup(plan.block, src_p, dst_p)
+        pass_dc = link_pass(k_dec, plan, src_p, dst_p)
+        acct = _acct_add(acct, _link_acct(send_dec, blk_dc, pass_dc))
+        heard_dec = (send_dec & pass_dc) | (dec_now[None, :] & eye)
+        heard_dec = (
+            heard_dec & alive[:, None] & same_cfg & (cand[:, None] == src_p)
+        )
+        fb_commit_raw = jnp.any(heard_dec, axis=1)
+        d_src = jnp.argmax(heard_dec, axis=1)
+        evicts_self = prop_rm_new[d_src, col]
+        fb_commit = fb_commit_raw & ~evicts_self & ~commit
+        commit = commit | fb_commit
+        batch_rm = batch_rm | (prop_rm_new[d_src] & fb_commit[:, None])
+        batch_add = batch_add | (prop_add_new[d_src] & fb_commit[:, None])
+        fallback_commits = jnp.sum(fb_commit, dtype=jnp.int32)
+        fb_msgs = (
+            fb_msgs
+            + jnp.sum(send_acc, dtype=jnp.int32)
+            + jnp.sum(send_ar, dtype=jnp.int32)
+            + jnp.sum(send_dec, dtype=jnp.int32)
+        )
     view_changes = jnp.sum(commit, dtype=jnp.int32)
     verdicts_dead = jnp.sum(batch_rm, dtype=jnp.int32)
     verdicts_alive = jnp.sum(batch_add, dtype=jnp.int32)
@@ -478,6 +936,8 @@ def rapid_tick(
     msgs_sync = jnp.sum(send_p, dtype=jnp.int32) + jnp.sum(
         send_s, dtype=jnp.int32
     )
+    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+        msgs_sync = msgs_sync + fb_msgs
     avail = (send_s & pass_s) | eye
     sync_score = jnp.where(
         avail & alive[None, :], vid2[None, :] * (n + 1) + (n - 1 - col[None, :]), -1
@@ -488,6 +948,17 @@ def rapid_tick(
     adopt = alive & (vid2[best] > vid2) & includes_self
     mm3 = jnp.where(adopt[:, None], cand_mask, mm2) | eye
     vid3 = jnp.where(adopt, vid2[best], vid2)
+    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+        # A live member that sees a HIGHER configuration excluding itself
+        # was evicted behind its back (e.g. a healed partition). It cannot
+        # adopt that view; the road back is the join handshake — start one
+        # toward the best sync sender unless a handshake is already open.
+        excluded = alive & (vid2[best] > vid2) & ~includes_self
+        josh_open = join_phase_j != 0
+        trigger = excluded & ~josh_open
+        join_phase_j = jnp.where(trigger, 1, join_phase_j)
+        join_seed_j = jnp.where(trigger, best, join_seed_j)
+        tries_j = jnp.where(trigger, 0, tries_j)
 
     # ---- causal flight recorder (structure-gated, obs/tracer.py) ---------
     # Alarm → vote → commit, in ring order: the protocol's own causal
@@ -510,7 +981,7 @@ def rapid_tick(
             jnp.broadcast_to(subj, (n, k)),
             aux=jnp.where(join_alarm, 1, 0),
         )
-        ring, _ = trace_emit(
+        ring, vote_pos = trace_emit(
             ring,
             TK_VOTE,
             newly_voting,
@@ -519,20 +990,128 @@ def rapid_tick(
             col,
             aux=jnp.sum(vote_rm, axis=1, dtype=jnp.int32),  # cut size locked
         )
-        ring, _ = trace_emit(
-            ring,
-            TK_VIEW_COMMIT,
-            commit,
-            t,
-            col,
-            winner.astype(jnp.int32),  # the vote source the commit adopted
-            aux=vid2,
-        )
+        if fb is None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+            ring, _ = trace_emit(
+                ring,
+                TK_VIEW_COMMIT,
+                commit,
+                t,
+                col,
+                winner.astype(jnp.int32),  # the vote source the commit adopted
+                aux=vid2,
+            )
+        else:
+            # Fallback causal chain rides the ring's registers (all writes
+            # fb-gated so tracer-on fallback-off runs stay bit-identical to
+            # the pinned PR-6 golden): origin[m] holds m's latest TK_VOTE
+            # position (or, for joiners, the TK_JOIN_ACK they echo),
+            # last_miss[c] threads a coordinator's prepare → accept → the
+            # commit's cause.
+            ring = ring.replace(
+                origin=jnp.where(newly_voting, vote_pos, ring.origin)
+            )
+            ring, prep_pos = trace_emit(
+                ring,
+                TK_FB_PREPARE,
+                coord_now,
+                t,
+                col,
+                col,
+                cause=ring.origin,  # the coordinator's own locked vote
+                aux=rank,
+            )
+            ring = ring.replace(
+                last_miss=jnp.where(coord_now, prep_pos, ring.last_miss)
+            )
+            ring, accp_pos = trace_emit(
+                ring,
+                TK_FB_ACCEPT,
+                decided_now,
+                t,
+                col,
+                col,
+                cause=ring.last_miss,  # this round's prepare
+                aux=rank,
+            )
+            ring = ring.replace(
+                last_miss=jnp.where(decided_now, accp_pos, ring.last_miss)
+            )
+            ring, _ = trace_emit(
+                ring,
+                TK_VIEW_COMMIT,
+                commit,
+                t,
+                col,
+                jnp.where(fb_commit, d_src.astype(jnp.int32),
+                          winner.astype(jnp.int32)),
+                cause=jnp.where(fb_commit, ring.last_miss[d_src], -1),
+                aux=vid2,
+            )
+            ring, req_pos = trace_emit(
+                ring,
+                TK_JOIN_REQ,
+                ph1,
+                t,
+                col,
+                seed,
+                aux=fb.join_tries,  # attempt counter; chain root
+            )
+            ring, ack_pos = trace_emit(
+                ring,
+                TK_JOIN_ACK,
+                ack_ok,
+                t,
+                seed,
+                col,
+                cause=req_pos,  # the request it answers (same tick)
+                aux=jnp.where(ack_ok, dg[seed], 0),
+            )
+            ring = ring.replace(
+                origin=jnp.where(ack_ok, ack_pos, ring.origin)
+            )
+            ring, _ = trace_emit(
+                ring,
+                TK_JOIN_CONFIRM,
+                new_latch,
+                t,
+                seed,
+                col,
+                cause=ring.origin,  # the ack the joiner echoed (earlier tick)
+            )
 
     # Every view change (commit or adoption) starts a fresh configuration:
     # the old locked vote is void and the member may vote once again.
     view_changed = commit | adopt
+    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+        # A view change clears every per-configuration Paxos register (the
+        # wait clock, promises, acceptances, proposals) — the new config
+        # starts a fresh single-decree instance. Join state survives unless
+        # the member's own view changed (admission/adoption closes the
+        # handshake); certificates for now-admitted members are consumed so
+        # a later re-eviction forces a fresh handshake.
+        wait_next = jnp.where(
+            alive & voted & ~view_changed, fb.wait + 1, 0
+        )
+        fb_next = FallbackState(
+            wait=wait_next,
+            promised=jnp.where(view_changed, 0, promised_p1),
+            acc_rank=jnp.where(view_changed, -1, acc_rank_new),
+            acc_rm=jnp.where(view_changed[:, None], False, acc_rm_new),
+            acc_add=jnp.where(view_changed[:, None], False, acc_add_new),
+            prop_rm=jnp.where(view_changed[:, None], False, prop_rm_new),
+            prop_add=jnp.where(view_changed[:, None], False, prop_add_new),
+            prop_ready=prop_ready_next & ~view_changed,
+            decided=decided_next & ~view_changed,
+            join_phase=jnp.where(view_changed, 0, join_phase_j),
+            join_seed=join_seed_j,
+            join_tries=jnp.where(view_changed, 0, tries_j),
+            join_digest=join_digest_j,
+            join_ok=join_ok_now & ~mm3,
+        )
+    else:
+        fb_next = None
     new_state = state.replace(
+        fb=fb_next,
         member_mask=mm3,
         view_id=vid3,
         edge_fail=edge_fail,
@@ -583,6 +1162,13 @@ def rapid_tick(
         "ingest_rejected": zero,
         "ingest_backpressure": zero,
         "serve_batches": zero,
+        # Classic-fallback + join-protocol counters: live values only with
+        # the fallback attached; constant 0 otherwise (and in every other
+        # engine — the SHARED_COUNTERS contract).
+        "fallback_rounds": fallback_rounds if fb is not None else zero,
+        "fallback_commits": fallback_commits if fb is not None else zero,
+        "join_requests": join_requests if fb is not None else zero,
+        "join_confirms": join_confirms if fb is not None else zero,
         # Monotonicity gauges (inc_max has no Rapid analog: constant 0).
         "inc_max": zero,
         "epoch_max": jnp.max(state.epoch),
@@ -612,10 +1198,22 @@ def scan_rapid_ticks(
     scheduled = isinstance(plan, FaultSchedule)
 
     def step(carry: RapidState, _):
+        join_m = None
         if scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
             t = carry.tick + 1  # the global tick about to execute
-            plan_t, (kill_m, restart_m) = resolve_tick(plan, t, params.n)
-            carry = apply_events_rapid(params, carry, kill_m, restart_m)
+            if carry.fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+                # Join-aware resolution: same plan, plus the EV_JOIN lane.
+                # The fb-None path keeps the exact legacy resolve_tick call
+                # (bit-identical graph, pinned by the PR-6 golden).
+                plan_t = plan_at(plan, t)
+                kill_m, restart_m, join_m = rapid_events_at(
+                    plan, t, params.n
+                )
+            else:
+                plan_t, (kill_m, restart_m) = resolve_tick(plan, t, params.n)
+            carry = apply_events_rapid(
+                params, carry, kill_m, restart_m, join_mask=join_m
+            )
         else:
             plan_t = plan
         new_state, metrics = rapid_tick(
@@ -626,6 +1224,8 @@ def scan_rapid_ticks(
             metrics["plan_dirty"] = plan_dirty_at(plan, t)
             metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
             metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+            if join_m is not None:  # tpulint: disable=R1 -- trace-time structure gate (follows carry.fb), not a traced value
+                metrics["joins_fired"] = jnp.sum(join_m, dtype=jnp.int32)
         return new_state, metrics
 
     return lax.scan(step, state, None, length=n_ticks)
@@ -652,13 +1252,14 @@ def run_rapid_ticks(
 
 
 def init_ensemble_rapid(
-    params: RapidParams, init_seeds
+    params: RapidParams, init_seeds, fallback: bool = False
 ) -> RapidState:
     """Stacked :func:`init_rapid_full_view` states, one per RNG seed."""
     from scalecube_cluster_tpu.sim.ensemble import stack_universes
 
     return stack_universes(
-        init_rapid_full_view(params, seed=int(s)) for s in init_seeds
+        init_rapid_full_view(params, seed=int(s), fallback=fallback)
+        for s in init_seeds
     )
 
 

@@ -58,6 +58,10 @@ from scalecube_cluster_tpu.sim.state import AGE_STALE, SimState
 #: Event kinds for ``FaultSchedule.ev_kind``.
 EV_KILL = 0
 EV_RESTART = 1
+#: Protocol-level join (Rapid engines with the fallback/join machinery;
+#: see sim/rapid.py). Value 3, not 2: the serve layer shares this numeric
+#: kind space in its batch tensors and serve/events.py::EV_GOSSIP owns 2.
+EV_JOIN = 3
 
 
 @register_dataclass
@@ -157,6 +161,22 @@ def events_at(
     kill = zeros.at[node].max(fire & (schedule.ev_kind == EV_KILL))
     restart = zeros.at[node].max(fire & (schedule.ev_kind == EV_RESTART))
     return kill, restart
+
+
+def rapid_events_at(
+    schedule: FaultSchedule, t: jax.Array, n: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """``(kill_mask, restart_mask, join_mask)`` for the join-aware Rapid
+    engine. Identical to :func:`events_at` plus the EV_JOIN lane; engines
+    without a join protocol resolve through :func:`events_at`, which simply
+    never fires kind-3 slots."""
+    fire = schedule.ev_tick == t
+    node = jnp.clip(schedule.ev_node, 0, n - 1)
+    zeros = jnp.zeros((n,), bool)
+    kill = zeros.at[node].max(fire & (schedule.ev_kind == EV_KILL))
+    restart = zeros.at[node].max(fire & (schedule.ev_kind == EV_RESTART))
+    join = zeros.at[node].max(fire & (schedule.ev_kind == EV_JOIN))
+    return kill, restart, join
 
 
 def scheduled_kill_ticks(schedule: FaultSchedule) -> dict[int, list[int]]:
@@ -308,6 +328,19 @@ class ScheduleBuilder:
         self._events.append((int(tick), int(node), EV_RESTART))
         return self
 
+    def join(self, tick: int, node: int) -> "ScheduleBuilder":
+        """Cold-start ``node`` as a joining singleton at ``tick``: alive at a
+        bumped epoch, view = {self}, and — on Rapid engines with
+        ``fallback=True`` — the seed-routed join handshake armed. Models a
+        process that must *re-enter through the join protocol* rather than a
+        restart that keeps the bootstrap view. Engines without a join
+        protocol (SWIM, Rapid with ``fallback=False``) resolve events through
+        :func:`events_at` and silently skip kind-3 slots; schedule joins only
+        against the join-aware Rapid path. Joins spend the same EPOCH_MAX
+        budget as restarts."""
+        self._events.append((int(tick), int(node), EV_JOIN))
+        return self
+
     def build(self, *, epoch0: np.ndarray | int = 0) -> FaultSchedule:
         """Validate and freeze. ``epoch0`` (scalar or [n]) is the starting
         epoch of the state the schedule will run against, used to enforce the
@@ -365,12 +398,17 @@ class ScheduleBuilder:
                 raise ValueError(f"event node {node} outside [0, {self.n})")
             kinds = by_tick_node.setdefault((tick, node), set())
             if kind in kinds:
+                kind_name = {EV_KILL: "kill", EV_RESTART: "restart"}.get(
+                    kind, "join"
+                )
                 raise ValueError(
-                    f"node {node} has duplicate {'restart' if kind else 'kill'}"
+                    f"node {node} has duplicate {kind_name}"
                     f" events at tick {tick}"
                 )
             kinds.add(kind)
-            if kind == EV_RESTART:
+            if kind in (EV_RESTART, EV_JOIN):
+                # Joins mint a fresh identity exactly like restarts, so they
+                # draw on the same EPOCH_MAX budget.
                 restarts_per_node[node] = restarts_per_node.get(node, 0) + 1
         # A kill and a restart on the same (tick, node) is a legal bounce
         # with PINNED semantics: every apply_events_* computes

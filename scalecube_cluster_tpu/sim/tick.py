@@ -838,6 +838,12 @@ def sim_tick(
         "view_changes": jnp.zeros((), jnp.int32),
         "alarms_raised": jnp.zeros((), jnp.int32),
         "cut_detected": jnp.zeros((), jnp.int32),
+        # Classic-fallback + join-handshake counters (sim/rapid.py
+        # fallback=True): SWIM runs neither plane, constant zero.
+        "fallback_rounds": jnp.zeros((), jnp.int32),
+        "fallback_commits": jnp.zeros((), jnp.int32),
+        "join_requests": jnp.zeros((), jnp.int32),
+        "join_confirms": jnp.zeros((), jnp.int32),
         # Bucketed-exchange counter (explicit-SPMD engine, parallel/spmd.py):
         # no fixed-capacity buckets in the dense tick, constant zero.
         "exchange_overflow": jnp.zeros((), jnp.int32),

@@ -76,9 +76,12 @@ DISTURB_END_MAX = DISTURB_START_HI + DISTURB_LEN_HI
 
 ENGINES = ("dense", "sparse")
 #: All engines chaos understands — the SWIM pair plus the Rapid
-#: consistent-membership engine (sim/rapid.py). Rapid trials run the SAME
-#: sampled schedules and are certified against C1-C7 AND R1-R4.
-ALL_ENGINES = ("dense", "sparse", "rapid")
+#: consistent-membership engine (sim/rapid.py) in both trims: ``rapid`` is
+#: the bare fast path, ``rapid_fb`` attaches the classic-Paxos fallback +
+#: join protocol (fallback=True) and is additionally certified against the
+#: R5 liveness oracle (every detected cut must commit). All Rapid trials
+#: run the SAME sampled schedules and are certified against C1-C7 AND R1-R4.
+ALL_ENGINES = ("dense", "sparse", "rapid", "rapid_fb")
 #: Scenario-variant names, indexed by the draw in :func:`sample_schedule`.
 VARIANTS = ("loss", "partition", "flap")
 
@@ -219,9 +222,9 @@ def run_scheduled(
         # memory headroom.
         state, traces = run_sparse_ticks_nodonate(sp, state, schedule, n_ticks)
         return state, traces, sparse_convergence(state)
-    if engine == "rapid":
+    if engine in ("rapid", "rapid_fb"):
         rp = rapid_chaos_params(n)
-        state = init_rapid_full_view(rp, seed=seed)
+        state = init_rapid_full_view(rp, seed=seed, fallback=engine == "rapid_fb")
         state, traces = run_rapid_ticks(rp, state, schedule, n_ticks)
         conv = float(jax.device_get(traces["convergence"][-1]))
         return state, traces, conv
@@ -256,11 +259,15 @@ def chaos_trial(seed: int, n: int, engine: str) -> dict:
     try:
         _, traces, conv = run_scheduled(engine, params, schedule, ticks)
         summary = certify_traces(params, traces)
-        if engine == "rapid":
-            # The consistency plane gets its own oracle on top of C1-C7.
+        if engine in ("rapid", "rapid_fb"):
+            # The consistency plane gets its own oracle on top of C1-C7;
+            # the fallback trim additionally arms the R5 liveness raises.
             summary = {
                 **summary,
-                **certify_rapid_traces(rapid_chaos_params(n), traces),
+                **certify_rapid_traces(
+                    rapid_chaos_params(n), traces,
+                    fallback=engine == "rapid_fb",
+                ),
             }
         certify_heal(params, summary, conv)
     except InvariantViolation as e:
@@ -312,13 +319,22 @@ def chaos_ensemble(seeds, n: int, engine: str) -> list[dict]:
         pull["conv"] = ensemble_sparse_convergence(states)
         host = jax.device_get(pull)
         conv = np.asarray(host.pop("conv"))
-    elif engine == "rapid":
+    elif engine in ("rapid", "rapid_fb"):
         rp = rapid_chaos_params(n)
-        states = init_ensemble_rapid(rp, [0] * b_count)
+        states = init_ensemble_rapid(
+            rp, [0] * b_count, fallback=engine == "rapid_fb"
+        )
         _, traces = run_ensemble_rapid_ticks(rp, states, plans, ticks)
         keys = dict.fromkeys(
             (*REQUIRED_KEYS, *RAPID_REQUIRED_KEYS, "convergence")
         )
+        if engine == "rapid_fb":
+            # The fallback trim's extra gauges feed the R5 oracle and the
+            # race table's parked/committed columns.
+            keys.update(dict.fromkeys(
+                ("joins_fired", "fallback_rounds", "fallback_commits",
+                 "join_requests", "join_confirms")
+            ))
         host = jax.device_get({k: traces[k] for k in keys})
         conv = np.asarray(host.pop("convergence"))[:, -1]
     else:
@@ -327,11 +343,13 @@ def chaos_ensemble(seeds, n: int, engine: str) -> list[dict]:
         )
 
     cert = certify_population(params, host, final_convergence=conv)
-    if engine == "rapid":
-        # Merge the R1-R4 verdicts: a universe passes only if BOTH oracles
+    if engine in ("rapid", "rapid_fb"):
+        # Merge the R1-R5 verdicts: a universe passes only if BOTH oracles
         # pass; a SWIM-side violation (more fundamental accounting) wins
         # the report when both fire.
-        rcert = certify_rapid_population(rapid_chaos_params(n), host)
+        rcert = certify_rapid_population(
+            rapid_chaos_params(n), host, fallback=engine == "rapid_fb"
+        )
         for b in range(b_count):
             if cert["ok"][b] and not rcert["ok"][b]:
                 cert["ok"][b] = False
@@ -384,10 +402,16 @@ def chaos_race(seeds, n: int, swim_engine: str = "sparse") -> list[dict]:
     ``alarms_raised``), plus the drawn scenario variant. On flap scenarios
     Rapid's L-watermark must yield ZERO flap-induced view changes (R4) —
     any view change in a Rapid row comes from the scripted kill/restart
-    pairs, never from the square-wave link."""
+    pairs, never from the square-wave link.
+
+    The Rapid side runs the ``rapid_fb`` trim (classic fallback attached),
+    so each row also carries the liveness columns the fallback contract
+    pins: ``rapid_views_parked`` (R5's count — must be 0 for an ok row)
+    and ``rapid_fallback_commits`` (view changes that needed the classic
+    path rather than the fast quorum)."""
     seeds = [int(s) for s in seeds]
     swim = chaos_ensemble(seeds, n, swim_engine)
-    rapid = chaos_ensemble(seeds, n, "rapid")
+    rapid = chaos_ensemble(seeds, n, "rapid_fb")
     rows = []
     for s_row, r_row, seed in zip(swim, rapid, seeds):
         assert s_row["digest"] == r_row["digest"], "race rows must pair"
@@ -410,6 +434,8 @@ def chaos_race(seeds, n: int, swim_engine: str = "sparse") -> list[dict]:
                 "rapid_alarms_raised": r_row.get("alarms_raised"),
                 "rapid_max_view_id": r_row.get("max_view_id"),
                 "rapid_convergence": r_row.get("final_convergence"),
+                "rapid_views_parked": r_row.get("views_parked"),
+                "rapid_fallback_commits": r_row.get("fallback_commits"),
                 "swim": s_row,
                 "rapid": r_row,
             }

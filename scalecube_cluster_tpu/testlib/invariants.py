@@ -45,6 +45,16 @@ R4  Stability — no view change before the network has missed probes on at
     least ``low_watermark`` distinct ticks: the L-watermark means a link
     must fail that many consecutive probes before it can even alarm, so a
     flap shorter than L can never surface as a view change.
+R5  Liveness under fallback — with the classic-Paxos fallback attached
+    (``fallback=True`` runs of sim/rapid.py), every detected cut COMMITS:
+    a tick with ``cut_detected > 0`` must be followed by a view change
+    within :func:`r5_bound` ticks of the later of (the cut, the last
+    disturbance). The bound is closed-form — the fallback arming delay,
+    one full coordinator rotation of 3-tick rounds, a sync period, and a
+    cadence cushion. The symmetric cause check: the run's FIRST view
+    change needs a prior detected cut. R5 only raises for fallback runs
+    (the fast path alone may park by design — that caveat is exactly what
+    the fallback removes); ``views_parked`` is reported for every run.
 
 Violations raise :class:`InvariantViolation` with the failing tick and
 values — the chaos harness wraps that into a one-line seeded reproducer.
@@ -72,6 +82,21 @@ REQUIRED_KEYS = (
     "plan_dirty",
     "kills_fired",
     "restarts_fired",
+)
+
+
+#: Optional per-tick gauges the batched certifiers carry through to the
+#: per-universe slices when a run emitted them (join-aware Rapid schedules,
+#: fallback counters). Never required.
+_OPTIONAL_EVENT_KEYS = (
+    "joins_fired",
+    "plan_dirty",
+    "kills_fired",
+    "restarts_fired",
+    "fallback_rounds",
+    "fallback_commits",
+    "join_requests",
+    "join_confirms",
 )
 
 
@@ -126,6 +151,14 @@ def certify_traces(params: SimParams, traces: dict) -> dict:
     blk, lost = tr["fault_blocked"], tr["fault_lost"]
     dirty = tr["plan_dirty"].astype(bool)
     kills, restarts = tr["kills_fired"], tr["restarts_fired"]
+    # Optional gauge from join-aware scheduled runs (Rapid fallback engine):
+    # a scheduled join spends the same epoch budget as a restart, so C4
+    # accepts epoch bumps on join ticks too. Absent everywhere else.
+    joins = (
+        np.asarray(traces["joins_fired"]).reshape(-1)
+        if "joins_fired" in traces
+        else np.zeros_like(restarts)
+    )
 
     # C1 conservation, every tick.
     bad = np.flatnonzero(att != dlv + blk + lost)
@@ -156,7 +189,7 @@ def certify_traces(params: SimParams, traces: dict) -> dict:
         )
 
     # C3 no false verdicts under a fully clean, event-free timeline.
-    event_ticks = (kills > 0) | (restarts > 0)
+    event_ticks = (kills > 0) | (restarts > 0) | (joins > 0)
     if not dirty.any() and not event_ticks.any():
         if tr["suspicions_raised"].sum() > 0:
             t = int(np.flatnonzero(tr["suspicions_raised"] > 0)[0])
@@ -183,13 +216,13 @@ def certify_traces(params: SimParams, traces: dict) -> dict:
             f"tick {t}: epoch_max dropped {int(em[t - 1])} -> {int(em[t])}",
         )
     rose = np.flatnonzero(d_em > 0) + 1
-    bad = rose[restarts[rose] == 0]
+    bad = rose[(restarts[rose] == 0) & (joins[rose] == 0)]
     if bad.size:
         t = int(bad[0])
         raise InvariantViolation(
             "C4-epoch-source",
             f"tick {t}: epoch_max rose {int(em[t - 1])} -> {int(em[t])} "
-            "with no scheduled restart",
+            "with no scheduled restart or join",
         )
 
     # C5 incarnation monotone except on restart ticks.
@@ -273,7 +306,12 @@ def certify_population(
     violations: list = [None] * b_count
     summaries: list = [None] * b_count
     for b in range(b_count):
-        tb = {k: np.asarray(traces[k])[b] for k in REQUIRED_KEYS}
+        tb = {
+            k: np.asarray(traces[k])[b]
+            for k in REQUIRED_KEYS + tuple(
+                k for k in _OPTIONAL_EVENT_KEYS if k in traces
+            )
+        }
         try:
             summary = certify_traces(params, tb)
             if final_convergence is not None:
@@ -320,14 +358,34 @@ def _get_rapid(traces: dict, key: str) -> np.ndarray:
     return arr.reshape(-1)
 
 
-def certify_rapid_traces(params, traces: dict) -> dict:
-    """Certify one Rapid trajectory's traces (R1-R4). ``params`` is the
-    run's :class:`~scalecube_cluster_tpu.sim.rapid.RapidParams` (the
-    L-watermark parameterizes R4). Returns a summary dict on success;
-    raises :class:`InvariantViolation` at the first breach.
+def r5_bound(params) -> int:
+    """Ticks within which a detected cut must commit a view change under
+    the classic fallback (R5). Closed form over the protocol's cadences:
+    the locked vote sits ``fallback_delay_ticks`` before arming, the
+    rotating coordinator needs at most n+2 three-tick rounds to land on an
+    armed live member of the right configuration (n candidates, plus the
+    partial round in flight, plus one round of promise-state settling),
+    laggards adopt within one sync period, and the constant cushion absorbs
+    probe/alarm phase at the detection edge."""
+    return (
+        int(params.fallback_delay_ticks)
+        + 3 * (int(params.n) + 2)
+        + int(params.sync_period_ticks)
+        + 20
+    )
 
-    Check order is R3, R1, R2, R4 — see the module docstring for why
-    split-brain outranks plain disagreement.
+
+def certify_rapid_traces(params, traces: dict, fallback: bool = False) -> dict:
+    """Certify one Rapid trajectory's traces (R1-R5). ``params`` is the
+    run's :class:`~scalecube_cluster_tpu.sim.rapid.RapidParams` (the
+    L-watermark parameterizes R4, the fallback cadences R5). Returns a
+    summary dict on success; raises :class:`InvariantViolation` at the
+    first breach.
+
+    Check order is R3, R1, R2, R4, R5 — see the module docstring for why
+    split-brain outranks plain disagreement. ``fallback=True`` (a run with
+    the classic fallback attached) arms the R5 liveness raises; the
+    ``views_parked`` summary field is computed either way.
     """
     vid = _get_rapid(traces, "view_id")
     dig = _get_rapid(traces, "view_digest")
@@ -395,18 +453,64 @@ def certify_rapid_traces(params, traces: dict) -> dict:
                 "shorter than L must never surface as a view change",
             )
 
-    return {
+    # R5 liveness: every detected cut must commit within the closed-form
+    # bound — counted for every run (``views_parked``), raised only for
+    # fallback runs (the bare fast path may park by design).
+    cut = _get_rapid(traces, "cut_detected")
+    cut_ticks = np.flatnonzero(cut > 0)
+    bound = r5_bound(params) if hasattr(params, "fallback_delay_ticks") else 0
+    disturb = np.zeros(ticks, bool)
+    for key in ("plan_dirty", "kills_fired", "restarts_fired", "joins_fired"):
+        if key in traces:
+            disturb |= np.asarray(traces[key]).reshape(-1)[:ticks].astype(bool)
+    views_parked = 0
+    first_parked = -1
+    for t in cut_ticks:
+        later = np.flatnonzero(disturb[int(t):]) + int(t)
+        anchor = int(later[-1]) if later.size else int(t)
+        deadline = anchor + bound
+        if deadline >= ticks:
+            continue  # trace too short to judge this cut
+        # Window includes the cut tick itself: the fast path locks a vote
+        # and commits it in the same round when the quorum is already there.
+        if not (vc[int(t) : deadline + 1] > 0).any():
+            views_parked += 1
+            if first_parked < 0:
+                first_parked = int(t)
+    if fallback and views_parked:
+        raise InvariantViolation(
+            "R5-parked",
+            f"tick {first_parked}: cut detected but no view change within "
+            f"{bound} ticks of the last disturbance — {views_parked} parked "
+            "cut(s) under the classic fallback, which guarantees commit",
+        )
+    if fallback and first_vc >= 0:
+        if not cut_ticks.size or int(cut_ticks[0]) > first_vc:
+            raise InvariantViolation(
+                "R5-commit-cause",
+                f"tick {first_vc}: view change committed with no detected "
+                f"cut at or before it (first cut: "
+                f"{int(cut_ticks[0]) if cut_ticks.size else None})",
+            )
+
+    summary = {
         "ticks": int(ticks),
         "view_changes": int(vc.sum()),
         "alarms_raised": int(_get_rapid(traces, "alarms_raised").sum()),
-        "cut_detected": int(_get_rapid(traces, "cut_detected").sum()),
+        "cut_detected": int(cut.sum()),
         "max_view_id": int(vid[-1].max()),
         "first_view_change_tick": first_vc,
+        "views_parked": int(views_parked),
     }
+    for key in ("fallback_rounds", "fallback_commits",
+                "join_requests", "join_confirms"):
+        if key in traces:
+            summary[key] = int(np.asarray(traces[key]).sum())
+    return summary
 
 
-def certify_rapid_population(params, traces: dict) -> dict:
-    """Batched R1-R4 certifier over an ensemble Rapid run: every trace leaf
+def certify_rapid_population(params, traces: dict, fallback: bool = False) -> dict:
+    """Batched R1-R5 certifier over an ensemble Rapid run: every trace leaf
     carries a leading universe axis (scalars ``[B, T]``, member traces
     ``[B, T, N]``); universe b is certified exactly as a single run. Never
     raises — returns the same ``{"ok", "violations", "summaries"}``
@@ -427,9 +531,14 @@ def certify_rapid_population(params, traces: dict) -> dict:
     violations: list = [None] * b_count
     summaries: list = [None] * b_count
     for b in range(b_count):
-        tb = {k: np.asarray(traces[k])[b] for k in RAPID_REQUIRED_KEYS}
+        tb = {
+            k: np.asarray(traces[k])[b]
+            for k in RAPID_REQUIRED_KEYS + tuple(
+                k for k in _OPTIONAL_EVENT_KEYS if k in traces
+            )
+        }
         try:
-            summaries[b] = certify_rapid_traces(params, tb)
+            summaries[b] = certify_rapid_traces(params, tb, fallback=fallback)
         except InvariantViolation as e:
             ok[b] = False
             violations[b] = {"invariant": e.invariant, "error": str(e)}

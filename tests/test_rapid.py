@@ -186,10 +186,12 @@ def test_flap_only_rapid_silent_while_swim_suspects():
 # -- 3. knobs -----------------------------------------------------------------
 
 
-def _identity_knobs():
+def _identity_knobs(k: int):
+    # fanout_cap >= k is the Rapid identity: every observer slot may
+    # broadcast, exactly the uncapped engine (sim/rapid.py section 1).
     return Knobs(
         suspicion_mult=jnp.asarray(1.0, jnp.float32),
-        fanout_cap=jnp.asarray(3, jnp.int32),  # ignored by Rapid
+        fanout_cap=jnp.asarray(k, jnp.int32),
     )
 
 
@@ -198,7 +200,7 @@ def test_identity_knobs_bit_identical():
     sched = _clean_schedule(N, lambda b: b.kill(10, 3))
     _, base = run_rapid_ticks(rp, init_rapid_full_view(rp), sched, 50)
     _, knobbed = run_rapid_ticks(
-        rp, init_rapid_full_view(rp), sched, 50, knobs=_identity_knobs()
+        rp, init_rapid_full_view(rp), sched, 50, knobs=_identity_knobs(rp.k)
     )
     _assert_traces_equal(base, knobbed, "identity knobs")
 
@@ -211,7 +213,7 @@ def test_suspicion_mult_scales_l_watermark():
     _, base = run_rapid_ticks(rp, init_rapid_full_view(rp), sched, 80)
     slow_knobs = Knobs(
         suspicion_mult=jnp.asarray(3.0, jnp.float32),
-        fanout_cap=jnp.asarray(3, jnp.int32),
+        fanout_cap=jnp.asarray(rp.k, jnp.int32),
     )
     _, slow = run_rapid_ticks(
         rp, init_rapid_full_view(rp), sched, 80, knobs=slow_knobs

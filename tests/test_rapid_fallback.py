@@ -1,0 +1,418 @@
+"""Classic-Paxos fallback + protocol joins (sim/rapid.py fallback=True).
+
+Five layers:
+
+1. Parity — ``fallback=False`` replays the pinned PR-6 scenarios
+   (tools/pin_rapid_golden.py) and every state leaf and trace key digests
+   identically to tests/golden/rapid_pr6_state.json; the trace keys added
+   after the capture (the fallback/join counters) are pinned constant-zero.
+2. Liveness (the headline property) — a deterministic split-vote schedule
+   (two simultaneous kills across a one-way partition) PARKS the bare
+   fast path (``views_parked == 1``, no view change, stuck convergence)
+   while the same schedule under ``fallback=True`` commits through the
+   classic rounds, certifies R1-R5, and re-converges to 1.0 — including a
+   protocol-level join re-admitting one victim through the handshake.
+3. Negatives — the R5 certifier bites on a parked trace, on a commit with
+   no detected cut behind it, and R3 still bites under fallback; the
+   flight-recorder chain walker rejects a tampered fallback chain.
+4. Knobs — ``fanout_cap`` below the H-watermark starves cut detection
+   entirely (no alarms can stabilize), the sub-identity regime.
+5. Twins — the vmapped ensemble carries the fallback pytree bit-identically
+   to the solo run, and the serve path (run_rapid_serve_batch + the
+   rapid-engine EventBatcher) replays a join-bearing schedule bit-for-bit.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.obs.trace import (
+    TK_FB_ACCEPT,
+    TK_JOIN_CONFIRM,
+    TK_VIEW_COMMIT,
+    ring_events,
+)
+from scalecube_cluster_tpu.serve import (
+    EV_GOSSIP,
+    EV_JOIN,
+    EV_KILL,
+    EV_RESTART,
+    EventBatcher,
+    ServeEvent,
+    run_rapid_serve_batch,
+)
+from scalecube_cluster_tpu.sim import (
+    FaultPlan,
+    Knobs,
+    ScheduleBuilder,
+    init_ensemble_rapid,
+    init_rapid_full_view,
+    run_ensemble_rapid_ticks,
+    run_rapid_ticks,
+)
+from scalecube_cluster_tpu.sim.ensemble import stack_universes
+from scalecube_cluster_tpu.testlib.chaos import rapid_chaos_params
+from scalecube_cluster_tpu.testlib.invariants import (
+    InvariantViolation,
+    certify_rapid_population,
+    certify_rapid_traces,
+    r5_bound,
+)
+from scalecube_cluster_tpu.utils.jaxcache import jit_cache_size
+from tools.trace_explain import check_rapid_chains, explain_verdict
+
+N = 16
+TICKS = 60
+SCHED_ONLY = {"plan_dirty", "kills_fired", "restarts_fired", "joins_fired"}
+
+
+def _split_vote_schedule(with_join: bool):
+    """The deterministic split-vote scenario: kill 0 and 8 at t=10 behind a
+    one-way block {9..15} -> {1..7} over ticks [15, 17). Group {1..7} never
+    hears alarms about 8 (all of 8's ring observers sit in {9..15, 0}), so
+    it locks the cut {rm 0} while {9..15} locks {rm 0, 8}: 7 < thr = 12
+    votes per camp — the fast path parks. ``with_join`` re-admits node 8
+    through the protocol handshake at t=40."""
+    n = N
+    blk = np.zeros((n, n), bool)
+    blk[9:16, 1:8] = True
+    one_way = FaultPlan(
+        block=blk,
+        loss=np.zeros((1, 1), np.float32),
+        mean_delay=np.zeros((1, 1), np.float32),
+    )
+    b = (
+        ScheduleBuilder(n)
+        .add_segment(1, FaultPlan.clean(n))
+        .add_segment(15, one_way)
+        .add_segment(17, FaultPlan.clean(n))
+        .kill(10, 0)
+        .kill(10, 8)
+    )
+    if with_join:
+        b.join(40, 8)
+    return b.build()
+
+
+@pytest.fixture(scope="module")
+def parked_run():
+    """The split-vote schedule on the bare (fallback=False) engine. Long
+    enough (cut tick + r5_bound < ticks) that the parked cut is judgeable —
+    R5 skips cuts whose commit deadline lies past the end of the trace."""
+    rp = rapid_chaos_params(N)
+    state = init_rapid_full_view(rp, seed=7)
+    state, traces = run_rapid_ticks(
+        rp, state, _split_vote_schedule(with_join=False), 120
+    )
+    return rp, state, jax.device_get(traces)
+
+
+@pytest.fixture(scope="module")
+def fallback_run():
+    """The same split vote (plus a protocol join of victim 8) under
+    ``fallback=True``, with the flight recorder attached."""
+    rp = rapid_chaos_params(N)
+    state = init_rapid_full_view(rp, seed=7, trace_capacity=4096, fallback=True)
+    state, traces = run_rapid_ticks(
+        rp, state, _split_vote_schedule(with_join=True), TICKS
+    )
+    return rp, state, jax.device_get(traces)
+
+
+# -- 1. fallback=False parity against the PR-6 golden -------------------------
+
+
+def test_fallback_off_bit_identical_to_pr6_golden():
+    import json
+
+    from tools.pin_rapid_golden import GOLDEN, _digest, run_scenarios
+
+    with open(GOLDEN) as fh:
+        golden = json.load(fh)
+    current = run_scenarios()
+    assert set(current) == set(golden)
+    new_keys = (
+        "fallback_rounds",
+        "fallback_commits",
+        "join_requests",
+        "join_confirms",
+    )
+    mismatches = []
+    for name, want in golden.items():
+        got = current[name]
+        # Every leaf the pre-fallback engine produced must digest the same.
+        for section in ("state", "traces"):
+            for key, digest in want[section].items():
+                if got[section].get(key) != digest:
+                    mismatches.append(f"{name}.{section}.{key}")
+        # Keys added after the capture are pinned constant-zero: their
+        # digest must equal an all-zeros int32 vector of the run's length.
+        ticks = {
+            "clean_60": 60,
+            "kill_restart_100": 100,
+            "chaos_seed7_120": 120,
+            "traced_cycle_80": 80,
+            "identity_knobs_60": 60,
+        }[name]
+        zero = _digest(np.zeros((ticks,), np.int32))
+        for key in new_keys:
+            if got["traces"].get(key) != zero:
+                mismatches.append(f"{name}.traces.{key} (not constant-zero)")
+    assert mismatches == [], mismatches
+
+
+def test_fallback_off_state_has_no_fallback_pytree():
+    rp = rapid_chaos_params(N)
+    state = init_rapid_full_view(rp)
+    assert state.fb is None
+    # None is an empty pytree node: the compiled tick's input structure is
+    # the pre-fallback one (the structure gate the golden digests pin).
+    leaves_off = len(jax.tree_util.tree_leaves(state))
+    leaves_on = len(
+        jax.tree_util.tree_leaves(init_rapid_full_view(rp, fallback=True))
+    )
+    assert leaves_on > leaves_off
+
+
+# -- 2. the split vote: parked without fallback, committed with it ------------
+
+
+def test_split_vote_parks_bare_fast_path(parked_run):
+    rp, state, traces = parked_run
+    summary = certify_rapid_traces(rp, traces, fallback=False)
+    assert summary["cut_detected"] > 0, "the cut must actually be detected"
+    assert summary["view_changes"] == 0, "the split vote must park PR-6"
+    assert summary["views_parked"] == 1
+    # Parked means the dead members are never removed from any live view.
+    assert float(np.asarray(traces["convergence"])[-1]) < 1.0
+
+
+def test_split_vote_commits_under_fallback(fallback_run):
+    rp, state, traces = fallback_run
+    summary = certify_rapid_traces(rp, traces, fallback=True)
+    assert summary["views_parked"] == 0
+    assert summary["fallback_rounds"] >= 1
+    assert summary["fallback_commits"] > 0, "the classic path must commit"
+    assert summary["view_changes"] > 0
+    # The protocol join re-admitted victim 8: one request, one confirm,
+    # and the run ends fully re-converged.
+    assert summary["join_requests"] >= 1
+    assert summary["join_confirms"] >= 1
+    assert float(np.asarray(traces["convergence"])[-1]) == 1.0
+    assert bool(np.asarray(state.alive)[8])
+
+
+def test_r5_bound_is_closed_form():
+    rp = rapid_chaos_params(N)
+    assert r5_bound(rp) == (
+        rp.fallback_delay_ticks + 3 * (N + 2) + rp.sync_period_ticks + 20
+    )
+
+
+# -- 3. negatives -------------------------------------------------------------
+
+
+def test_r5_parked_negative(parked_run):
+    """Certifying the parked trace AS IF the fallback had been armed must
+    raise: under the fallback contract every detected cut commits."""
+    rp, _, traces = parked_run
+    with pytest.raises(InvariantViolation) as e:
+        certify_rapid_traces(rp, traces, fallback=True)
+    assert e.value.invariant == "R5-parked"
+
+
+def test_r5_commit_without_cut_negative(fallback_run):
+    """A committed view change with no detected cut at or before it has no
+    cause — the symmetric R5 tamper."""
+    rp, _, traces = fallback_run
+    tampered = dict(traces)
+    tampered["cut_detected"] = np.zeros_like(
+        np.asarray(traces["cut_detected"])
+    )
+    with pytest.raises(InvariantViolation) as e:
+        certify_rapid_traces(rp, tampered, fallback=True)
+    assert e.value.invariant == "R5-commit-cause"
+
+
+def test_r3_two_group_split_negative_under_fallback(fallback_run):
+    """The fallback's quorum intersection must keep R3 armed: a doctored
+    two-majority tick still reports split-brain, not a liveness pass."""
+    rp, _, traces = fallback_run
+    tampered = {k: np.array(np.asarray(v)) for k, v in traces.items()}
+    t = 5  # before any real view change
+    n = tampered["view_digest"].shape[1]
+    tampered["alive_mask"][t, :] = True
+    tampered["view_id"][t, :] = 3
+    tampered["view_digest"][t, : n // 2] = 111
+    tampered["view_digest"][t, n // 2 :] = 222
+    tampered["view_size"][t, :] = n // 2
+    with pytest.raises(InvariantViolation) as e:
+        certify_rapid_traces(rp, tampered, fallback=True)
+    assert e.value.invariant == "R3-split-brain"
+
+
+def test_fallback_commit_chain_walks_to_vote(fallback_run):
+    """Flight recorder: a fallback-committed view change walks back through
+    fb_accept -> fb_prepare to the coordinator's locked vote (the
+    originating cut detection), and a confirmed join walks back to its
+    seed-addressed request; a tampered chain fails loudly."""
+    _, state, _ = fallback_run
+    events = ring_events(state.trace)
+    fb_commits = [
+        e for e in events if e["kind"] == TK_VIEW_COMMIT and e["cause"] >= 0
+    ]
+    joins = [e for e in events if e["kind"] == TK_JOIN_CONFIRM]
+    assert fb_commits, "the split vote must produce fallback commits"
+    assert joins, "the join handshake must confirm"
+    assert check_rapid_chains(events) == []
+    exp = explain_verdict(events, fb_commits[0])
+    assert exp["complete"], exp["violations"]
+    assert [e["kind_name"] for e in exp["chain"]] == [
+        "view_commit", "fb_accept", "fb_prepare", "vote",
+    ]
+    expj = explain_verdict(events, joins[0])
+    assert expj["complete"], expj["violations"]
+    assert [e["kind_name"] for e in expj["chain"]] == [
+        "join_confirm", "join_ack", "join_req",
+    ]
+
+    # Tamper: sever the accept -> prepare link. The walker must refuse.
+    accept_i = next(
+        e["i"] for e in events if e["kind"] == TK_FB_ACCEPT
+    )
+    tampered = [dict(e) for e in events]
+    tampered[accept_i]["cause"] = -1
+    violations = check_rapid_chains(tampered)
+    assert any("unresolved cause" in v for v in violations)
+
+
+# -- 4. fanout_cap below the H-watermark --------------------------------------
+
+
+def test_fanout_cap_below_h_starves_detection():
+    """A cap below H means no subject can ever collect H alarming
+    observers through the capped broadcast: cuts never stabilize and the
+    kill is never committed — the documented sub-identity regime of the
+    ``fanout_cap`` knob on Rapid (README knob table)."""
+    rp = rapid_chaos_params(N)
+    sched = (
+        ScheduleBuilder(N)
+        .add_segment(0, FaultPlan.clean(N))
+        .kill(10, 3)
+        .build()
+    )
+    starved_knobs = Knobs(
+        suspicion_mult=jnp.asarray(1.0, jnp.float32),
+        fanout_cap=jnp.asarray(rp.high_watermark - 1, jnp.int32),
+    )
+    _, traces = run_rapid_ticks(
+        rp, init_rapid_full_view(rp), sched, TICKS, knobs=starved_knobs
+    )
+    assert int(np.asarray(traces["cut_detected"]).sum()) == 0
+    assert int(np.asarray(traces["view_changes"]).sum()) == 0
+
+
+# -- 5. twins: ensemble + serve -----------------------------------------------
+
+
+def test_ensemble_twin_carries_fallback_pytree_bit_identically():
+    rp = rapid_chaos_params(N)
+    ticks = 50
+    sched = _split_vote_schedule(with_join=True)
+    plans = stack_universes([sched, sched])
+    states = init_ensemble_rapid(rp, [7, 11], fallback=True)
+    efinal, etraces = run_ensemble_rapid_ticks(rp, states, plans, ticks)
+
+    solo_final, solo_tr = run_rapid_ticks(
+        rp, init_rapid_full_view(rp, seed=11, fallback=True), sched, ticks
+    )
+    host_e = jax.device_get(etraces)
+    for k in set(solo_tr) - SCHED_ONLY:
+        assert np.array_equal(
+            np.asarray(host_e[k])[1], np.asarray(solo_tr[k])
+        ), k
+    # Every FallbackState leaf of universe 1 is bit-equal to the solo run.
+    for f in dataclasses.fields(solo_final.fb):
+        assert np.array_equal(
+            np.asarray(getattr(efinal.fb, f.name))[1],
+            np.asarray(getattr(solo_final.fb, f.name)),
+        ), f"fb.{f.name}"
+
+    verdict = certify_rapid_population(rp, host_e, fallback=True)
+    assert bool(np.all(verdict["ok"])), verdict["violations"]
+
+
+def test_serve_replay_parity_with_join_events():
+    """The replay-parity leg with join events: the same kill + protocol
+    join, once as a FaultSchedule and once through the rapid-engine
+    EventBatcher + run_rapid_serve_batch, lands bit-identical on every
+    state leaf including the fallback pytree — and reuses one executable
+    across launches."""
+    n, ticks, k = N, 40, 8
+    rp = rapid_chaos_params(n)
+    sched = (
+        ScheduleBuilder(n)
+        .add_segment(1, FaultPlan.clean(n))
+        .kill(6, 2)
+        .join(14, 2)
+        .build()
+    )
+    ref_final, _ = run_rapid_ticks(
+        rp, init_rapid_full_view(rp, seed=11, fallback=True), sched, ticks
+    )
+
+    state = init_rapid_full_view(rp, seed=11, fallback=True)
+    plan = FaultPlan.clean(n)
+    batcher = EventBatcher(
+        n=n, g_slots=1, n_ticks=k, capacity=2, engine="rapid"
+    )
+    batcher.push(ServeEvent(EV_KILL, 2, tick=6), stamp=False)
+    batcher.push(ServeEvent(EV_JOIN, 2, tick=14), stamp=False)
+    joins_total = 0
+    compiled = None
+    for base in range(0, ticks, k):
+        batch, _stats = batcher.next_batch(base)
+        batch = jax.tree.map(jnp.asarray, batch)
+        state, traces = run_rapid_serve_batch(rp, state, plan, batch)
+        joins_total += int(np.sum(traces["joins_fired"]))
+        if compiled is None:
+            compiled = jit_cache_size(run_rapid_serve_batch)
+    assert joins_total == 1
+    assert jit_cache_size(run_rapid_serve_batch) == compiled, (
+        "same-geometry launches must not recompile the serve step"
+    )
+
+    for f in dataclasses.fields(ref_final):
+        ref_v = getattr(ref_final, f.name)
+        if ref_v is None or f.name == "fb":
+            continue
+        assert np.array_equal(
+            np.asarray(ref_v), np.asarray(getattr(state, f.name))
+        ), f.name
+    for f in dataclasses.fields(ref_final.fb):
+        assert np.array_equal(
+            np.asarray(getattr(ref_final.fb, f.name)),
+            np.asarray(getattr(state.fb, f.name)),
+        ), f"fb.{f.name}"
+
+
+def test_batcher_routes_joins_per_engine():
+    swim = EventBatcher(n=8, g_slots=2, n_ticks=2, capacity=2)
+    swim.push(ServeEvent(EV_JOIN, 3), stamp=False)
+    assert swim._pending[0].kind == EV_RESTART, (
+        "SWIM keeps the historical join -> restart alias at push"
+    )
+    rapid = EventBatcher(
+        n=8, g_slots=2, n_ticks=2, capacity=2, engine="rapid"
+    )
+    rapid.push(ServeEvent(EV_JOIN, 3), stamp=False)
+    assert rapid._pending[0].kind == EV_JOIN, (
+        "rapid sessions keep the protocol-level join kind"
+    )
+    with pytest.raises(ValueError, match="rapid session"):
+        rapid.push(ServeEvent(EV_GOSSIP, 1, arg=0), stamp=False)
+    with pytest.raises(ValueError, match="unknown engine"):
+        EventBatcher(n=8, g_slots=2, n_ticks=2, capacity=2, engine="raft")

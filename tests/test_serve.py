@@ -29,6 +29,7 @@ from scalecube_cluster_tpu.cluster_api.config import TransportConfig
 from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS
 from scalecube_cluster_tpu.serve import (
     EV_GOSSIP,
+    EV_JOIN,
     EV_KILL,
     EV_RESTART,
     SERVE_QUALIFIER,
@@ -213,7 +214,7 @@ def test_trace_format_parsing(tmp_path):
     ev = parse_trace_line('{"tick": 3, "kind": "leave", "node": 5}')
     assert (ev.kind, ev.node, ev.tick) == (EV_KILL, 5, 3)
     ev = parse_trace_line('{"kind": "join", "node": 1}')
-    assert (ev.kind, ev.tick) == (EV_RESTART, None)
+    assert (ev.kind, ev.tick) == (EV_JOIN, None)  # protocol-level join kind
     ev = parse_trace_line('{"kind": "gossip", "node": 2, "slot": 3}')
     assert (ev.kind, ev.arg) == (EV_GOSSIP, 3)
     with pytest.raises(ValueError, match="unknown serve event kind"):

@@ -309,7 +309,7 @@ def _build_ensemble_writeback_free():
     )
 
 
-def _build_run_rapid_ticks(trace_capacity=0):
+def _build_run_rapid_ticks(trace_capacity=0, fallback=False):
     from scalecube_cluster_tpu.sim.faults import FaultPlan
     from scalecube_cluster_tpu.sim.rapid import (
         RapidParams,
@@ -318,7 +318,11 @@ def _build_run_rapid_ticks(trace_capacity=0):
     )
 
     params = RapidParams(n=N)
-    state = init_rapid_full_view(params, trace_capacity=trace_capacity)
+    # fallback=True arms the classic-Paxos plane: FallbackState joins the
+    # carry pytree, so it is a distinct executable to census.
+    state = init_rapid_full_view(
+        params, trace_capacity=trace_capacity, fallback=fallback
+    )
     return (
         run_rapid_ticks,
         (params, state, FaultPlan.uniform(), T),
@@ -362,6 +366,31 @@ def _build_run_serve_batch():
         {"collect": True},
         {
             "donate_argnums": (1,),
+            "state_argnum": 1,
+            "state_out": _state_first,
+            "static_argnums": (0,),
+            "static_argnames": ("collect",),
+        },
+    )
+
+
+def _build_run_rapid_serve_batch():
+    # The Rapid serving-session executable (serve/engine.py): the fallback-
+    # armed rapid tick scanned over a fixed-shape EventBatch. Unlike
+    # run_serve_batch this entry does NOT donate — rapid serve sessions are
+    # replay/parity surfaces that re-run the same state object.
+    from scalecube_cluster_tpu.serve.engine import run_rapid_serve_batch
+    from scalecube_cluster_tpu.serve.events import empty_batch
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.rapid import RapidParams, init_rapid_full_view
+
+    params = RapidParams(n=N)
+    state = init_rapid_full_view(params, fallback=True)
+    return (
+        run_rapid_serve_batch,
+        (params, state, FaultPlan.uniform(), empty_batch(T, 2)),
+        {"collect": True},
+        {
             "state_argnum": 1,
             "state_out": _state_first,
             "static_argnums": (0,),
@@ -421,8 +450,13 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
         "sim.rapid.run_rapid_ticks[traced]",
         lambda: _build_run_rapid_ticks(trace_capacity=256),
     ),
+    EntrySpec(
+        "sim.rapid.run_rapid_ticks[fallback]",
+        lambda: _build_run_rapid_ticks(fallback=True),
+    ),
     EntrySpec("sim.rapid.run_ensemble_rapid_ticks", _build_run_ensemble_rapid_ticks),
     EntrySpec("serve.engine.run_serve_batch", _build_run_serve_batch),
+    EntrySpec("serve.engine.run_rapid_serve_batch", _build_run_rapid_serve_batch),
 )
 
 

@@ -1,0 +1,121 @@
+"""Regenerate tests/golden/rapid_pr6_state.json — per-leaf digests of the
+Rapid engine's fallback-free trajectories on fixed scenarios.
+
+The golden file was first captured from the PR-6 engine BEFORE the Paxos
+fallback landed; tests/test_rapid_fallback.py replays the same scenarios
+with ``fallback=False`` and asserts every state leaf and every trace key
+digests identically — the executable form of "fallback=False remains
+bit-identical to the pre-PR engine on every state leaf". Trace keys added
+AFTER the capture (the fallback/join counters) are pinned constant-zero by
+the test instead of digested here. Re-run only if a later PR deliberately
+changes the fallback-free trajectory (record why in the PR).
+
+    JAX_PLATFORMS=cpu python -m tools.pin_rapid_golden
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+import numpy as np
+
+GOLDEN = os.path.join(
+    os.path.dirname(__file__), "..", "tests", "golden", "rapid_pr6_state.json"
+)
+
+
+def _digest(arr) -> str:
+    a = np.asarray(arr)
+    h = hashlib.sha256()
+    h.update(str(a.dtype).encode())
+    h.update(str(a.shape).encode())
+    h.update(np.ascontiguousarray(a).tobytes())
+    return h.hexdigest()[:16]
+
+
+def state_digests(state) -> dict:
+    """Digest every array leaf of a RapidState; the optional fallback pytree
+    (absent pre-PR, None when fallback=False) never contributes."""
+    out = {}
+    for f in dataclasses.fields(state):
+        v = getattr(state, f.name)
+        if v is None or f.name == "fb":
+            continue
+        if f.name == "trace":
+            for tf in dataclasses.fields(v):
+                out[f"trace.{tf.name}"] = _digest(getattr(v, tf.name))
+        else:
+            out[f.name] = _digest(v)
+    return out
+
+
+def trace_digests(traces: dict, keys=None) -> dict:
+    return {k: _digest(traces[k]) for k in sorted(keys or traces)}
+
+
+def run_scenarios() -> dict:
+    from scalecube_cluster_tpu.sim import (
+        FaultPlan,
+        Knobs,
+        ScheduleBuilder,
+        init_rapid_full_view,
+        run_rapid_ticks,
+    )
+    import jax.numpy as jnp
+
+    from scalecube_cluster_tpu.testlib.chaos import (
+        rapid_chaos_params,
+        sample_schedule,
+    )
+
+    n = 16
+    rp = rapid_chaos_params(n)
+    clean = ScheduleBuilder(n).add_segment(0, FaultPlan.clean(n)).build()
+    cycle = (
+        ScheduleBuilder(n)
+        .add_segment(0, FaultPlan.clean(n))
+        .kill(10, 3)
+        .restart(40, 3)
+        .build()
+    )
+    knobs = Knobs(
+        suspicion_mult=jnp.asarray(1.0, jnp.float32),
+        fanout_cap=jnp.asarray(rp.k, jnp.int32),
+    )
+    specs = {
+        "clean_60": dict(sched=clean, ticks=60),
+        "kill_restart_100": dict(sched=cycle, ticks=100),
+        "chaos_seed7_120": dict(sched=sample_schedule(7, n), ticks=120),
+        "traced_cycle_80": dict(sched=cycle, ticks=80, trace_capacity=512),
+        "identity_knobs_60": dict(sched=cycle, ticks=60, knobs=knobs),
+    }
+    out = {}
+    for name, spec in specs.items():
+        init_kwargs = {}
+        if spec.get("trace_capacity"):
+            init_kwargs["trace_capacity"] = spec["trace_capacity"]
+        state = init_rapid_full_view(rp, **init_kwargs)
+        state, traces = run_rapid_ticks(
+            rp, state, spec["sched"], spec["ticks"], knobs=spec.get("knobs")
+        )
+        out[name] = {
+            "state": state_digests(state),
+            "traces": trace_digests(traces),
+        }
+    return out
+
+
+def main():
+    golden = run_scenarios()
+    path = os.path.abspath(GOLDEN)
+    with open(path, "w") as fh:
+        json.dump(golden, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -15,6 +15,17 @@ the probe episode, be of the kind the protocol allows at that link, and be
 tick-ordered. A tampered or truncated ring therefore fails loudly — the
 exit code is 1 whenever any queried verdict's chain is broken.
 
+Rapid rings (sim/rapid.py, ``fallback=True``) add two more chain families,
+auto-checked whenever their kinds appear in the file:
+
+    view_commit(cause >= 0) -> fb_accept -> fb_prepare -> vote    (fallback)
+    join_confirm -> join_ack -> join_req                          (join)
+
+A fallback-committed view change therefore walks back to the coordinator's
+locked vote — the originating cut detection — and a confirmed join walks
+back to the joiner's seed-addressed request. ``cause == -1`` on a
+view_commit is the fast path (no classic round ran): a legitimate root.
+
 Usage::
 
     python -m tools.trace_explain events.jsonl [--subject N] [--tick T]
@@ -28,10 +39,17 @@ import sys
 
 from scalecube_cluster_tpu.obs.trace import (
     DEAD_VIA_EXPIRY,
+    TK_FB_ACCEPT,
+    TK_FB_PREPARE,
+    TK_JOIN_ACK,
+    TK_JOIN_CONFIRM,
+    TK_JOIN_REQ,
     TK_PROBE_MISSED,
     TK_PROBE_SENT,
     TK_SUSPECT_START,
     TK_VERDICT_DEAD,
+    TK_VIEW_COMMIT,
+    TK_VOTE,
     load_events_jsonl,
 )
 
@@ -40,7 +58,31 @@ _CAUSE_KINDS = {
     TK_VERDICT_DEAD: (TK_SUSPECT_START, TK_PROBE_SENT),
     TK_SUSPECT_START: (TK_PROBE_MISSED,),
     TK_PROBE_MISSED: (TK_PROBE_SENT,),
+    TK_VIEW_COMMIT: (TK_FB_ACCEPT,),
+    TK_FB_ACCEPT: (TK_FB_PREPARE,),
+    TK_FB_PREPARE: (TK_VOTE,),
+    TK_JOIN_CONFIRM: (TK_JOIN_ACK,),
+    TK_JOIN_ACK: (TK_JOIN_REQ,),
 }
+
+#: Kinds that legitimately end a chain (nothing caused them inside the ring).
+_ROOT_KINDS = (TK_PROBE_SENT, TK_VOTE, TK_JOIN_REQ)
+
+#: Kinds whose ``cause`` may be -1 at the chain HEAD: a view_commit with no
+#: cause is a fast-path commit (no classic round ran) — a legitimate root,
+#: not a truncated chain. Anywhere deeper, -1 is still a violation.
+_OPTIONAL_CAUSE = (TK_VIEW_COMMIT,)
+
+#: Links whose actor must stay fixed: the FD probe episode, the fallback
+#: coordinator's prepare -> accept -> vote trail, and the seed's ack ->
+#: confirm pair. (Verdict and fb-commit links legitimately cross actors.)
+_ACTOR_FIXED = (
+    TK_SUSPECT_START,
+    TK_PROBE_MISSED,
+    TK_FB_ACCEPT,
+    TK_FB_PREPARE,
+    TK_JOIN_CONFIRM,
+)
 
 
 def walk_chain(by_pos: dict[int, dict], ev: dict) -> tuple[list[dict], list[str]]:
@@ -57,18 +99,21 @@ def walk_chain(by_pos: dict[int, dict], ev: dict) -> tuple[list[dict], list[str]
     while True:
         kinds = _CAUSE_KINDS.get(cur["kind"])
         if kinds is None:
-            # probe_sent (or any other root kind) legitimately ends a chain.
-            if cur["kind"] != TK_PROBE_SENT and cur is not ev:
+            # A root kind (probe_sent / vote / join_req) legitimately ends
+            # a chain.
+            if cur["kind"] not in _ROOT_KINDS and cur is not ev:
                 violations.append(
                     f"event {cur['i']}: chain ends at kind "
-                    f"{cur['kind_name']}, not at a probe_sent root"
+                    f"{cur['kind_name']}, not at a root kind"
                 )
             break
         c = cur["cause"]
         if c < 0:
+            if cur["kind"] in _OPTIONAL_CAUSE and cur is ev:
+                break  # fast-path view_commit: causeless by design
             violations.append(
                 f"event {cur['i']} ({cur['kind_name']}): unresolved cause "
-                "(ref -1) — originating probe missing from the ring"
+                "(ref -1) — originating event missing from the ring"
             )
             break
         if c >= cur["i"]:
@@ -93,7 +138,19 @@ def walk_chain(by_pos: dict[int, dict], ev: dict) -> tuple[list[dict], list[str]
                 f"{nxt['kind_name']}, protocol allows kinds {allowed}"
             )
             break
-        if nxt["subject"] != cur["subject"]:
+        if cur["kind"] == TK_JOIN_ACK:
+            # The only subject-swapping link: a seed's ack (actor=seed,
+            # subject=joiner) answers the joiner's request (actor=joiner,
+            # subject=seed) — roles invert across the wire.
+            if nxt["actor"] != cur["subject"] or nxt["subject"] != cur["actor"]:
+                violations.append(
+                    f"event {cur['i']}: join ack does not answer its "
+                    f"joiner's request (ack seed={cur['actor']} "
+                    f"joiner={cur['subject']}, req actor={nxt['actor']} "
+                    f"seed={nxt['subject']} at ref {c})"
+                )
+                break
+        elif nxt["subject"] != cur["subject"]:
             violations.append(
                 f"event {cur['i']}: subject changes along the chain "
                 f"({cur['subject']} -> {nxt['subject']} at ref {c})"
@@ -105,14 +162,12 @@ def walk_chain(by_pos: dict[int, dict], ev: dict) -> tuple[list[dict], list[str]
                 f"the future (tick {nxt['tick']})"
             )
             break
-        if (
-            cur["kind"] in (TK_SUSPECT_START, TK_PROBE_MISSED)
-            and nxt["actor"] != cur["actor"]
-        ):
-            # Within one probe episode the failure-detector actor is fixed;
-            # only the verdict link crosses actors (viewer != prober).
+        if cur["kind"] in _ACTOR_FIXED and nxt["actor"] != cur["actor"]:
+            # Within one probe episode / fallback round / seed handshake the
+            # acting member is fixed; only the verdict and fb-commit links
+            # cross actors (viewer != prober, committer != coordinator).
             violations.append(
-                f"event {cur['i']}: probe-episode actor changes "
+                f"event {cur['i']}: episode actor changes "
                 f"({cur['actor']} -> {nxt['actor']} at ref {c})"
             )
             break
@@ -123,14 +178,20 @@ def walk_chain(by_pos: dict[int, dict], ev: dict) -> tuple[list[dict], list[str]
 
 
 def explain_verdict(events: list[dict], verdict: dict) -> dict:
-    """Explain one DEAD verdict: its full chain plus any C6 violations."""
+    """Explain one chain head (DEAD verdict, fb-committed view change, or
+    join confirm): its full chain plus any per-link violations."""
     by_pos = {e["i"]: e for e in events}
     chain, violations = walk_chain(by_pos, verdict)
+    tail = chain[-1]
     return {
         "verdict": verdict,
         "chain": chain,
         "violations": violations,
-        "complete": not violations and chain[-1]["kind"] == TK_PROBE_SENT,
+        "complete": not violations
+        and (
+            tail["kind"] in _ROOT_KINDS
+            or (tail["kind"] in _OPTIONAL_CAUSE and tail["cause"] < 0)
+        ),
     }
 
 
@@ -151,22 +212,59 @@ def check_c6(events: list[dict]) -> list[str]:
     return out
 
 
+def check_rapid_chains(events: list[dict]) -> list[str]:
+    """Machine-check the Rapid fallback and join chain families over EVERY
+    fb-committed view change (``view_commit`` with ``cause >= 0``) and every
+    ``join_confirm`` in the file. Returns the flat violation list (empty ==
+    each one walks back to its originating vote / join request)."""
+    by_pos = {e["i"]: e for e in events}
+    out: list[str] = []
+    for ev in events:
+        if ev["kind"] == TK_VIEW_COMMIT and ev["cause"] >= 0:
+            label = (
+                f"FB_COMMIT(decree src={ev['subject']}, "
+                f"member={ev['actor']}, tick={ev['tick']})"
+            )
+        elif ev["kind"] == TK_JOIN_CONFIRM:
+            label = (
+                f"JOIN_CONFIRM(joiner={ev['subject']}, "
+                f"seed={ev['actor']}, tick={ev['tick']})"
+            )
+        else:
+            continue
+        _, violations = walk_chain(by_pos, ev)
+        out.extend(f"{label}: {v}" for v in violations)
+    return out
+
+
 def format_chain(explained: dict) -> str:
     v = explained["verdict"]
-    via = "expiry" if v["aux"] == DEAD_VIA_EXPIRY else "gossip/sync"
-    lines = [
-        f"why DEAD({v['subject']}) at tick {v['tick']} "
-        f"as seen by member {v['actor']} (via {via}):"
-    ]
+    if v["kind"] == TK_VERDICT_DEAD:
+        via = "expiry" if v["aux"] == DEAD_VIA_EXPIRY else "gossip/sync"
+        head = (
+            f"why DEAD({v['subject']}) at tick {v['tick']} "
+            f"as seen by member {v['actor']} (via {via}):"
+        )
+    elif v["kind"] == TK_VIEW_COMMIT:
+        head = (
+            f"why view {v['aux']} committed at tick {v['tick']} "
+            f"by member {v['actor']} (fallback decree from {v['subject']}):"
+        )
+    else:
+        head = (
+            f"why member {v['subject']} joined (confirmed tick {v['tick']} "
+            f"by seed {v['actor']}):"
+        )
+    lines = [head]
     for ev in explained["chain"]:
         lines.append(
             f"  [{ev['i']:>5}] tick {ev['tick']:>5}  {ev['kind_name']:<14} "
             f"actor={ev['actor']} subject={ev['subject']} cause={ev['cause']}"
         )
     for bad in explained["violations"]:
-        lines.append(f"  C6 VIOLATION: {bad}")
+        lines.append(f"  VIOLATION: {bad}")
     if explained["complete"]:
-        lines.append("  => chain complete: rooted at an originating probe (C6 ok)")
+        lines.append("  => chain complete: rooted at its originating event")
     return "\n".join(lines)
 
 
@@ -186,36 +284,64 @@ def main(argv: list[str] | None = None) -> int:
     args = ap.parse_args(argv)
 
     events = load_events_jsonl(args.events)
-    deads = [
+
+    def match(e: dict) -> bool:
+        return (args.subject is None or e["subject"] == args.subject) and (
+            args.tick is None or e["tick"] == args.tick
+        )
+
+    deads = [e for e in events if e["kind"] == TK_VERDICT_DEAD and match(e)]
+    # Rapid chain heads (auto-checked whenever the ring carries them):
+    # fallback-committed view changes and confirmed joins.
+    rapid = [
         e for e in events
-        if e["kind"] == TK_VERDICT_DEAD
-        and (args.subject is None or e["subject"] == args.subject)
-        and (args.tick is None or e["tick"] == args.tick)
+        if match(e)
+        and (
+            (e["kind"] == TK_VIEW_COMMIT and e["cause"] >= 0)
+            or e["kind"] == TK_JOIN_CONFIRM
+        )
     ]
-    if not deads:
+    if not deads and not rapid:
         print("no matching DEAD verdicts in the trace")
         return 0
 
     shown = 0
-    all_violations: list[str] = []
-    for ev in deads:
+    c6_violations: list[str] = []
+    rapid_violations: list[str] = []
+    for ev, sink in [(e, c6_violations) for e in deads] + [
+        (e, rapid_violations) for e in rapid
+    ]:
         explained = explain_verdict(events, ev)
-        all_violations.extend(explained["violations"])
+        sink.extend(explained["violations"])
         if not args.quiet and shown < args.max_chains:
             print(format_chain(explained))
             shown += 1
-    if len(deads) > shown and not args.quiet:
-        print(f"... ({len(deads) - shown} more chains checked, not printed)")
+    checked = len(deads) + len(rapid)
+    if checked > shown and not args.quiet:
+        print(f"... ({checked - shown} more chains checked, not printed)")
 
-    if all_violations:
-        print(f"C6: {len(all_violations)} violation(s) across "
-              f"{len(deads)} DEAD verdict(s)")
-        for v in all_violations:
-            print(f"  {v}")
-        return 1
-    print(f"C6: all {len(deads)} DEAD verdict(s) resolve to a complete "
-          "causal chain")
-    return 0
+    rc = 0
+    if deads:
+        if c6_violations:
+            print(f"C6: {len(c6_violations)} violation(s) across "
+                  f"{len(deads)} DEAD verdict(s)")
+            for v in c6_violations:
+                print(f"  {v}")
+            rc = 1
+        else:
+            print(f"C6: all {len(deads)} DEAD verdict(s) resolve to a "
+                  "complete causal chain")
+    if rapid:
+        if rapid_violations:
+            print(f"rapid chains: {len(rapid_violations)} violation(s) "
+                  f"across {len(rapid)} fallback-commit/join event(s)")
+            for v in rapid_violations:
+                print(f"  {v}")
+            rc = 1
+        else:
+            print(f"rapid chains: all {len(rapid)} fallback-commit/join "
+                  "event(s) walk back to their originating event")
+    return rc
 
 
 if __name__ == "__main__":
