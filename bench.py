@@ -40,13 +40,18 @@ sim/ensemble.py: B universes stepped in one compiled call; the reported
 aggregate is universes × member·rounds/s), ``python bench.py --rapid
 [n]`` (the Rapid consistent-membership engine rung, sim/rapid.py — the
 measured price of strong consistency next to the SWIM numbers), or
-``python bench.py --shard-map <d> [n]`` (the explicit-SPMD engine rung,
-parallel/spmd.py: the sparse tick as a shard_map program over d member
-shards with bucketed cross-shard exchange; rows are stamped with the
-shard count, the resolved bucket capacity and the exchange-round count,
-and both the backend probe attempt and the result row land in
-artifacts/bench_history.jsonl. On a CPU-only box set JAX_PLATFORMS=cpu
-and the rung forces d virtual host devices itself), or ``python bench.py
+``python bench.py --shard-map <d> [n] [--pallas]`` (the explicit-SPMD
+engine rung, parallel/spmd.py: the sparse tick as a shard_map program
+over d member shards with bucketed cross-shard exchange; ``--pallas``
+swaps each shard's merge/decay core for the fused Pallas kernel, same
+collective geometry. Rows are stamped with the shard count, the resolved
+bucket capacity and the exchange-round count, and both the backend probe
+attempt and the result row land in artifacts/bench_history.jsonl. On a
+CPU-only box set JAX_PLATFORMS=cpu and the rung forces d virtual host
+devices itself), ``python bench.py --persistent-ksweep [n] [k_max]``
+(the persistent multi-tick kernel swept over launch depth k on one
+traced executable — one row per k with ns_per_member and a
+zero_recompile verdict pinned via jit_cache_size), or ``python bench.py
 --serve [n]`` (the streaming serving-bridge rung, serve/: a synthetic
 event stream replayed through the double-buffered launch pipeline; the
 ``kind="serve"`` session row — events/s, member·rounds/s, batch-latency
@@ -131,6 +136,15 @@ CHILD_DEADLINE_S = 420
 #: guaranteed output past the driver's patience (probe + first child worst
 #: case still fits well under it).
 TOTAL_BUDGET_S = 1200
+
+
+def _ns_per_member(value: float) -> float | None:
+    """Wall nanoseconds per member·round (1e9 / member·rounds/s) — the
+    flat-scaling lens (round-7 satellite): a rung family scales linearly
+    exactly while this column stays flat as n grows, so scaling knees read
+    straight off bench_history.jsonl without dividing throughput columns
+    by hand. ``None`` when the rung never produced a measurement."""
+    return round(1e9 / value, 3) if value > 0 else None
 
 
 def _measure_dense(
@@ -252,6 +266,7 @@ def _measure_ensemble(
         "value": round(value, 1),
         "unit": "universes·member·rounds/s",
         "per_universe": round(value / b_count, 1),
+        "ns_per_member": _ns_per_member(value),
         "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
         "n_members": n_members,
         "universes": b_count,
@@ -260,15 +275,21 @@ def _measure_ensemble(
 
 
 def _measure_shard_map(
-    d: int, n_members: int = 32768, chunk: int = 48, reps: int = 4
+    d: int, n_members: int = 32768, chunk: int = 48, reps: int = 4,
+    pallas: bool = False,
 ) -> dict:
-    """The ``--shard-map d [n]`` rung: the explicit-SPMD sparse engine
-    (parallel/spmd.py) over a d-shard ``members`` mesh, measured exactly
-    like the sparse rungs (warmup + compile, then reps × chunk scanned
-    ticks synced by an element fetch off the large view_T buffer). The row
-    carries the exchange geometry next to the throughput number — shard
-    count, resolved per-(channel, destination) bucket capacity in sender
-    groups, exchange rounds per tick, and the analytic exchange payload in
+    """The ``--shard-map d [n] [--pallas]`` rung: the explicit-SPMD sparse
+    engine (parallel/spmd.py) over a d-shard ``members`` mesh, measured
+    exactly like the sparse rungs (warmup + compile, then reps × chunk
+    scanned ticks synced by an element fetch off the large view_T buffer).
+    ``pallas=True`` (round-7 tentpole arm) swaps each shard's merge/decay
+    core for the fused Pallas kernel — the three cross-shard collectives
+    stay outside the kernel, identical geometry — under the engine tag
+    ``sparse-shard-map-pallas``, so the kernel-vs-XLA-core delta at the
+    same shard count reads as two adjacent rows. The row carries the
+    exchange geometry next to the throughput number — shard count,
+    resolved per-(channel, destination) bucket capacity in sender groups,
+    exchange rounds per tick, and the analytic exchange payload in
     bytes/tick — so GSPMD-vs-explicit-SPMD comparisons in PERF.md read
     straight off bench_history.jsonl rows."""
     import jax
@@ -296,7 +317,10 @@ def _measure_shard_map(
     # is one replicated psum, no host boundary needed) — unlike the
     # GSPMD sparse rung, which runs chunked with host-boundary frees.
     params = SparseParams.for_n(
-        n_members, in_scan_writeback=True, slot_budget=_rung_slot_budget(n_members)
+        n_members,
+        in_scan_writeback=True,
+        slot_budget=_rung_slot_budget(n_members),
+        pallas_core=pallas,
     )
     cfg = ShardConfig(d=d)
     mesh = make_mesh(jax.devices()[:d])
@@ -321,8 +345,9 @@ def _measure_shard_map(
         "value": round(value, 1),
         "unit": "member·rounds/s",
         "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+        "ns_per_member": _ns_per_member(value),
         "n_members": n_members,
-        "engine": "sparse-shard-map",
+        "engine": "sparse-shard-map-pallas" if pallas else "sparse-shard-map",
         "slot_budget": params.slot_budget,
         "shards": d,
         "bucket_groups": _bucket_cap(params, cfg),
@@ -334,6 +359,86 @@ def _measure_shard_map(
             params, cfg
         )["total_bytes"],
     }
+
+
+def _measure_persistent_ksweep(
+    n_members: int = 4096,
+    k_max: int = 8,
+    reps: int = 4,
+    slot_budget: int | None = None,
+) -> list[dict]:
+    """The ``--persistent-ksweep [n] [k_max]`` rung family: the persistent
+    multi-tick kernel (ops/pallas_sparse.py::run_sparse_core_persistent)
+    swept over launch depth k on ONE traced executable — k rides a scalar
+    operand, so every 1 <= k <= k_max reuses the k_max-sized grid. One row
+    per k, same member·rounds/s metric as the tick rungs plus
+    ``ns_per_member``, so how per-launch overhead (dispatch + the first
+    slab DMA fill) amortizes with depth reads as a row family in
+    bench_history.jsonl. Every row carries ``zero_recompile`` pinned via
+    jit_cache_size: a silently re-specializing executable fails loudly in
+    the history instead of flattering the sweep. Operands are the same
+    seeded realistic set the parity tests use (negative UNKNOWNs, partial
+    slot table, dead rows) — this rung prices the kernel, not a protocol
+    trajectory."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalecube_cluster_tpu.ops.pallas_sparse import run_sparse_core_persistent
+    from scalecube_cluster_tpu.utils.jaxcache import jit_cache_size
+
+    s = slot_budget or _rung_slot_budget(n_members)
+    f = 3
+    nb = n_members // 32
+    rng = np.random.default_rng(0)
+    slab = jnp.asarray(rng.integers(-1, 1 << 20, (n_members, s)), jnp.int32)
+    age = jnp.asarray(rng.integers(0, 120, (n_members, s)), jnp.int8)
+    susp = jnp.asarray(rng.integers(0, 21, (n_members, s)), jnp.int16)
+    subj = np.full(s, -1, np.int32)
+    k_active = min(n_members, s // 2)
+    subj[:k_active] = rng.choice(n_members, size=k_active, replace=False)
+    rng.shuffle(subj)
+    slot_subj = jnp.asarray(subj)
+    ginv = jnp.asarray(rng.integers(0, nb, (k_max, f, nb)), jnp.int32)
+    rots = jnp.asarray(rng.integers(0, 32, (k_max, f, nb)), jnp.int32)
+    edge_ok = jnp.asarray(rng.random((k_max, f, n_members)) < 0.8)
+    alive = jnp.asarray(rng.random(n_members) < 0.9)
+    kw = dict(
+        spread=6, susp_ticks=20, age_stale=120, sweep=6, k_max=k_max,
+        fold=frozenset({"countdown", "wb_mask", "view_rows"}),
+    )
+
+    def launch(k: int):
+        return run_sparse_core_persistent(
+            slab, age, susp, slot_subj, ginv, rots, edge_ok, alive, k, **kw
+        )
+
+    before = jit_cache_size(run_sparse_core_persistent)
+    # One warmup launch at full depth pays the single compile; the element
+    # fetch off the large slab output is the host sync (module docstring).
+    int(launch(k_max)[0][0, 0])
+    rows = []
+    for k in range(1, k_max + 1):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            int(launch(k)[0][0, 0])
+        dt = time.perf_counter() - t0
+        value = n_members * (reps * k / dt)
+        rows.append({
+            "metric": "member_gossip_rounds_per_sec",
+            "value": round(value, 1),
+            "unit": "member·rounds/s",
+            "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+            "ns_per_member": _ns_per_member(value),
+            "n_members": n_members,
+            "engine": "sparse-persistent-kernel",
+            "slot_budget": s,
+            "k": k,
+            "k_max": k_max,
+            "launches": reps,
+            "zero_recompile": jit_cache_size(run_sparse_core_persistent)
+            == before + 1,
+        })
+    return rows
 
 
 def _measure_rapid(n_members: int = 1024, chunk: int = 40, reps: int = 4) -> dict:
@@ -370,6 +475,7 @@ def _measure_rapid(n_members: int = 1024, chunk: int = 40, reps: int = 4) -> dic
         "value": round(value, 1),
         "unit": "member·rounds/s",
         "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+        "ns_per_member": _ns_per_member(value),
         "n_members": n_members,
         "engine": "rapid",
         "k_observers": params.k,
@@ -483,6 +589,7 @@ def _measure(engine: str, n_members: int, slot_budget: int | None = None) -> dic
         "value": round(value, 1),
         "unit": "member·rounds/s",
         "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+        "ns_per_member": _ns_per_member(value),
         "n_members": n_members,
         "engine": engine,
     }
@@ -583,8 +690,11 @@ def _record_probe_attempt(
     budget each round spent discovering it. ``extra`` merges scenario
     context into the attempt row — the serve rung stamps its ingest→verdict
     SLO percentiles here so the probe history carries the serving-latency
-    trend, not just up/down. Best-effort: a read-only or missing artifacts/
-    dir must never break the bench's one-JSON-line contract.
+    trend, not just up/down; any attempt whose extra carries a
+    ``member_rounds_per_sec`` throughput gets ``ns_per_member`` stamped
+    alongside automatically, so the per-member cost trend lives in the
+    same timeline. Best-effort: a read-only or missing artifacts/ dir must
+    never break the bench's one-JSON-line contract.
     """
     try:
         from scalecube_cluster_tpu.obs.export import append_jsonl, make_row, run_metadata
@@ -592,18 +702,19 @@ def _record_probe_attempt(
         path = os.path.join(
             os.path.dirname(os.path.abspath(__file__)), "artifacts", "bench_history.jsonl"
         )
-        row = make_row(
-            "bench_probe",
-            {
-                "attempt": attempt,
-                "ok": err is None,
-                "detail": (err or "")[-300:],
-                "elapsed_s": round(elapsed_s, 1),
-                "budget_s": PROBE_DEADLINE_S,
-                **(extra or {}),
-            },
-            run_metadata(),
-        )
+        payload = {
+            "attempt": attempt,
+            "ok": err is None,
+            "detail": (err or "")[-300:],
+            "elapsed_s": round(elapsed_s, 1),
+            "budget_s": PROBE_DEADLINE_S,
+            **(extra or {}),
+        }
+        if "member_rounds_per_sec" in payload:
+            payload.setdefault(
+                "ns_per_member", _ns_per_member(payload["member_rounds_per_sec"])
+            )
+        row = make_row("bench_probe", payload, run_metadata())
         append_jsonl(path, [row])
     except Exception:
         pass
@@ -716,6 +827,7 @@ def main() -> None:
             "value": 0.0,
             "unit": "member·rounds/s",
             "vs_baseline": 0.0,
+            "ns_per_member": None,
             "error": f"{err} (probe attempts: {probes})",
             **_self_evidence(),
         }
@@ -775,8 +887,10 @@ if __name__ == "__main__":
             flush=True,
         )
     elif len(sys.argv) >= 3 and sys.argv[1] == "--shard-map":
-        d_arg = int(sys.argv[2])
-        n_arg = int(sys.argv[3]) if len(sys.argv) > 3 else 32768
+        pos = [a for a in sys.argv[2:] if not a.startswith("--")]
+        use_pallas = "--pallas" in sys.argv[2:]
+        d_arg = int(pos[0])
+        n_arg = int(pos[1]) if len(pos) > 1 else 32768
         # CPU-only boxes (JAX_PLATFORMS=cpu): force d virtual host devices
         # BEFORE the first jax import, same mechanism as tests/conftest.py.
         if os.environ.get("JAX_PLATFORMS", "") == "cpu":
@@ -810,8 +924,25 @@ if __name__ == "__main__":
                 run_metadata(seed=0),
             )
         else:
-            out = _measure_shard_map(d_arg, n_arg)
+            out = _measure_shard_map(d_arg, n_arg, pallas=use_pallas)
             row = make_row("bench_shard_map", out, run_metadata(seed=0))
+            # Stamp throughput + ns/member onto a probe-attempt row too
+            # (same discipline as --serve's SLO percentiles): the probe
+            # history is the long-lived per-round record, so the
+            # per-member cost trend shows up in the same timeline as
+            # outages.
+            _record_probe_attempt(
+                2,
+                None,
+                time.monotonic() - t_probe,
+                extra={
+                    "scenario": "shard_map",
+                    "engine": out["engine"],
+                    "shards": d_arg,
+                    "n_members": n_arg,
+                    "member_rounds_per_sec": out["value"],
+                },
+            )
         try:
             append_jsonl(
                 os.path.join(
@@ -824,6 +955,73 @@ if __name__ == "__main__":
         except Exception:
             pass
         print(jsonl_line(row), flush=True)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--persistent-ksweep":
+        try:
+            from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+            enable_repo_jax_cache()
+        except Exception:
+            pass
+        from scalecube_cluster_tpu.obs.export import (
+            append_jsonl,
+            jsonl_line,
+            make_row,
+            run_metadata,
+        )
+
+        pos = [a for a in sys.argv[2:] if not a.startswith("--")]
+        n_arg = int(pos[0]) if pos else 4096
+        k_arg = int(pos[1]) if len(pos) > 1 else 8
+        # One recorded backend probe first (same discipline as --shard-map:
+        # outage budget must leave evidence in bench_history.jsonl).
+        t_probe = time.monotonic()
+        probe_err = _probe_once()
+        _record_probe_attempt(1, probe_err, time.monotonic() - t_probe)
+        if probe_err is not None:
+            rows = [
+                make_row(
+                    "bench_persistent",
+                    {
+                        "error": probe_err,
+                        "n_members": n_arg,
+                        "k_max": k_arg,
+                        **_self_evidence(),
+                    },
+                    run_metadata(seed=0),
+                )
+            ]
+        else:
+            sweep = _measure_persistent_ksweep(n_arg, k_max=k_arg)
+            rows = [
+                make_row("bench_persistent", r, run_metadata(seed=0))
+                for r in sweep
+            ]
+            best = max(sweep, key=lambda r: r["value"])
+            _record_probe_attempt(
+                2,
+                None,
+                time.monotonic() - t_probe,
+                extra={
+                    "scenario": "persistent_ksweep",
+                    "n_members": n_arg,
+                    "k": best["k"],
+                    "k_max": k_arg,
+                    "member_rounds_per_sec": best["value"],
+                },
+            )
+        try:
+            append_jsonl(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "artifacts",
+                    "bench_history.jsonl",
+                ),
+                rows,
+            )
+        except Exception:
+            pass
+        for row in rows:
+            print(jsonl_line(row), flush=True)
     elif len(sys.argv) >= 2 and sys.argv[1] == "--serve":
         try:
             from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache

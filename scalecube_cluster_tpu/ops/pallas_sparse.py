@@ -57,6 +57,8 @@ scalar-prefetch memory small at 32k members.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -100,35 +102,28 @@ def pack_slots(fd_slot, sy_slot):
     return (fd_slot + 1) | ((sy_slot + 1) << SLOT_BITS)
 
 
-def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale, sweep, fold):
+def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale, sweep, fold, has_base=False):
     b = SPARSE_GROUP
     fp = "points" in fold
     fc = "countdown" in fold
     fw = "wb_mask" in fold
     fr = "view_rows" in fold
 
-    def kernel(
-        ginv_ref,
-        rot_ref,
-        flags_ref,
-        slots_ref,
-        fdk_ref,
-        syk_ref,
-        slab_hbm_ref,
-        age_hbm_ref,
-        subj_ref,
-        slab_ref,
-        age_ref,
-        susp_ref,
-        slab2_ref,
-        age2_ref,
-        susp2_ref,
-        self_ref,
-        aggr_ref,
-        wslab,
-        wage,
-        sems,
-    ):
+    def kernel(*refs):
+        if has_base:
+            # ``row_base`` rides a 7th scalar-prefetch lane: under shard_map
+            # the local block rows are GLOBAL members lo..lo+nl-1 while the
+            # grid indexes local rows, so own-column detection needs the
+            # shard offset (traced — it comes off jax.lax.axis_index).
+            (ginv_ref, rot_ref, flags_ref, slots_ref, fdk_ref, syk_ref,
+             base_ref, *rest) = refs
+        else:
+            (ginv_ref, rot_ref, flags_ref, slots_ref, fdk_ref, syk_ref,
+             *rest) = refs
+            base_ref = None
+        (slab_hbm_ref, age_hbm_ref, subj_ref, slab_ref, age_ref, susp_ref,
+         slab2_ref, age2_ref, susp2_ref, self_ref, aggr_ref,
+         wslab, wage, sems) = rest
         i = pl.program_id(0)
 
         def dma(block, slot, c):
@@ -222,6 +217,8 @@ def _kernel_factory(f, nb, s, spread, susp_ticks, age_stale, sweep, fold):
 
         # Self-rumor channel (receiver == slot's subject), then exclusion.
         row_ids = jax.lax.broadcasted_iota(jnp.int32, (b, s), 0) + i * b
+        if has_base:
+            row_ids = row_ids + base_ref[0]
         own = subj_lane == row_ids
         self_vals = jnp.max(jnp.where(own, best_any, -1), axis=1)
         self_ref[...] = jnp.broadcast_to(self_vals.reshape(b, 1), (b, 128))
@@ -323,6 +320,9 @@ def sparse_core_pallas(
     sweep=0,
     fold=frozenset({"countdown"}),
     interpret=None,
+    row_base=None,
+    slab_windows=None,
+    age_windows=None,
 ):
     """Fused sparse tick core with the residual-fold ladder.
 
@@ -334,9 +334,11 @@ def sparse_core_pallas(
       slab/age/susp: post-load working set ``[N, S]`` — PRE point update
         when ``'points' in fold`` (the kernel applies them), post-point
         otherwise (caller applied them, round-5 behavior).
-      slot_subj: ``[S]`` int32 subject of each slot (-1 free).
+      slot_subj: ``[S]`` int32 subject of each slot (-1 free). GLOBAL
+        subject ids when ``row_base`` is given (shard_map caller).
       ginv, rots: structured fan-out with ``group=SPARSE_GROUP``,
-        ``[f, N/32]``.
+        ``[f, N/32]``. When ``slab_windows`` is given, ``ginv`` indexes
+        32-row blocks of the WINDOW array, not members.
       edge_ok: ``[f, N]`` bool. alive: ``[N]`` bool.
       fd_slot/sy_slot: ``[N]`` int32 — this tick's point-update slot per
         viewer (-1 = none), for the rearm/changed correction.
@@ -347,6 +349,14 @@ def sparse_core_pallas(
         tombstone sweep itself still happens at write-back, not here).
       fold: subset of :data:`FOLD_PIECES`; 'wb_mask'/'view_rows' require
         'countdown' (they aggregate the swept arrays).
+      row_base: optional traced int32 scalar — global member id of local
+        row 0, for own-column detection inside shard_map (default 0).
+      slab_windows/age_windows: optional pre-assembled sender windows
+        (``[W, S]`` int32 / int8, W a multiple of 32) replacing the
+        default whole-slab HBM source for the window DMAs. The shard_map
+        caller builds these from the gossip exchange (remote senders are
+        not in the local slab); ``age_windows`` is all-zeros there since
+        shipped rows are already young-masked sender-side.
     """
     n, s = slab.shape
     f = ginv.shape[0]
@@ -364,6 +374,27 @@ def sparse_core_pallas(
         raise ValueError(f"unknown fold pieces {sorted(unknown)}")
     if ("wb_mask" in fold or "view_rows" in fold) and "countdown" not in fold:
         raise ValueError("'wb_mask'/'view_rows' require 'countdown'")
+    if (slab_windows is None) != (age_windows is None):
+        raise ValueError("slab_windows and age_windows must be given together")
+    if slab_windows is not None:
+        if "points" in fold:
+            # The window point-override reads sender fd/sy slots from local
+            # SMEM; caller-built windows carry REMOTE senders whose slots
+            # are not addressable here — the shard_map caller applies
+            # points in XLA before assembling the windows.
+            raise ValueError(
+                "'points' cannot fold with caller-built sender windows"
+            )
+        if (
+            slab_windows.ndim != 2
+            or slab_windows.shape[1] != s
+            or slab_windows.shape[0] % SPARSE_GROUP != 0
+            or age_windows.shape != slab_windows.shape
+        ):
+            raise ValueError(
+                f"sender windows must be [32m, {s}] pairs, got "
+                f"{slab_windows.shape} / {age_windows.shape}"
+            )
     if fd_key is None:
         fd_key = jnp.zeros_like(fd_slot)
     if sy_key is None:
@@ -372,9 +403,10 @@ def sparse_core_pallas(
         interpret = jax.default_backend() != "tpu"
     nb = n // SPARSE_GROUP
     b = SPARSE_GROUP
+    has_base = row_base is not None
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=6,
+        num_scalar_prefetch=7 if has_base else 6,
         grid=(nb,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),  # slab windows
@@ -397,8 +429,23 @@ def sparse_core_pallas(
             pltpu.SemaphoreType.DMA((2, f, 2)),
         ],
     )
+    scalars = [
+        ginv,
+        rots,
+        pack_flags(edge_ok, alive),
+        pack_slots(fd_slot, sy_slot),
+        fd_key,
+        sy_key,
+    ]
+    if has_base:
+        scalars.append(jnp.asarray(row_base, jnp.int32).reshape(1))
+    win_slab = slab if slab_windows is None else slab_windows
+    win_age = age if age_windows is None else age_windows
     slab2, age2, susp2, self_pad, aggr = pl.pallas_call(
-        _kernel_factory(f, nb, s, spread, susp_ticks, age_stale, sweep, fold),
+        _kernel_factory(
+            f, nb, s, spread, susp_ticks, age_stale, sweep, fold,
+            has_base=has_base,
+        ),
         grid_spec=grid_spec,
         out_shape=[
             jax.ShapeDtypeStruct((n, s), jnp.int32),
@@ -409,17 +456,475 @@ def sparse_core_pallas(
         ],
         interpret=interpret,
     )(
-        ginv,
-        rots,
-        pack_flags(edge_ok, alive),
-        pack_slots(fd_slot, sy_slot),
-        fd_key,
-        sy_key,
-        slab,
-        age,
+        *scalars,
+        win_slab,
+        win_age,
         jnp.broadcast_to(slot_subj[None, :], (8, s)),
         slab,
         age,
         susp,
     )
     return slab2, age2, susp2, self_pad[:, 0], aggr[0]
+
+
+# --------------------------------------------------------------------------
+# Persistent multi-tick kernel (round 7): the scan moves INTO the kernel.
+# --------------------------------------------------------------------------
+
+#: Max suspicion countdown representable in the packed cold lane (7 bits:
+#: the int16 must stay non-negative with age in the low byte).
+COLD_SUSP_MAX = 127
+
+
+def pack_cold(age, susp):
+    """Pack int8 age (0..AGE_STALE) + susp (0..:data:`COLD_SUSP_MAX`) into
+    one int16 lane: ``age | susp << 8``.
+
+    Halves the cold per-slot working set the persistent kernel streams
+    (3 B/cell → 2 B/cell) and is the checkpoint wire form behind
+    ``save_sparse_checkpoint(pack_cold=True)``. Values stay < 2**15 so the
+    int16 is non-negative and unpacking needs no sign fixup.
+    """
+    return (
+        (age.astype(jnp.int32) & 0xFF) | (susp.astype(jnp.int32) << 8)
+    ).astype(jnp.int16)
+
+
+def unpack_cold(cold):
+    """Inverse of :func:`pack_cold` → ``(age int8, susp int16)``."""
+    c32 = cold.astype(jnp.int32)
+    return (c32 & 0xFF).astype(jnp.int8), (c32 >> 8).astype(jnp.int16)
+
+
+def _persistent_factory(f, nb, s, spread, susp_ticks, age_stale, sweep, fold):
+    b = SPARSE_GROUP
+    fw = "wb_mask" in fold
+    fr = "view_rows" in fold
+
+    def kernel(
+        kk_ref,       # (1,) ticks to run this launch (traced, <= k_max)
+        ginv_ref,     # (k_max, f, nb) window-block index per tick
+        rot_ref,      # (k_max, f, nb)
+        flags_ref,    # (k_max, n) pack_flags per tick
+        slab_in_ref,  # ANY [n, s] int32 — tick-0 source
+        cold_in_ref,  # ANY [n, s] int16 packed (age | susp << 8)
+        subj_ref,     # (8, s) slot_subj lanes (revisited, constant)
+        slab_a_ref,   # ANY outs: ping-pong A (even ticks write here)
+        cold_a_ref,
+        slab_b_ref,   # ping-pong B (odd ticks write here)
+        cold_b_ref,
+        self_ref,     # ANY [n, 128] — last tick's self-rumor column
+        aggr_ref,     # ANY [8, s] — last tick's per-slot aggregate
+        wslab,        # VMEM (2, f, b, s) int32 window scratch
+        wcold,        # VMEM (2, f, b, s) int16
+        lslab,        # VMEM (2, b, s) int32 local-block scratch
+        lcold,        # VMEM (2, b, s) int16
+        oslab,        # VMEM (b, s) int32 outbound staging
+        ocold,        # VMEM (b, s) int16
+        sscr,         # VMEM (b, 128) int32 self staging
+        ascr,         # VMEM (8, s) int32 aggregate accumulator
+        rsem,         # DMA (2, f + 1, 2) read sems [slot, chan|local, kind]
+        wsem,         # DMA (2, 2) write sems [dst a/b, kind]
+        osem,         # DMA (2,) self/aggr sems
+    ):
+        t = pl.program_id(0)
+        i = pl.program_id(1)
+        kk = kk_ref[0]
+
+        def read_copies(src_slab, src_cold, block, slot):
+            copies = []
+            for c in range(f):
+                base = ginv_ref[t, c, block] * b
+                copies.append(
+                    pltpu.make_async_copy(
+                        src_slab.at[pl.ds(base, b)],
+                        wslab.at[slot, c],
+                        rsem.at[slot, c, 0],
+                    )
+                )
+                copies.append(
+                    pltpu.make_async_copy(
+                        src_cold.at[pl.ds(base, b)],
+                        wcold.at[slot, c],
+                        rsem.at[slot, c, 1],
+                    )
+                )
+            copies.append(
+                pltpu.make_async_copy(
+                    src_slab.at[pl.ds(block * b, b)],
+                    lslab.at[slot],
+                    rsem.at[slot, f, 0],
+                )
+            )
+            copies.append(
+                pltpu.make_async_copy(
+                    src_cold.at[pl.ds(block * b, b)],
+                    lcold.at[slot],
+                    rsem.at[slot, f, 1],
+                )
+            )
+            return copies
+
+        def start_reads(block, slot):
+            # Tick 0 reads the launch inputs; tick t >= 1 reads the buffer
+            # tick t-1 wrote (even writers fill A, so odd ticks read A).
+            # Exactly one branch fires, all into the same scratch/sems.
+            @pl.when(t == 0)
+            def _():
+                for cp in read_copies(slab_in_ref, cold_in_ref, block, slot):
+                    cp.start()
+
+            @pl.when((t > 0) & (t % 2 == 1))
+            def _():
+                for cp in read_copies(slab_a_ref, cold_a_ref, block, slot):
+                    cp.start()
+
+            @pl.when((t > 0) & (t % 2 == 0))
+            def _():
+                for cp in read_copies(slab_b_ref, cold_b_ref, block, slot):
+                    cp.start()
+
+        @pl.when(t < kk)
+        def _run():
+            slot = i % 2
+
+            # Tick-boundary bubble is deliberate: block 0 of tick t cannot
+            # prefetch during tick t-1 (its source is what t-1 is writing).
+            @pl.when(i == 0)
+            def _():
+                start_reads(0, 0)
+
+            @pl.when(i + 1 < nb)
+            def _():
+                start_reads(i + 1, (i + 1) % 2)
+
+            # Waits only consume semaphore counts, which are source-
+            # independent (every candidate source has the same shape), so
+            # the descriptors are rebuilt against the launch inputs.
+            for cp in read_copies(slab_in_ref, cold_in_ref, i, slot):
+                cp.wait()
+
+            lane_ids = jax.lax.broadcasted_iota(jnp.int32, (b, s), 1)
+            subj_lane = subj_ref[0:1, :]
+            active_lane = subj_lane >= 0
+            flags = jnp.stack(
+                [flags_ref[t, i * b + r] for r in range(b)]
+            ).reshape(b, 1)
+
+            best_any = jnp.full((b, s), -1, jnp.int32)
+            best_alive = best_any
+            for c in range(f):
+                rot = rot_ref[t, c, i]
+                w32 = wslab[slot, c]
+                # Widen + unpack the cold lane BEFORE the roll (Mosaic's
+                # dynamic rotate lowers for 32-bit lanes only).
+                wa32 = wcold[slot, c].astype(jnp.int32) & 0xFF
+                w = pltpu.roll(w32, shift=b - rot, axis=0)
+                wa = pltpu.roll(wa32, shift=b - rot, axis=0)
+                young_w = wa < spread
+                payload = jnp.where(young_w & active_lane, w, -1)
+                ok = ((flags >> c) & 1) != 0
+                contrib = jnp.where(ok, payload, -1)
+                best_any = jnp.maximum(best_any, contrib)
+                best_alive = jnp.maximum(
+                    best_alive, jnp.where(is_alive_key(contrib), contrib, -1)
+                )
+
+            local = lslab[slot]
+            lc32 = lcold[slot].astype(jnp.int32)
+            age0 = lc32 & 0xFF
+            s_loc = lc32 >> 8
+
+            row_ids = jax.lax.broadcasted_iota(jnp.int32, (b, s), 0) + i * b
+            own = subj_lane == row_ids
+            self_vals = jnp.max(jnp.where(own, best_any, -1), axis=1)
+            best_any = jnp.where(own, -1, best_any)
+            best_alive = jnp.where(own, -1, best_alive)
+
+            merged = _merge_rows(local, best_any, best_alive)
+            merged = jnp.where(active_lane, merged, local)
+            alive_row = ((flags >> ALIVE_BIT) & 1) != 0
+            merged = jnp.where(alive_row, merged, local)
+
+            # In-kernel sweep (the plain-tick core has no point updates, so
+            # rearm/changed compare directly against the local block).
+            armed = s_loc > 0
+            rearm = merged != local
+            left0 = jnp.maximum(s_loc - 1, 0)
+            expired = (
+                alive_row
+                & armed
+                & ~rearm
+                & (left0 == 0)
+                & ((merged & DEAD_BIT) == 0)
+                & ((merged & 1) != 0)
+                & (merged >= 0)
+            )
+            slab2 = jnp.where(
+                expired, (merged | DEAD_BIT) & ~jnp.int32(1), merged
+            )
+            changed = (slab2 != local) & alive_row & active_lane
+            age2 = jnp.where(changed, 0, jnp.minimum(age0, age_stale - 1) + 1)
+            is_susp = ((slab2 & 1) != 0) & ((slab2 & DEAD_BIT) == 0) & (slab2 >= 0)
+            susp2 = jnp.where(
+                is_susp & active_lane,
+                jnp.where(rearm | ~armed, susp_ticks, left0),
+                0,
+            )
+            susp2 = jnp.where(alive_row, susp2, s_loc)
+
+            oslab[...] = slab2
+            ocold[...] = ((age2 & 0xFF) | (susp2 << 8)).astype(jnp.int16)
+
+            def write_copies(dst_slab, dst_cold, d):
+                return [
+                    pltpu.make_async_copy(
+                        oslab, dst_slab.at[pl.ds(i * b, b)], wsem.at[d, 0]
+                    ),
+                    pltpu.make_async_copy(
+                        ocold, dst_cold.at[pl.ds(i * b, b)], wsem.at[d, 1]
+                    ),
+                ]
+
+            # Synchronous commit (start + wait in this grid step): the
+            # sequential grid then guarantees tick t is fully in its dst
+            # buffer before tick t+1's first read DMA issues. Writes go
+            # ONLY to the non-source buffer — the launcher picks the final
+            # buffer by k's parity, so no last-tick double-write races the
+            # window prefetches still reading the source.
+            @pl.when(t % 2 == 0)
+            def _():
+                for cp in write_copies(slab_a_ref, cold_a_ref, 0):
+                    cp.start()
+                for cp in write_copies(slab_a_ref, cold_a_ref, 0):
+                    cp.wait()
+
+            @pl.when(t % 2 == 1)
+            def _():
+                for cp in write_copies(slab_b_ref, cold_b_ref, 1):
+                    cp.start()
+                for cp in write_copies(slab_b_ref, cold_b_ref, 1):
+                    cp.wait()
+
+            # Last tick only: self-rumor column + per-slot aggregates, the
+            # same outputs a single-tick launch would hand back.
+            @pl.when(t == kk - 1)
+            def _():
+                sscr[...] = jnp.broadcast_to(self_vals.reshape(b, 1), (b, 128))
+                cp = pltpu.make_async_copy(
+                    sscr, self_ref.at[pl.ds(i * b, b)], osem.at[0]
+                )
+                cp.start()
+                cp.wait()
+
+                red = jnp.zeros((1, s), jnp.int32)
+
+                def anyrow(m):
+                    return jnp.max(m.astype(jnp.int32), axis=0, keepdims=True)
+
+                if fw:
+                    dead2 = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
+                    stale_done = age2 > sweep
+                    holding = (
+                        (age2 < spread)
+                        | (susp2 > 0)
+                        | (dead2 & ~stale_done & ~own)
+                    )
+                    red = red | (anyrow(holding & alive_row) << AGGR_HOLD_BIT)
+                if fr:
+                    dead2 = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
+                    is_s2 = ((slab2 & 1) != 0) & ~dead2 & (slab2 >= 0)
+                    red = red | (anyrow(is_s2 & alive_row) << AGGR_SUSPECT_BIT)
+                    red = red | (anyrow(dead2 & alive_row) << AGGR_DEAD_BIT)
+                blk = jnp.broadcast_to(red, (8, s))
+
+                @pl.when(i == 0)
+                def _():
+                    ascr[...] = blk
+
+                @pl.when(i > 0)
+                def _():
+                    ascr[...] = ascr[...] | blk
+
+                @pl.when(i == nb - 1)
+                def _():
+                    cp2 = pltpu.make_async_copy(ascr, aggr_ref, osem.at[1])
+                    cp2.start()
+                    cp2.wait()
+
+    return kernel
+
+
+def sparse_core_pallas_persistent(
+    slab,
+    age,
+    susp,
+    slot_subj,
+    ginv,
+    rots,
+    edge_ok,
+    alive,
+    k,
+    *,
+    spread,
+    susp_ticks,
+    age_stale,
+    sweep=0,
+    k_max=8,
+    fold=frozenset({"countdown"}),
+    interpret=None,
+):
+    """Persistent fused core: ONE launch steps ``k`` plain sparse ticks.
+
+    Bit-identical to ``k`` chained :func:`sparse_core_pallas` launches with
+    ``fd_slot = sy_slot = -1`` (the plain-tick core has no FD/SYNC point
+    updates) and the same per-tick fan-out/edge inputs — the contract
+    tests/test_sparse.py pins. State ping-pongs between two HBM buffer
+    pairs by tick parity (reads and writes never share a buffer), with the
+    cold per-slot state (age, suspicion countdown) bit-packed into one
+    int16 lane (:func:`pack_cold`) to shrink the streamed working set.
+
+    ``k`` is TRACED (the grid is sized by the static ``k_max``; ticks past
+    ``k`` are skipped via ``pl.when``), so one executable covers every
+    ``1 <= k <= k_max`` — the zero-recompile contract bench.py sweeps.
+    Scalar-prefetch SMEM holds ``k_max`` ticks of fan-out + packed flags
+    (~``k_max * n * 12`` bytes), which bounds ``k_max`` at large n.
+
+    Args:
+      slab/age/susp, slot_subj: as :func:`sparse_core_pallas`; ``susp``
+        must not exceed :data:`COLD_SUSP_MAX` anywhere (packed lane).
+      ginv/rots: ``[k_max, f, N/32]`` per-tick structured fan-out.
+      edge_ok: ``[k_max, f, N]`` per-tick edge gates. alive: ``[N]``.
+      k: traced int32 scalar, 1 <= k <= k_max.
+      fold: must contain 'countdown' (the sweep lives in-kernel; there is
+        no per-tick XLA fallback inside a persistent launch) and must not
+        contain 'points'; 'wb_mask'/'view_rows' shape only the LAST tick's
+        aggregate output.
+
+    Returns ``(slab2, age2, susp2, self_rumor, aggr)`` — final state plus
+    the last tick's self-rumor column and aggregate.
+    """
+    n, s = slab.shape
+    if ginv.ndim != 3 or ginv.shape[0] != k_max:
+        raise ValueError(f"ginv must be [k_max={k_max}, f, n/32], got {ginv.shape}")
+    _, f, _ = ginv.shape
+    if n % SPARSE_GROUP != 0:
+        raise ValueError(f"n={n} not a multiple of {SPARSE_GROUP}")
+    if s % 128 != 0:
+        raise ValueError(f"S={s} not a multiple of 128")
+    fold = frozenset(fold)
+    unknown = fold - set(FOLD_PIECES)
+    if unknown:
+        raise ValueError(f"unknown fold pieces {sorted(unknown)}")
+    if "countdown" not in fold:
+        raise ValueError(
+            "the persistent kernel sweeps in-kernel: 'countdown' must fold"
+        )
+    if "points" in fold:
+        raise ValueError(
+            "the persistent kernel steps plain ticks only ('points' is a "
+            "protocol-tick fold — run those through sparse_core_pallas)"
+        )
+    if susp_ticks > COLD_SUSP_MAX:
+        raise ValueError(
+            f"susp_ticks={susp_ticks} > {COLD_SUSP_MAX} overflows the "
+            "packed int16 cold lane"
+        )
+    if isinstance(k, int) and not 1 <= k <= k_max:  # tpulint: disable=R1 -- isinstance guard: k is a host int on this branch, traced k skips it
+        raise ValueError(f"k={k} must be in [1, k_max={k_max}]")
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    nb = n // SPARSE_GROUP
+    b = SPARSE_GROUP
+
+    cold = pack_cold(age, susp)
+    flags_all = jnp.stack([pack_flags(edge_ok[t], alive) for t in range(k_max)])
+    kk = jnp.asarray(k, jnp.int32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=4,
+        grid=(k_max, nb),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),  # slab_in
+            pl.BlockSpec(memory_space=pl.ANY),  # cold_in
+            pl.BlockSpec((8, s), lambda t, i, *_: (0, 0)),  # slot_subj lanes
+        ],
+        out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 6,
+        scratch_shapes=[
+            pltpu.VMEM((2, f, b, s), jnp.int32),
+            pltpu.VMEM((2, f, b, s), jnp.int16),
+            pltpu.VMEM((2, b, s), jnp.int32),
+            pltpu.VMEM((2, b, s), jnp.int16),
+            pltpu.VMEM((b, s), jnp.int32),
+            pltpu.VMEM((b, s), jnp.int16),
+            pltpu.VMEM((b, 128), jnp.int32),
+            pltpu.VMEM((8, s), jnp.int32),
+            pltpu.SemaphoreType.DMA((2, f + 1, 2)),
+            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+    )
+    slab_a, cold_a, slab_b, cold_b, self_pad, aggr = pl.pallas_call(
+        _persistent_factory(f, nb, s, spread, susp_ticks, age_stale, sweep, fold),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((n, s), jnp.int32),
+            jax.ShapeDtypeStruct((n, s), jnp.int16),
+            jax.ShapeDtypeStruct((n, s), jnp.int32),
+            jax.ShapeDtypeStruct((n, s), jnp.int16),
+            jax.ShapeDtypeStruct((n, 128), jnp.int32),
+            jax.ShapeDtypeStruct((8, s), jnp.int32),
+        ],
+        interpret=interpret,
+    )(
+        kk,
+        ginv,
+        rots,
+        flags_all,
+        slab,
+        cold,
+        jnp.broadcast_to(slot_subj[None, :], (8, s)),
+    )
+    # Last tick k-1 wrote A when even (k odd), B when odd (k even).
+    k_odd = (jnp.asarray(k, jnp.int32) % 2) == 1
+    slab_fin = jnp.where(k_odd, slab_a, slab_b)
+    age_fin, susp_fin = unpack_cold(jnp.where(k_odd, cold_a, cold_b))
+    return slab_fin, age_fin, susp_fin, self_pad[:, 0], aggr[0]
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "spread", "susp_ticks", "age_stale", "sweep", "k_max", "fold",
+        "interpret",
+    ),
+)
+def run_sparse_core_persistent(
+    slab,
+    age,
+    susp,
+    slot_subj,
+    ginv,
+    rots,
+    edge_ok,
+    alive,
+    k,
+    *,
+    spread,
+    susp_ticks,
+    age_stale,
+    sweep=0,
+    k_max=8,
+    fold=frozenset({"countdown"}),
+    interpret=None,
+):
+    """Jitted entry for :func:`sparse_core_pallas_persistent`.
+
+    ``k`` stays traced, so ONE executable serves every k in [1, k_max] —
+    the bench.py k-sweep pins this with ``jit_cache_size``.
+    """
+    return sparse_core_pallas_persistent(
+        slab, age, susp, slot_subj, ginv, rots, edge_ok, alive, k,
+        spread=spread, susp_ticks=susp_ticks, age_stale=age_stale,
+        sweep=sweep, k_max=k_max, fold=fold, interpret=interpret,
+    )

@@ -14,6 +14,12 @@ permute XLA lowers to ICI all-to-alls; the SYNC reply gather
 tick is host-side, so one jit of ``run_ticks`` with these shardings is the
 whole multi-chip story (multi-slice over DCN works the same way with a
 larger mesh).
+
+The same :func:`make_mesh` 1D ``members`` mesh also carries the explicit-SPMD
+engine (parallel/spmd.py) — there the tick is hand-written under ``shard_map``
+instead of partitioner-inferred, and since round 7 each shard's [n/d, S] core
+may itself be the fused Pallas kernel (``SparseParams.pallas_core=True``); the
+mesh object is shared, only the program around it differs.
 """
 
 from __future__ import annotations

@@ -37,8 +37,18 @@ sim/sparse.py::sparse_tick bit-for-bit (tests/test_spmd.py pins clean,
 scheduled-fault, and knobbed timelines at n=2048 on 8 virtual devices;
 testlib/certify.py runs it as an extra engine through the full cadence).
 
-Scope: XLA tick core only (``pallas_core=False``) with in-scan write-back;
-the per-shard Pallas launch is a follow-on (ROADMAP).
+Tick core: XLA or the fused Pallas kernel (``pallas_core=True``) — the
+per-shard ``[N/d, S]`` problem is exactly the single-device problem
+ops/pallas_sparse.py already solves, so the kernel runs INSIDE each shard
+with the 3 exchanges staying outside: the receiver assembles its senders'
+un-rotated gossip blocks from the bucket exchange into a ``[f·nl, S]``
+window array and the kernel DMAs/rolls/merges/sweeps per 32-row block,
+per the residual-fold ladder (``pallas_fold``). FD/SYNC point updates
+always stay in XLA here (the exchange ships post-point rows), and traced
+knobs drop the countdown folds back to the XLA sweep — both already
+bit-certified modes of the single-device ladder. The XLA shard_map
+program remains the bit-exact oracle (tests/test_spmd.py pins the pallas
+engine against it at n=2048, clean + scheduled + knobbed).
 """
 
 from __future__ import annotations
@@ -92,7 +102,13 @@ from scalecube_cluster_tpu.sim.state import AGE_STALE
 from scalecube_cluster_tpu.sim.tick import _acct_add, _acct_zero, _link_acct
 from scalecube_cluster_tpu.sim.usergossip import ring_record, user_gossip_finish
 from scalecube_cluster_tpu.ops.merge import EPOCH_MAX
-from scalecube_cluster_tpu.ops.pallas_sparse import SPARSE_GROUP
+from scalecube_cluster_tpu.ops.pallas_sparse import (
+    AGGR_DEAD_BIT,
+    AGGR_HOLD_BIT,
+    AGGR_SUSPECT_BIT,
+    SPARSE_GROUP,
+    sparse_core_pallas,
+)
 
 
 @dataclass(frozen=True)
@@ -123,11 +139,24 @@ def _validate(params: SparseParams, cfg: ShardConfig) -> None:
     n = params.base.n
     group = _sparse_group(n)
     if params.pallas_core:
-        raise ValueError(
-            "the explicit-SPMD engine runs the XLA tick core only for now "
-            "(set pallas_core=False); the per-shard Pallas launch is a "
-            "ROADMAP follow-on"
-        )
+        # The per-shard kernel launch supports every protocol mode (points
+        # stay in XLA; knobbed runs drop the countdown folds — see
+        # _tick_spmd); only the kernel's GEOMETRY constraints remain.
+        if group != SPARSE_GROUP:
+            raise ValueError(
+                f"pallas_core under explicit SPMD needs n={n} to be a "
+                f"multiple of {SPARSE_GROUP} (the fused kernel's 32-row "
+                "sender groups; smaller n falls back to group-8 fan-out, "
+                "which the int8 age windows cannot tile — set "
+                "pallas_core=False)"
+            )
+        if params.slot_budget % 128 != 0 or params.slot_budget >= 4096:
+            raise ValueError(
+                "pallas_core under explicit SPMD needs a kernel-tileable "
+                "slot budget (S % 128 == 0 and S < 4096), got "
+                f"S={params.slot_budget} — set pallas_core=False or "
+                "resize slot_budget"
+            )
     if not params.in_scan_writeback:
         raise ValueError(
             "explicit-SPMD needs in_scan_writeback=True (the host-boundary "
@@ -236,19 +265,40 @@ def _apply_events_local(params, st, kill_mask, restart_mask, cut):
 def _free_plan_spmd(params, st, col, gate):
     """sim/sparse.py::_free_plan with the any-over-viewers pin reduced
     across shards (one psum; integer, order-free). Returns replicated
-    ``(freeing [S], wb_subj [S])`` plus the shard-local demoted slab."""
+    ``(freeing [S], wb_subj [S])`` plus the shard-local demoted slab.
+
+    Round-7 'wb_mask' fold: when the kernel carried a valid pin mask from
+    the previous tick (replicated — the carry psums it at write time), the
+    cond picks it on every shard identically and the [nl, S] pin sweep is
+    skipped; the psum of ``d`` replicated copies is ``d·v > 0 ⇔ v``, so
+    the result is bit-identical to the recompute branch. The psum stays
+    OUTSIDE the cond (collectives cannot sit inside a traced branch)."""
     p = params.base
     n = p.n
     active = st.slot_subj >= 0
     own_row = col[:, None] == st.slot_subj[None, :]  # local viewers × slots
     dead_rec = ((st.slab & DEAD_BIT) != 0) & (st.slab >= 0)
     stale_done = st.age.astype(jnp.int32) > p.periods_to_sweep
-    holding = (
-        (st.age < p.periods_to_spread)
-        | (st.susp > 0)
-        | (dead_rec & ~stale_done & ~own_row)
+
+    def recompute_hold_part():
+        holding = (
+            (st.age < p.periods_to_spread)
+            | (st.susp > 0)
+            | (dead_rec & ~stale_done & ~own_row)
+        )
+        return jnp.any(holding & st.alive[:, None], axis=0)  # [S] partial
+
+    use_carry = (
+        st.wb_pinned is not None
+        and params.pallas_core
+        and "wb_mask" in params.pallas_fold
     )
-    hold_part = jnp.any(holding & st.alive[:, None], axis=0)  # [S] partial
+    if use_carry:
+        hold_part = lax.cond(
+            st.wb_valid, lambda: st.wb_pinned, recompute_hold_part
+        )
+    else:
+        hold_part = recompute_hold_part()
     pinned = lax.psum(hold_part.astype(jnp.int32), AXIS) > 0
     freeing = active & ~pinned & gate
     wb_subj = jnp.where(freeing, st.slot_subj, n)
@@ -440,6 +490,21 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
             jnp.where(r_fire, jnp.asarray(0, jnp.int8), age[lrow, r_safe])
         )
 
+    # ---------------- core-path routing (round-7: Pallas inside shard_map)
+    # The per-shard launch reuses the single-device fold ladder with two
+    # standing adjustments, both already bit-certified modes of that
+    # ladder: 'points' never folds (the gossip exchange ships POST-point
+    # sender rows, so the XLA where-passes below stay authoritative and
+    # fd/sy_slot feed the kernel's rearm/changed correction), and traced
+    # knobs drop the countdown folds (the kernel bakes the suspicion fill
+    # as a static constant; edge knobs still fold — they ride edge_ok).
+    use_kernel = params.pallas_core
+    kfold = frozenset(params.pallas_fold) - {"points"} if use_kernel else frozenset()
+    if knobs is not None:
+        kfold = kfold - {"countdown", "wb_mask", "view_rows"}
+    need_wb = "wb_mask" in kfold
+    need_rows = "view_rows" in kfold
+
     # ------------------------------ 4. apply FD verdicts + SYNC learnings
     slab0 = slab
     fd_slot = jnp.where(fd_fire & (subj_slot[fd_tgt] >= 0), subj_slot[fd_tgt], -1)
@@ -531,6 +596,7 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
     got_u = jnp.zeros((nl, G), bool)
     uinf_ids, uptr = state.uinf_ids, state.uptr
     edge_ok_c = []
+    win_c = []  # kernel path: sender-row-order window blocks per channel
     for c in range(f):
         sg = ginv[c, rg]  # sender group feeding each of my receiver groups
         sshard = sg // ngl
@@ -541,7 +607,6 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         stag = blk.reshape(nl, S + G)
         rot = rots[c, rg][rotv_b]  # per-row rotation of my receiver groups
         r_idx = rotv_b * group + (col + rot) % group
-        sender_rows = stag[r_idx, :S]
         ug_flags = stag[r_idx, S:] > 0
         sid = group * sg[rotv_b] + (col + rot) % group  # global sender ids
         gpass = link_pass_from(cut(u_full[c]), plan, sid, col)
@@ -549,11 +614,18 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
             e_ok = e_ok & elive[c]
         edge_ok_c.append(e_ok)
-        contrib = jnp.where(e_ok[:, None], sender_rows, UNKNOWN_KEY)
-        best_any = jnp.maximum(best_any, contrib)
-        best_alive = jnp.maximum(
-            best_alive, jnp.where(is_alive_key(contrib), contrib, UNKNOWN_KEY)
-        )
+        if use_kernel:
+            # Window rows stay sender-indexed (un-rotated): the kernel's
+            # in-VMEM roll IS the r_idx un-rotation above.
+            win_c.append(stag[:, :S])
+        else:
+            sender_rows = stag[r_idx, :S]
+            contrib = jnp.where(e_ok[:, None], sender_rows, UNKNOWN_KEY)
+            best_any = jnp.maximum(best_any, contrib)
+            best_alive = jnp.maximum(
+                best_alive,
+                jnp.where(is_alive_key(contrib), contrib, UNKNOWN_KEY),
+            )
         # User gossip, same bucket: tracked records the pushing sender in
         # the suppression ring channel by channel (ring order matches the
         # oracle's sequential channel loop).
@@ -564,48 +636,107 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         else:
             got_u = got_u | (ug_flags & e_ok[:, None])
 
-    own_col = col[:, None] == slot_subj[None, :]
-    self_rumor = jnp.max(jnp.where(own_col, best_any, UNKNOWN_KEY), axis=1)
-    best_any = jnp.where(own_col, UNKNOWN_KEY, best_any)
-    best_alive = jnp.where(own_col, UNKNOWN_KEY, best_alive)
-    merged, _ = merge_views(slab, best_any, best_alive)
-    merged = jnp.where(active[None, :], merged, slab)
-    merged = jnp.where(alive[:, None], merged, slab)
+    aggr = None
+    merged = None  # non-None ⇒ the XLA sweep below owns step 6
+    if use_kernel:
+        # Per-shard fused launch. The exchange already delivered the
+        # young-masked POST-point sender rows, so the kernel's window
+        # source is the assembled [f·nl, S] block array with an identity
+        # routing table (channel c, receiver-group j → window block
+        # c·ngl + j) and an all-young synthetic age (the young mask was
+        # applied sender-side; undelivered/masked cells are already
+        # UNKNOWN). Local rows are global members lo..lo+nl-1, so
+        # row_base=lo keeps own-column detection global.
+        slab_win = jnp.concatenate(win_c, axis=0)
+        age_win = jnp.zeros(slab_win.shape, jnp.int8)
+        ginv_k = (
+            jnp.arange(f, dtype=jnp.int32)[:, None] * ngl
+            + jnp.arange(ngl, dtype=jnp.int32)[None, :]
+        )
+        core = sparse_core_pallas(
+            slab,
+            age,
+            susp_in,
+            slot_subj,
+            ginv_k,
+            rots[:, rg],
+            jnp.stack(edge_ok_c),
+            alive,
+            fd_slot,
+            sy_slot,
+            fd_key,
+            sy_key,
+            spread=p.periods_to_spread,
+            susp_ticks=p.suspicion_ticks,
+            age_stale=AGE_STALE,
+            sweep=p.periods_to_sweep,
+            fold=kfold,
+            row_base=lo,
+            slab_windows=slab_win,
+            age_windows=age_win,
+        )
+        if "countdown" in kfold:
+            slab2, age, susp, self_rumor, aggr = core
+        else:
+            # Ladder root off (e.g. knobbed runs): kernel = delivery+merge
+            # only; the XLA sweep below consumes ``merged``.
+            merged, _, _, self_rumor, aggr = core
+    else:
+        own_col = col[:, None] == slot_subj[None, :]
+        self_rumor = jnp.max(jnp.where(own_col, best_any, UNKNOWN_KEY), axis=1)
+        best_any = jnp.where(own_col, UNKNOWN_KEY, best_any)
+        best_alive = jnp.where(own_col, UNKNOWN_KEY, best_alive)
+        merged, _ = merge_views(slab, best_any, best_alive)
+        merged = jnp.where(active[None, :], merged, slab)
+        merged = jnp.where(alive[:, None], merged, slab)
 
-    # --------------------- 6. suspicion sweep (cancel-on-update form)
-    armed = susp_in > 0
-    rearm = merged != slab0
-    left0 = jnp.maximum(susp_in.astype(jnp.int32) - 1, 0)
-    expired = (
-        alive[:, None]
-        & armed
-        & ~rearm
-        & (left0 == 0)
-        & ((merged & DEAD_BIT) == 0)
-        & ((merged & 1) != 0)
-        & (merged >= 0)
-    )
-    dead_keys = (merged | DEAD_BIT) & ~jnp.int32(1)
-    slab2 = jnp.where(expired, dead_keys, merged)
-    changed = (slab2 != slab0) & alive[:, None] & active[None, :]
-    age = jnp.where(
-        changed,
-        jnp.asarray(0, jnp.int8),
-        jnp.minimum(age, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
-    )
-    is_susp = is_suspect_key(slab2)
-    susp = jnp.where(
-        is_susp & active[None, :],
-        jnp.where(rearm | ~armed, susp_fill, left0),
-        0,
-    ).astype(jnp.int16)
-    susp = jnp.where(alive[:, None], susp, susp_in)
+    if merged is not None:
+        # --------------------- 6. suspicion sweep (cancel-on-update form)
+        armed = susp_in > 0
+        rearm = merged != slab0
+        left0 = jnp.maximum(susp_in.astype(jnp.int32) - 1, 0)
+        expired = (
+            alive[:, None]
+            & armed
+            & ~rearm
+            & (left0 == 0)
+            & ((merged & DEAD_BIT) == 0)
+            & ((merged & 1) != 0)
+            & (merged >= 0)
+        )
+        dead_keys = (merged | DEAD_BIT) & ~jnp.int32(1)
+        slab2 = jnp.where(expired, dead_keys, merged)
+        changed = (slab2 != slab0) & alive[:, None] & active[None, :]
+        age = jnp.where(
+            changed,
+            jnp.asarray(0, jnp.int8),
+            jnp.minimum(age, AGE_STALE - 1) + jnp.asarray(1, jnp.int8),
+        )
+        is_susp = is_suspect_key(slab2)
+        susp = jnp.where(
+            is_susp & active[None, :],
+            jnp.where(rearm | ~armed, susp_fill, left0),
+            0,
+        ).astype(jnp.int16)
+        susp = jnp.where(alive[:, None], susp, susp_in)
+
+    # Per-slot aggregates from the kernel — LOCAL partials here (the kernel
+    # reduced over this shard's rows); they cross shards via the recorder
+    # psum / the wb-carry psum below. Post-core corrections accumulate the
+    # window-apply and refutation touches exactly as sim/sparse.py does.
+    if need_wb or need_rows:
+        pin_k = ((aggr >> AGGR_HOLD_BIT) & 1).astype(bool)
+        seen_s_k = ((aggr >> AGGR_SUSPECT_BIT) & 1).astype(bool)
+        seen_d_k = ((aggr >> AGGR_DEAD_BIT) & 1).astype(bool)
+    pin_extra = jnp.zeros((S,), bool)
+    seen_s_extra = jnp.zeros((S,), bool)
+    seen_d_extra = jnp.zeros((S,), bool)
 
     # ------------------------- 6.5 window SYNC application (cond-gated)
     if W > 0:
 
         def _apply_window(args):
-            slab_a, age_a, susp_a = args
+            slab_a, age_a, susp_a, pin_e, ss_e, sd_e = args
             wslot = subj_slot[wsubj]
             safe = jnp.where(wslot >= 0, wslot, 0)
             cur = slab_a[:, safe]
@@ -629,10 +760,28 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
                 susp_a[:, safe].astype(jnp.int32),
             ).astype(jnp.int16)
             susp_a = susp_a.at[:, route].set(new_susp, mode="drop")
-            return slab_a, age_a, susp_a
+            if need_wb or need_rows:
+                # Applied cells become young (age 0) at a live viewer, so
+                # their slot holds; the learned key may also be the slot's
+                # first suspect/dead record at a live viewer.
+                pin_e = pin_e.at[route].max(jnp.any(app, axis=0), mode="drop")
+                ss_e = ss_e.at[route].max(
+                    jnp.any(app & is_suspect_key(win_key), axis=0), mode="drop"
+                )
+                sd_e = sd_e.at[route].max(
+                    jnp.any(
+                        app & ((win_key & DEAD_BIT) != 0) & (win_key >= 0),
+                        axis=0,
+                    ),
+                    mode="drop",
+                )
+            return slab_a, age_a, susp_a, pin_e, ss_e, sd_e
 
-        slab2, age, susp = lax.cond(
-            do_sync, _apply_window, lambda a: a, (slab2, age, susp)
+        slab2, age, susp, pin_extra, seen_s_extra, seen_d_extra = lax.cond(
+            do_sync,
+            _apply_window,
+            lambda a: a,
+            (slab2, age, susp, pin_extra, seen_s_extra, seen_d_extra),
         )
 
     # --------------------------------------------------- 7. self-refutation
@@ -660,6 +809,13 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         jnp.where(threat, own_new, slab2[lrow, own_safe])
     )
     age = age.at[lrow, own_safe].set(jnp.where(threat, 0, age[lrow, own_safe]))
+    if need_wb:
+        # The refuted own record is young at a live viewer (threat ⇒ alive
+        # & has_own), pinning its slot. Refutation writes ALIVE keys, so
+        # the recorder masks need no correction here.
+        pin_extra = pin_extra.at[jnp.where(threat, own_slot, S)].max(
+            threat, mode="drop"
+        )
 
     # ------------------------------------------------- 8. user gossip finish
     if tracked:
@@ -676,11 +832,18 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
     # ------------------------- 9. verdict-latency recorder (structure-gated)
     lat_s, lat_d = state.lat_first_suspect, state.lat_first_dead
     if lat_s is not None:
-        live_rows = alive[:, None]
-        seen_s_part = jnp.any(is_suspect_key(slab2) & live_rows, axis=0)
-        seen_d_part = jnp.any(
-            ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0) & live_rows, axis=0
-        )
+        if need_rows:
+            # 'view_rows' fold: the kernel aggregate IS this shard's local
+            # partial (it reduced over local rows only); the psum below is
+            # the cross-shard combine either way.
+            seen_s_part = seen_s_k | seen_s_extra
+            seen_d_part = seen_d_k | seen_d_extra
+        else:
+            live_rows = alive[:, None]
+            seen_s_part = jnp.any(is_suspect_key(slab2) & live_rows, axis=0)
+            seen_d_part = jnp.any(
+                ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0) & live_rows, axis=0
+            )
         seen = lax.psum(
             jnp.stack([seen_s_part, seen_d_part]).astype(jnp.int32), AXIS
         ) > 0
@@ -695,7 +858,16 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
 
     wb_pinned, wb_valid = state.wb_pinned, state.wb_valid
     if wb_pinned is not None:
-        wb_valid = jnp.zeros((), bool)  # XLA core: mask stale, like the oracle
+        if need_wb:
+            # Replicated carry: combine local partials across shards now so
+            # the next free decision reads it without a collective. psum of
+            # d identical-per-slot 0/1 partials is exact (>0 ⇔ any shard).
+            wb_pinned = (
+                lax.psum((pin_k | pin_extra).astype(jnp.int32), AXIS) > 0
+            )
+            wb_valid = jnp.ones((), bool)
+        else:
+            wb_valid = jnp.zeros((), bool)  # XLA core: mask stale, like oracle
 
     new_state = state.replace(
         view_T=view_T,

@@ -94,13 +94,24 @@ def load_checkpoint(path: str | Path) -> tuple[SimState, SimParams]:
     return state, params
 
 
-def save_sparse_checkpoint(path: str | Path, state, params) -> None:
+_COLD_PACKED = "__cold_packed__"
+
+
+def save_sparse_checkpoint(path: str | Path, state, params, *, pack_cold=False) -> None:
     """Sparse-engine snapshot (sim/sparse.py::SparseState + SparseParams).
 
     Same .npz container as :func:`save_checkpoint`; the params JSON nests
     the base SimParams plus the working-set bounds.
+
+    ``pack_cold=True`` stores the cold per-cell state (``age`` int8 +
+    ``susp`` int16) as one int16 lane (ops/pallas_sparse.py::pack_cold) —
+    the persistent kernel's on-disk twin, 2 bytes/cell instead of 3.
+    Exact only while every countdown fits the packed field; out-of-range
+    values raise here rather than truncate silently.
     """
+    from scalecube_cluster_tpu.ops.pallas_sparse import COLD_SUSP_MAX, pack_cold as _pk
     from scalecube_cluster_tpu.sim.sparse import SparseState
+    from scalecube_cluster_tpu.sim.state import AGE_STALE
 
     path = _normalize(path)
     path.parent.mkdir(parents=True, exist_ok=True)
@@ -114,6 +125,15 @@ def save_sparse_checkpoint(path: str | Path, state, params) -> None:
         # than protocol state — export it via obs/trace.py instead.
         if getattr(state, f.name) is not None and f.name != "trace"
     }
+    if pack_cold:
+        age, susp = arrays.pop("age"), arrays.pop("susp")
+        if int(susp.max(initial=0)) > COLD_SUSP_MAX or int(age.max(initial=0)) > AGE_STALE:
+            raise ValueError(
+                f"pack_cold needs susp <= {COLD_SUSP_MAX} and age <= "
+                f"{AGE_STALE} (got susp max {int(susp.max(initial=0))}, age "
+                f"max {int(age.max(initial=0))}); save unpacked instead"
+            )
+        arrays[_COLD_PACKED] = np.asarray(jax.device_get(_pk(age, susp)))
     blob = dataclasses.asdict(params)
     # pallas_fold is a frozenset — JSON carries it as a sorted list;
     # SparseParams.__post_init__ re-freezes it on load.
@@ -141,6 +161,11 @@ def load_sparse_checkpoint(path: str | Path):
             for f in dataclasses.fields(SparseState)
             if f.name in data
         }
+        if _COLD_PACKED in data:
+            from scalecube_cluster_tpu.ops.pallas_sparse import unpack_cold
+
+            age, susp = unpack_cold(jax.numpy.asarray(data[_COLD_PACKED]))
+            arrays["age"], arrays["susp"] = age.copy(), susp.copy()
         # Snapshots from before the user-gossip fields existed: empty slots.
         n = arrays["view_T"].shape[0]
         g = params.base.user_gossip_slots

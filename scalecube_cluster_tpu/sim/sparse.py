@@ -222,6 +222,12 @@ class SparseParams:
     #: Run the [N, S] tick core (delivery + merge + suspicion + aging) as
     #: one fused Pallas kernel (ops/pallas_sparse.py). Bit-identical to the
     #: XLA chain; needs n % 32 == 0 and S % 128 == 0, else ignored.
+    #: Composes with the explicit-SPMD engine (round 7): under
+    #: parallel/spmd.py each shard's [n/d, S] core is the kernel while the
+    #: three collectives stay outside it; shard mode re-routes two fold
+    #: pieces itself — 'points' stays XLA (globally-indexed FD/SYNC
+    #: scatter), and knob-carrying runs drop the countdown folds per shard
+    #: instead of raising like the single-device path does.
     pallas_core: bool = False
     #: Residual-fold ladder (round 6): which per-tick [N, S] passes fold
     #: INTO the kernel when ``pallas_core`` is on (ops/pallas_sparse.py

@@ -232,6 +232,58 @@ def test_sparse_checkpoint_roundtrip_is_exact(tmp_path):
     assert bool(jnp.all(cont_a.slot_subj == cont_b.slot_subj))
 
 
+def test_sparse_checkpoint_packed_cold_roundtrip(tmp_path):
+    """Round-7 satellite: ``pack_cold=True`` snapshots store age+susp as
+    one int16 lane (the persistent kernel's packing) and resume
+    bit-identically — a mid-run checkpoint continues to the same state as
+    both the unpacked snapshot and the uncheckpointed run, on the extended
+    pallas_fold ladder params. Out-of-range countdowns refuse to pack
+    rather than truncate."""
+    import numpy as np
+
+    from scalecube_cluster_tpu.sim.checkpoint import (
+        load_sparse_checkpoint,
+        save_sparse_checkpoint,
+    )
+
+    n, S = 32, 128
+    p = dataclasses.replace(
+        sparse_params(n, suspicion_ticks=12),
+        slot_budget=S,
+        pallas_core=True,
+        pallas_fold=frozenset({"countdown", "points", "wb_mask", "view_rows"}),
+    )
+    st = kill_sparse(init_sparse_full_view(n, S), 5)
+    plan = FaultPlan.uniform(loss_percent=10.0)
+    st, _ = run_sparse_ticks(p, st, plan, 14)  # mid-run: suspicion armed
+
+    save_sparse_checkpoint(tmp_path / "packed", st, p, pack_cold=True)
+    save_sparse_checkpoint(tmp_path / "plain", st, p)
+    with np.load(tmp_path / "packed.npz") as data:
+        assert "__cold_packed__" in data and "age" not in data and "susp" not in data
+    lp, pp = load_sparse_checkpoint(tmp_path / "packed")
+    lu, _ = load_sparse_checkpoint(tmp_path / "plain")
+    assert pp == p
+    assert bool(jnp.all(lp.age == lu.age)) and bool(jnp.all(lp.susp == lu.susp))
+    assert lp.age.dtype == st.age.dtype and lp.susp.dtype == st.susp.dtype
+
+    # Mid-run resume: packed and unpacked continuations equal each other
+    # AND the run-through (donation: run continuations before the original).
+    cont_p, _ = run_sparse_ticks(pp, lp, plan, 12)
+    cont_u, _ = run_sparse_ticks(p, lu, plan, 12)
+    cont_o, _ = run_sparse_ticks(p, st, plan, 12)
+    for f in ("slab", "age", "susp", "view_T", "slot_subj", "subj_slot", "rng"):
+        a, b, c = getattr(cont_o, f), getattr(cont_u, f), getattr(cont_p, f)
+        assert bool(jnp.all(a == b)), f
+        assert bool(jnp.all(a == c)), f
+
+    # The packed field is a contract, not a cast: susp beyond the lane
+    # width must refuse.
+    big = st.replace(susp=st.susp.at[0, 0].set(1000))
+    with pytest.raises(ValueError, match="pack_cold"):
+        save_sparse_checkpoint(tmp_path / "nope", big, p, pack_cold=True)
+
+
 def test_pallas_core_matches_xla():
     """The fused sparse tick core (ops/pallas_sparse.py, interpreted on the
     CPU backend) is bit-identical to the XLA chain over whole trajectories
@@ -363,6 +415,118 @@ def test_wb_carry_matches_recompute():
         assert bool(jnp.all(getattr(a, f) == getattr(b, f))), f
     # Consuming the mask invalidates it; the next free recomputes.
     assert not bool(a.wb_valid)
+
+
+def _persistent_inputs(n=128, s=256, f=3, k_max=5, seed=0):
+    """Random-but-seeded operand set for the persistent multi-tick kernel:
+    k_max ticks of fan-out tables/edges over a realistic slab (negative
+    UNKNOWNs, partial slot table, dead rows)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    nb = n // 32
+    slab = jnp.asarray(rng.integers(-1, 1 << 20, (n, s)), jnp.int32)
+    age = jnp.asarray(rng.integers(0, 120, (n, s)), jnp.int8)
+    susp = jnp.asarray(rng.integers(0, 21, (n, s)), jnp.int16)
+    subj = np.full(s, -1, np.int32)
+    k_active = min(n, s // 2)
+    subj[:k_active] = rng.choice(n, size=k_active, replace=False)
+    rng.shuffle(subj)
+    return dict(
+        slab=slab, age=age, susp=susp, slot_subj=jnp.asarray(subj),
+        ginv=jnp.asarray(rng.integers(0, nb, (k_max, f, nb)), jnp.int32),
+        rots=jnp.asarray(rng.integers(0, 32, (k_max, f, nb)), jnp.int32),
+        edge_ok=jnp.asarray(rng.random((k_max, f, n)) < 0.8),
+        alive=jnp.asarray(rng.random(n) < 0.9),
+    )
+
+
+def test_persistent_kernel_matches_chained_launches():
+    """Round-7 tentpole rung (b): the persistent k-tick kernel is
+    bit-identical to k chained single-tick launches on every output
+    (slab, packed cold state, self-rumor, per-slot aggregate), and one
+    traced executable serves EVERY k <= k_max (zero recompile, pinned via
+    jit_cache_size — k is a traced operand, only k_max is static)."""
+    import numpy as np
+
+    from scalecube_cluster_tpu.ops.pallas_sparse import (
+        run_sparse_core_persistent,
+        sparse_core_pallas,
+    )
+    from scalecube_cluster_tpu.utils.jaxcache import jit_cache_size
+
+    k_max = 5
+    inp = _persistent_inputs(k_max=k_max)
+    kw = dict(spread=6, susp_ticks=20, age_stale=120, sweep=6)
+    fold = frozenset({"countdown", "wb_mask", "view_rows"})
+    neg = jnp.full((inp["slab"].shape[0],), -1, jnp.int32)
+
+    def chain(k):
+        sl, ag, su = inp["slab"], inp["age"], inp["susp"]
+        for t in range(k):
+            sl, ag, su, selfr, aggr = sparse_core_pallas(
+                sl, ag, su, inp["slot_subj"], inp["ginv"][t], inp["rots"][t],
+                inp["edge_ok"][t], inp["alive"], neg, neg, fold=fold, **kw,
+            )
+        return sl, ag, su, selfr, aggr
+
+    before = jit_cache_size(run_sparse_core_persistent)
+    for k in (1, 2, 3, 5):
+        ref = chain(k)
+        got = run_sparse_core_persistent(
+            inp["slab"], inp["age"], inp["susp"], inp["slot_subj"],
+            inp["ginv"], inp["rots"], inp["edge_ok"], inp["alive"], k,
+            k_max=k_max, fold=fold, **kw,
+        )
+        for nm, r, g in zip(("slab", "age", "susp", "self", "aggr"), ref, got):
+            assert np.array_equal(np.asarray(r), np.asarray(g)), (k, nm)
+    # One executable across all four k values: k rides a scalar operand.
+    assert jit_cache_size(run_sparse_core_persistent) == before + 1
+
+
+def test_persistent_kernel_validation_and_cold_packing():
+    """The persistent kernel's contract edges: pack_cold round-trips the
+    int8 age + int16 suspicion countdown through one int16 lane exactly;
+    fold combinations it cannot honor raise (countdown is mandatory — the
+    sweep lives in-kernel; points cannot fold — FD/SYNC verdicts are
+    protocol-tick inputs); countdowns wider than the packed field raise."""
+    import numpy as np
+
+    from scalecube_cluster_tpu.ops.pallas_sparse import (
+        COLD_SUSP_MAX,
+        pack_cold,
+        sparse_core_pallas_persistent,
+        unpack_cold,
+    )
+
+    age = jnp.asarray(
+        np.random.default_rng(1).integers(0, 121, (64, 256)), jnp.int8
+    )
+    susp = jnp.asarray(
+        np.random.default_rng(2).integers(0, COLD_SUSP_MAX + 1, (64, 256)),
+        jnp.int16,
+    )
+    a2, s2 = unpack_cold(pack_cold(age, susp))
+    assert np.array_equal(np.asarray(a2), np.asarray(age))
+    assert np.array_equal(np.asarray(s2), np.asarray(susp))
+
+    inp = _persistent_inputs(n=64, s=256)
+    args = (
+        inp["slab"], inp["age"], inp["susp"], inp["slot_subj"],
+        inp["ginv"], inp["rots"], inp["edge_ok"], inp["alive"], 2,
+    )
+    kw = dict(spread=6, age_stale=120, sweep=6, k_max=5)
+    with pytest.raises(ValueError, match="countdown"):
+        sparse_core_pallas_persistent(*args, susp_ticks=20, fold=frozenset(), **kw)
+    with pytest.raises(ValueError, match="points"):
+        sparse_core_pallas_persistent(
+            *args, susp_ticks=20, fold=frozenset({"countdown", "points"}), **kw
+        )
+    with pytest.raises(ValueError, match="packed int16 cold lane"):
+        sparse_core_pallas_persistent(
+            *args, susp_ticks=COLD_SUSP_MAX + 1,
+            fold=frozenset({"countdown"}), **kw
+        )
 
 
 def test_host_boundary_writeback_matches_protocol():
@@ -672,9 +836,15 @@ def test_sparse_sharded_full_cadence_certification():
         "(2,2)/(4,2) diverge, independent of packet loss. tpulint S3's "
         "donation-race hypothesis is ruled out: certification runs every "
         "leg through the non-donating twins (testlib/donation.py) and the "
-        "divergence persists. The remaining suspect is GSPMD's partitioning "
-        "of the FD slot-update scatter when the [n, S] slab is split on "
-        "members while subject-indexed tables split on subjects."
+        "divergence persists. BISECTED (round 7, tests/test_spmd.py::"
+        "test_2d_mesh_divergence_bisected_to_fd_probe_selection): the first "
+        "divergent observable is the FD probe COUNT itself on the first FD "
+        "tick (msgs_fd 255 vs 264 at n=256 — extra probes plus spurious "
+        "suspicions of live members), so the fault is in the FD "
+        "probe-target selection under 2D GSPMD, UPSTREAM of the slot-update "
+        "scatter previously suspected; the downstream split is one whole "
+        "slot-allocation decision, and suppressing FD (fd_period → ∞) is "
+        "bit-clean through the same horizon."
     ),
 )
 def test_sparse_sharded_full_cadence_certification_2d():

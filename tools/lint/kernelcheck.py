@@ -283,7 +283,7 @@ def _zeros(shape, dtype):
 
 
 def audit_shipped(root: str = "") -> AuditReport:
-    """Capture + audit the three shipped kernel wrappers at probe shapes
+    """Capture + audit the four shipped kernel wrappers at probe shapes
     that satisfy their structural guards (Pallas path, not XLA fallback)."""
     import inspect
 
@@ -323,6 +323,35 @@ def audit_shipped(root: str = "") -> AuditReport:
             fold=frozenset({"countdown", "points", "wb_mask", "view_rows"}),
         )
     path, line = loc(pallas_sparse.sparse_core_pallas)
+    for call in captured:
+        audit_call(call, path=path, line=line, report=report)
+
+    # persistent multi-tick core (round 7): k_max=2 plain ticks in one
+    # launch, full non-protocol fold. Its state windows are memory_space=ANY
+    # double-buffered DMAs (counted as any_space_windows, covered
+    # dynamically by the chained-launch parity test), but the slot_subj
+    # lane block and the grid geometry ARE statically checkable here.
+    n, s, k_max = 64, 128, 2
+    captured = []
+    with capture_pallas_calls(captured):
+        pallas_sparse.sparse_core_pallas_persistent(
+            _zeros((n, s), jnp.int32),
+            _zeros((n, s), jnp.int8),
+            _zeros((n, s), jnp.int16),
+            _zeros((s,), jnp.int32),
+            _zeros((k_max, f, n // 32), jnp.int32),
+            _zeros((k_max, f, n // 32), jnp.int32),
+            _zeros((k_max, f, n), bool),
+            _zeros((n,), bool),
+            1,
+            spread=8,
+            susp_ticks=30,
+            age_stale=120,
+            sweep=18,
+            k_max=k_max,
+            fold=frozenset({"countdown", "wb_mask", "view_rows"}),
+        )
+    path, line = loc(pallas_sparse.sparse_core_pallas_persistent)
     for call in captured:
         audit_call(call, path=path, line=line, report=report)
 

@@ -162,12 +162,15 @@ def _build_run_sparse_ticks(pallas_core, schedule=False, trace_capacity=0):
     )
 
 
-def _build_run_sparse_ticks_spmd(schedule=False):
+def _build_run_sparse_ticks_spmd(schedule=False, pallas=False):
     # The explicit-SPMD shard_map engine (parallel/spmd.py). The census
     # environment is single-device, so the probe mesh is d=1 over
     # devices[:1] — every collective (all_gather / all_to_all / psum) still
     # appears in the jaxpr, it just has one participant; the semantic rules
     # see the same program structure the multi-chip run lowers.
+    # pallas=True swaps each shard's merge/decay core for the fused kernel
+    # (round 7): a distinct executable — the pallas_call eqn replaces the
+    # XLA merge chain — censused separately.
     import jax
 
     from scalecube_cluster_tpu.parallel.mesh import make_mesh
@@ -176,7 +179,7 @@ def _build_run_sparse_ticks_spmd(schedule=False):
         run_sparse_ticks_spmd,
     )
 
-    params, state, plan = _sparse_inputs(False, schedule=schedule)
+    params, state, plan = _sparse_inputs(pallas, schedule=schedule)
     mesh = make_mesh(jax.devices()[:1])
     return (
         run_sparse_ticks_spmd,
@@ -189,6 +192,47 @@ def _build_run_sparse_ticks_spmd(schedule=False):
             "static_argnums": (0, 1, 2, 5),
             "static_argnames": ("collect",),
         },
+    )
+
+
+def _build_run_sparse_core_persistent():
+    # The persistent multi-tick kernel executable (ops/pallas_sparse.py,
+    # round 7): k_max plain ticks in ONE launch, launch depth k a traced
+    # scalar operand. No state pytree — this is the raw array-in/array-out
+    # jit the bench k-sweep drives; censused so the scalar-prefetch grid
+    # and double-buffered DMA structure stay a reviewed surface.
+    import jax.numpy as jnp
+    import numpy as np
+
+    from scalecube_cluster_tpu.ops.pallas_sparse import run_sparse_core_persistent
+
+    n, s, f, k_max = N, S, 2, 2
+    nb = n // 32
+    rng = np.random.default_rng(0)
+    subj = np.full(s, -1, np.int32)
+    subj[: n // 2] = rng.choice(n, size=n // 2, replace=False)
+    return (
+        run_sparse_core_persistent,
+        (
+            jnp.asarray(rng.integers(-1, 1 << 20, (n, s)), jnp.int32),
+            jnp.asarray(rng.integers(0, 120, (n, s)), jnp.int8),
+            jnp.asarray(rng.integers(0, 21, (n, s)), jnp.int16),
+            jnp.asarray(subj),
+            jnp.asarray(rng.integers(0, nb, (k_max, f, nb)), jnp.int32),
+            jnp.asarray(rng.integers(0, 32, (k_max, f, nb)), jnp.int32),
+            jnp.asarray(rng.random((k_max, f, n)) < 0.8),
+            jnp.asarray(rng.random(n) < 0.9),
+            jnp.asarray(1, jnp.int32),
+        ),
+        {
+            "spread": 6,
+            "susp_ticks": 20,
+            "age_stale": 120,
+            "sweep": 6,
+            "k_max": k_max,
+            "fold": frozenset({"countdown", "wb_mask", "view_rows"}),
+        },
+        {},
     )
 
 
@@ -427,6 +471,14 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
     EntrySpec(
         "parallel.spmd.run_sparse_ticks_spmd[schedule]",
         lambda: _build_run_sparse_ticks_spmd(True),
+    ),
+    EntrySpec(
+        "parallel.spmd.run_sparse_ticks_spmd[pallas]",
+        lambda: _build_run_sparse_ticks_spmd(pallas=True),
+    ),
+    EntrySpec(
+        "ops.pallas_sparse.run_sparse_core_persistent",
+        _build_run_sparse_core_persistent,
     ),
     EntrySpec(
         "sim.ensemble.run_ensemble_ticks",

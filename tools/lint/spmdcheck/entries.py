@@ -54,7 +54,7 @@ class SpmdEntrySpec:
     meta: dict = field(default_factory=dict)
 
 
-def _spmd_inputs(schedule=False, record_latency=False):
+def _spmd_inputs(schedule=False, record_latency=False, pallas=False):
     from scalecube_cluster_tpu.sim.faults import FaultPlan
     from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
     from scalecube_cluster_tpu.sim.sparse import (
@@ -62,7 +62,7 @@ def _spmd_inputs(schedule=False, record_latency=False):
         init_sparse_full_view,
     )
 
-    params = SparseParams.for_n(N, slot_budget=S)
+    params = SparseParams.for_n(N, slot_budget=S, pallas_core=pallas)
     state = init_sparse_full_view(
         N,
         slot_budget=S,
@@ -83,7 +83,7 @@ def _spmd_inputs(schedule=False, record_latency=False):
     return params, state, plan
 
 
-def _build_run_sparse_ticks_spmd(schedule=False, record_latency=False):
+def _build_run_sparse_ticks_spmd(schedule=False, record_latency=False, pallas=False):
     import jax
 
     from scalecube_cluster_tpu.parallel.mesh import make_mesh
@@ -92,8 +92,12 @@ def _build_run_sparse_ticks_spmd(schedule=False, record_latency=False):
         run_sparse_ticks_spmd,
     )
 
+    # pallas=True: each shard's merge/decay core is the fused kernel
+    # (round 7). The three cross-shard collectives are OUTSIDE the
+    # pallas_call, so S1/S2 see identical exchange structure — the point
+    # of censusing this twin is pinning exactly that invariant.
     params, state, plan = _spmd_inputs(
-        schedule=schedule, record_latency=record_latency
+        schedule=schedule, record_latency=record_latency, pallas=pallas
     )
     cfg = ShardConfig(d=D)
     mesh = make_mesh(jax.devices()[:D])
@@ -173,6 +177,10 @@ SPMD_ENTRY_SPECS: tuple[SpmdEntrySpec, ...] = (
     SpmdEntrySpec(
         "parallel.spmd.run_sparse_ticks_spmd[latency,d2]",
         lambda: _build_run_sparse_ticks_spmd(False, record_latency=True),
+    ),
+    SpmdEntrySpec(
+        "parallel.spmd.run_sparse_ticks_spmd[pallas,d2]",
+        lambda: _build_run_sparse_ticks_spmd(pallas=True),
     ),
     SpmdEntrySpec(
         "parallel.spmd.run_ensemble_sparse_ticks_spmd[2x2]",

@@ -121,6 +121,25 @@ PYEOF
   sleep 10
   cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
 
+  echo "--- [3e/6] round-7: fused kernel under shard_map at 102400 ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  # Same 8-shard geometry as 3d with each shard's merge/decay core swapped
+  # for the fused Pallas kernel (--pallas; engine sparse-shard-map-pallas).
+  # Bit-parity vs the XLA shard_map oracle is certified at n=2048 in CI,
+  # so this rung is pure measurement; the two adjacent bench_history rows
+  # (same commit + census digests stamped by make_row) ARE the
+  # kernel-vs-XLA-core attribution at the 100k scale.
+  timeout 1500 python bench.py --shard-map 8 102400 --pallas >>"$LOG" 2>&1
+  sleep 10
+
+  echo "--- [3f/6] round-7: persistent-kernel k-sweep ($(date -u +%FT%TZ)) ---" >>"$LOG"
+  # Launch-depth amortization on-chip: one traced executable swept over
+  # k=1..8 (k rides a scalar operand — every row must say
+  # zero_recompile=true or the sweep is measuring recompiles). Rows land
+  # in bench_history.jsonl provenance-stamped like every other rung.
+  timeout 900 python bench.py --persistent-ksweep 32768 8 >>"$LOG" 2>&1
+  sleep 10
+  cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
+
   echo "--- [4/6] dense control ($(date -u +%FT%TZ)) ---" >>"$LOG"
   timeout 600 python tools/chunk_times.py 2>&1 | tail -30 >>"$LOG"
   cp "$LOG" /root/repo/TPU_RUN_r4.log 2>/dev/null
