@@ -60,7 +60,12 @@ percentiles — plus the probe attempt land in bench_history.jsonl), or
 serve/load.py: a seeded fleet of honest + adversarial loopback-TCP
 producers with churn drives one live session; the ``kind="load"`` row —
 events/s, backpressure pauses, rejections, conservation verdicts — plus
-the probe attempt land in bench_history.jsonl).
+the probe attempt land in bench_history.jsonl), or ``python bench.py
+--geo [n]`` (the geo-distributed rung, sim/topology.py: the dense engine
+under a 2-zone 400 ms WAN brownout schedule; the ``kind="bench_geo"``
+row reports member·rounds/s, ns_per_member and the flat-world overhead
+ratio, and both the probe attempt and the row land in
+bench_history.jsonl).
 """
 
 from __future__ import annotations
@@ -482,6 +487,67 @@ def _measure_rapid(n_members: int = 1024, chunk: int = 40, reps: int = 4) -> dic
     }
 
 
+def _measure_geo(n_members: int = 1024, chunk: int = 40, reps: int = 4) -> dict:
+    """The ``--geo [n]`` rung: the dense engine under a 2-zone WAN brownout
+    (sim/topology.py) — 400 ms cross-zone latency inflation composed over
+    the bench's standard uniform-5%-loss plan via a FaultSchedule whose
+    segment carries the LinkWorld. Timed exactly like the SWIM rungs
+    (collect=False, warmup + reps × chunk, large-buffer element sync). The
+    row reports both the geo throughput and its flat-world twin (same
+    schedule pytree shape, ``link_world=None``) so the per-edge zone-gather
+    overhead — two O(1) gathers per matrix per tick — reads as a ratio
+    straight off bench_history.jsonl (PERF.md geo note)."""
+    from scalecube_cluster_tpu.sim import (
+        FaultPlan,
+        ScheduleBuilder,
+        SimParams,
+        init_full_view,
+        run_ticks,
+    )
+    from scalecube_cluster_tpu.sim.state import seeds_mask
+    from scalecube_cluster_tpu.sim.topology import LinkWorld
+
+    params = SimParams.from_cluster_config(n_members)
+    seeds = seeds_mask(n_members, [0, 1])
+    world = LinkWorld.even_zones(n_members, 2).with_zone_latency(0, 1, 400.0)
+
+    def run(link_world):
+        sched = (
+            ScheduleBuilder(n_members)
+            .add_segment(
+                0, FaultPlan.uniform(loss_percent=5.0), link_world=link_world
+            )
+            .build()
+        )
+        state = init_full_view(n_members)
+        state, _ = run_ticks(params, state, sched, seeds, chunk, collect=False)
+        int(state.view[0, 0])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            state, _ = run_ticks(
+                params, state, sched, seeds, chunk, collect=False
+            )
+            int(state.view[0, 0])
+        dt = time.perf_counter() - t0
+        return n_members * (reps * chunk / dt)
+
+    flat_value = run(None)
+    value = run(world)
+    return {
+        "metric": "member_gossip_rounds_per_sec",
+        "value": round(value, 1),
+        "unit": "member·rounds/s",
+        "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+        "ns_per_member": _ns_per_member(value),
+        "n_members": n_members,
+        "engine": "dense-geo",
+        "n_zones": 2,
+        "brownout_latency_ms": 400.0,
+        "flat_value": round(flat_value, 1),
+        "geo_overhead": round(flat_value / value, 4) if value > 0 else None,
+    }
+
+
 def _measure_serve(
     n_members: int = 4096,
     batch_ticks: int = 32,
@@ -886,6 +952,59 @@ if __name__ == "__main__":
             jsonl_line(make_row("bench_rapid", out, run_metadata(seed=0))),
             flush=True,
         )
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--geo":
+        try:
+            from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+            enable_repo_jax_cache()
+        except Exception:
+            pass
+        from scalecube_cluster_tpu.obs.export import (
+            append_jsonl,
+            jsonl_line,
+            make_row,
+            run_metadata,
+        )
+
+        n_arg = int(sys.argv[2]) if len(sys.argv) > 2 else 1024
+        # One recorded backend probe first (the ladder driver's discipline:
+        # outage budget must leave evidence in bench_history.jsonl).
+        t_probe = time.monotonic()
+        probe_err = _probe_once()
+        _record_probe_attempt(1, probe_err, time.monotonic() - t_probe)
+        if probe_err is not None:
+            row = make_row(
+                "bench_geo",
+                {"error": probe_err, "n_members": n_arg, **_self_evidence()},
+                run_metadata(seed=0),
+            )
+        else:
+            out = _measure_geo(n_arg)
+            row = make_row("bench_geo", out, run_metadata(seed=0))
+            _record_probe_attempt(
+                2,
+                None,
+                time.monotonic() - t_probe,
+                extra={
+                    "scenario": "geo",
+                    "engine": out["engine"],
+                    "n_members": n_arg,
+                    "member_rounds_per_sec": out["value"],
+                    "geo_overhead": out["geo_overhead"],
+                },
+            )
+        try:
+            append_jsonl(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "artifacts",
+                    "bench_history.jsonl",
+                ),
+                [row],
+            )
+        except Exception:
+            pass
+        print(jsonl_line(row), flush=True)
     elif len(sys.argv) >= 3 and sys.argv[1] == "--shard-map":
         pos = [a for a in sys.argv[2:] if not a.startswith("--")]
         use_pallas = "--pallas" in sys.argv[2:]

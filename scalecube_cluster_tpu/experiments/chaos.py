@@ -21,6 +21,14 @@ the Rapid side runs with the fallback attached, so each row also reports
 ``rapid_views_parked`` / ``rapid_fallback_commits`` (how often the classic
 rounds had to rescue a split vote).
 
+``--geo`` swaps the flat schedule sampler for the geo-distributed matrix
+(testlib/chaos.py::geo_chaos_matrix): every trial draws a LinkWorld
+timeline — a 2-zone split-brain, a 3-zone WAN brownout racing the probe
+deadline, or an asymmetric one-way partition — and the SWIM engines are
+additionally certified against the Z1-Z3 per-zone graceful-degradation
+invariants. Geo CHAOS-REPRO digests hash the zone assignment and every
+[Z, Z] matrix, so one line still pins the whole world.
+
 ``--out FILE`` appends each trial as schema-versioned JSONL (obs/export.py),
 so soak results can be committed/diffed like the experiment grid's.
 """
@@ -47,6 +55,12 @@ def main(argv=None) -> int:
         action="store_true",
         help="SWIM-vs-Rapid race: both protocols over identical "
         "seed/schedule matrices, one paired row per seed",
+    )
+    ap.add_argument(
+        "--geo",
+        action="store_true",
+        help="geo matrix: LinkWorld timelines (split2/brownout3/oneway) "
+        "with Z1-Z3 zone certification on the SWIM engines",
     )
     ap.add_argument(
         "--swim-engine",
@@ -111,6 +125,50 @@ def main(argv=None) -> int:
         print(
             json.dumps(
                 {"races": len(rows), "violations": len(failures)}
+            )
+        )
+        return len(failures)
+
+    if args.geo:
+        from scalecube_cluster_tpu.testlib.chaos import (
+            GEO_ENGINES,
+            geo_chaos_matrix,
+        )
+
+        # --geo defaults to the full geo engine set (the explicit flag
+        # still wins: --engines dense --geo runs a dense-only matrix).
+        geo_engines = GEO_ENGINES if args.engines == "dense,sparse" else engines
+
+        def emit_geo(r: dict) -> None:
+            if r["ok"]:
+                print(
+                    f"ok seed={r['seed']} engine={r['engine']} "
+                    f"variant={r['variant']} digest={r['digest']} "
+                    f"conv={r['final_convergence']:.3f}"
+                )
+            else:
+                print(
+                    f"FAIL variant={r['variant']} {r['reproducer']} :: "
+                    f"{r['error']}"
+                )
+            sys.stdout.flush()
+
+        results = geo_chaos_matrix(
+            seeds, args.n, engines=geo_engines, on_result=emit_geo
+        )
+        failures = [r for r in results if not r["ok"]]
+        if args.out:
+            meta = run_metadata(n=args.n)
+            append_jsonl(
+                args.out, [make_row("chaos_geo", r, meta) for r in results]
+            )
+        print(
+            json.dumps(
+                {
+                    "trials": len(results),
+                    "violations": len(failures),
+                    "reproducers": [r["reproducer"] for r in failures],
+                }
             )
         )
         return len(failures)

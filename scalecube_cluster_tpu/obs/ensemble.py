@@ -50,6 +50,15 @@ ENVELOPE_KEYS = (
 #: Trace keys excluded from generic counter handling (not event counts).
 _NON_COUNTER = ("tick", "convergence")
 
+#: Per-zone gauges a LinkWorld-bearing scheduled run emits (sim/topology.py
+#: ``zone_tick_metrics``): ``[B, T, Z]`` in ensemble traces. Each gets a
+#: per-zone population envelope — the geo twin of :data:`ENVELOPE_KEYS`.
+ZONE_ENVELOPE_KEYS = (
+    "zone_intra_conv",
+    "zone_false_dead",
+    "zone_intra_suspects",
+)
+
 
 def first_tick_where(mask: jax.Array) -> jax.Array:
     """``[B, T]`` bool -> ``[B]`` int32: first tick where the condition
@@ -149,6 +158,35 @@ def population_stats(traces: dict) -> dict:
                 jnp.max(arr, axis=0).astype(jnp.float32),
             ]
         )
+    # Per-zone envelopes (geo runs): convergence reports its per-universe
+    # FLOOR (the deepest intra-zone dip a universe ever saw — the graceful-
+    # degradation headline), count gauges their totals and peaks; each then
+    # folds to a [3, Z] min/mean/max population envelope per zone.
+    for key in ZONE_ENVELOPE_KEYS:
+        arr = traces.get(key)
+        if arr is None or arr.ndim != 3:
+            continue
+        if key == "zone_intra_conv":
+            floor = jnp.min(arr, axis=1)  # [B, Z]
+            stats["zone_intra_conv_floor"] = floor
+            stats["zone_intra_conv_floor_env"] = jnp.stack(
+                [
+                    jnp.min(floor, axis=0).astype(jnp.float32),
+                    jnp.mean(floor.astype(jnp.float32), axis=0),
+                    jnp.max(floor, axis=0).astype(jnp.float32),
+                ]
+            )
+            continue
+        tot = jnp.sum(arr, axis=1)  # [B, Z]
+        stats[f"{key}_total"] = tot
+        stats[f"{key}_peak"] = jnp.max(arr, axis=1)
+        stats[f"{key}_env"] = jnp.stack(
+            [
+                jnp.min(tot, axis=0).astype(jnp.float32),
+                jnp.mean(tot.astype(jnp.float32), axis=0),
+                jnp.max(tot, axis=0).astype(jnp.float32),
+            ]
+        )
     return stats
 
 
@@ -222,6 +260,21 @@ def ensemble_report(
         agg[f"{key}_total_min"] = _scalar(env[0])
         agg[f"{key}_total_mean"] = _scalar(env[1])
         agg[f"{key}_total_max"] = _scalar(env[2])
+    # Geo runs: one headline per zone — the worst intra-zone convergence
+    # dip any universe saw, the max false-DEAD total, the suspect peak.
+    floor_env = stats.get("zone_intra_conv_floor_env")
+    if floor_env is not None:
+        for z in range(np.asarray(floor_env).shape[1]):
+            agg[f"zone{z}_intra_conv_floor_min"] = _scalar(floor_env[0][z])
+        fd_env = stats.get("zone_false_dead_env")
+        if fd_env is not None:
+            for z in range(np.asarray(fd_env).shape[1]):
+                agg[f"zone{z}_false_dead_total_max"] = _scalar(fd_env[2][z])
+        sp_peak = stats.get("zone_intra_suspects_peak")
+        if sp_peak is not None:
+            peaks = np.asarray(sp_peak).max(axis=0)
+            for z in range(peaks.shape[0]):
+                agg[f"zone{z}_intra_suspects_peak"] = _scalar(peaks[z])
     if cert is not None:
         agg["pass_rate"] = float(np.mean(cert["ok"]))
         agg["failures"] = int(np.sum(~cert["ok"]))

@@ -79,7 +79,7 @@ from scalecube_cluster_tpu.ops.merge import (
     merge_views,
 )
 from scalecube_cluster_tpu.parallel.mesh import AXIS, UNIVERSE_AXIS, sparse_state_pspecs
-from scalecube_cluster_tpu.sim.faults import FaultPlan, _edge_lookup, link_pass_from
+from scalecube_cluster_tpu.sim.faults import FaultPlan, edge_blocked, link_pass_from
 from scalecube_cluster_tpu.sim.knobs import Knobs, edge_live, suspicion_fill
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
@@ -909,7 +909,7 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
     for c in range(f):
         # Sender-side attribution of the SAME per-edge draws the receiver
         # consumed (u_full[c] indexed at the receiver): exact by bijection.
-        g_blk = _edge_lookup(plan.block, col, rcv_c[c])
+        g_blk = edge_blocked(plan, col, rcv_c[c])
         g_pass = link_pass_from(u_full[c][rcv_c[c]], plan, col, rcv_c[c])
         g_acct = _acct_add(g_acct, _link_acct(g_att_c[c], g_blk, g_pass))
     acct = _acct_add(fd_out[7:], g_acct, sy_out[7:])

@@ -19,7 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from scalecube_cluster_tpu.serve.events import EventBatch, event_masks, event_masks_rapid
-from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.faults import FaultPlan, plan_any_faults
 from scalecube_cluster_tpu.sim.knobs import Knobs
 from scalecube_cluster_tpu.sim.rapid import (
     RapidParams,
@@ -58,11 +58,7 @@ def run_serve_batch(
     # The plan is fixed for the whole launch, so its dirtiness — the same
     # predicate ScheduleBuilder precomputes per segment — is one reduction
     # outside the scan, broadcast into every tick's trace row.
-    dirty = (
-        jnp.any(plan.block)
-        | jnp.any(plan.loss > 0)
-        | jnp.any(plan.mean_delay > 0)
-    )
+    dirty = plan_any_faults(plan)
 
     def step(carry, xs):
         node, kind, arg, deferred = xs
@@ -115,11 +111,7 @@ def run_rapid_serve_batch(
     the argument alive is worth the extra buffer.
     """
     n = params.n
-    dirty = (
-        jnp.any(plan.block)
-        | jnp.any(plan.loss > 0)
-        | jnp.any(plan.mean_delay > 0)
-    )
+    dirty = plan_any_faults(plan)
 
     def step(carry, xs):
         node, kind, _arg, deferred = xs

@@ -42,6 +42,8 @@ import jax
 import jax.numpy as jnp
 from jax.tree_util import register_dataclass
 
+from scalecube_cluster_tpu.sim.topology import LinkWorld
+
 
 @register_dataclass
 @dataclass
@@ -54,11 +56,20 @@ class FaultPlan:
     loss/delay grids — carry 24 bytes instead of 3 O(N²) matrices, which at
     32k+ members is the difference between fitting HBM and not
     (the three dense matrices cost ~9.7 GB at n=32768, twice the state).
+
+    ``link_world`` (sim/topology.py) overlays a zone-level geo topology on
+    top of the per-link matrices: every edge additionally consults the
+    ``[Z, Z]`` matrices of its endpoints' zone pair (see the composition
+    rules in :func:`edge_blocked` / :func:`edge_loss` /
+    :func:`edge_mean_delay`). ``None`` — the default — is static pytree
+    structure, so flat-world plans compile to the exact pre-LinkWorld
+    program (the ``record_latency``/``trace`` structure-gating pattern).
     """
 
     block: jax.Array  # [N, N] (or [1, 1]) bool
     loss: jax.Array  # [N, N] (or [1, 1]) float32 in [0, 1)
     mean_delay: jax.Array  # [N, N] (or [1, 1]) float32 ms (0 = no delay)
+    link_world: LinkWorld | None = None  # zone overlay (sim/topology.py)
 
     def replace(self, **changes) -> "FaultPlan":
         return dataclasses.replace(self, **changes)
@@ -111,6 +122,26 @@ class FaultPlan:
         block = block.at[b[:, None], a[None, :]].set(True)
         return self.replace(block=block)
 
+    def partition_oneway(self, group_a, group_b) -> "FaultPlan":
+        """ONE-WAY partition: block a→b links only, for every a in
+        ``group_a`` and b in ``group_b``. B still reaches A — the asymmetric
+        regime (a misconfigured firewall, a one-sided route withdrawal)
+        that symmetric :meth:`partition` cannot express: A's probes of B die
+        on the forward leg while B's probes of A die on the ACK leg, and
+        the C1 conservation split attributes the two cases to DIFFERENT
+        ``fault_blocked`` edges (pinned by tests/test_topology.py)."""
+        if self.block.shape[0] == 1:
+            raise ValueError("partitions need a dense plan (FaultPlan.clean(n))")
+        a = jnp.asarray(group_a, jnp.int32)
+        b = jnp.asarray(group_b, jnp.int32)
+        return self.replace(
+            block=self.block.at[a[:, None], b[None, :]].set(True)
+        )
+
+    def with_link_world(self, world: LinkWorld | None) -> "FaultPlan":
+        """Attach (or drop, with ``None``) a zone overlay (sim/topology.py)."""
+        return self.replace(link_world=world)
+
 
 def _edge_lookup(mat: jax.Array, src: jax.Array, dst: jax.Array) -> jax.Array:
     """``mat[src, dst]`` honoring the compact [1, 1] uniform layout (indices
@@ -118,6 +149,43 @@ def _edge_lookup(mat: jax.Array, src: jax.Array, dst: jax.Array) -> jax.Array:
     s = jnp.minimum(src, mat.shape[0] - 1)
     d = jnp.minimum(dst, mat.shape[1] - 1)
     return mat[s, d]
+
+
+def edge_blocked(plan: FaultPlan, src: jax.Array, dst: jax.Array) -> jax.Array:
+    """Per-edge hard-block predicate: the plan's link matrix OR'd with the
+    zone overlay's one-way ``block[zone[src], zone[dst]]`` when a LinkWorld
+    is attached. EVERY consumer of block state — delivery decisions AND the
+    C1 accounting reads — must resolve through this helper, or zone-blocked
+    messages would misreport as ``fault_lost``."""
+    blocked = _edge_lookup(plan.block, src, dst)
+    w = plan.link_world
+    if w is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: link_world is None or a LinkWorld), not a traced value
+        blocked = blocked | w.block[w.zone[src], w.zone[dst]]
+    return blocked
+
+
+def edge_loss(plan: FaultPlan, src: jax.Array, dst: jax.Array) -> jax.Array:
+    """Per-edge drop probability: plan loss composed with the zone overlay's
+    as independent drops, ``1 - (1-p)·(1-q)``."""
+    loss = _edge_lookup(plan.loss, src, dst)
+    w = plan.link_world
+    if w is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: link_world is None or a LinkWorld), not a traced value
+        zl = w.loss[w.zone[src], w.zone[dst]]
+        loss = 1.0 - (1.0 - loss) * (1.0 - zl)
+    return loss
+
+
+def edge_mean_delay(plan: FaultPlan, src: jax.Array, dst: jax.Array) -> jax.Array:
+    """Per-edge mean exponential delay (ms): plan delay plus the zone
+    overlay's ``latency[zone[src], zone[dst]]`` (means of independent
+    exponential stages add — the FD round-trip draw already sums leg
+    means). This is the brownout lever: inflating it makes
+    :func:`round_trip_in_time` miss without dropping anything."""
+    mean = _edge_lookup(plan.mean_delay, src, dst)
+    w = plan.link_world
+    if w is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: link_world is None or a LinkWorld), not a traced value
+        mean = mean + w.latency[w.zone[src], w.zone[dst]]
+    return mean
 
 
 def link_pass_from(
@@ -132,8 +200,8 @@ def link_pass_from(
     decision — the decision itself stays shard-local. ``u`` must broadcast
     against the (src, dst) edge set.
     """
-    blocked = _edge_lookup(plan.block, src, dst)
-    loss = _edge_lookup(plan.loss, src, dst)
+    blocked = edge_blocked(plan, src, dst)
+    loss = edge_loss(plan, src, dst)
     return ~blocked & (u >= loss)
 
 
@@ -147,7 +215,7 @@ def link_pass(
     delay are a separate per-path draw (:func:`round_trip_in_time`).
     ``src``/``dst`` are broadcast-compatible int32 index arrays.
     """
-    blocked = _edge_lookup(plan.block, src, dst)
+    blocked = edge_blocked(plan, src, dst)
     u = jax.random.uniform(rng, jnp.shape(blocked))
     return link_pass_from(u, plan, src, dst)
 
@@ -168,7 +236,7 @@ def link_delay_within_tick(
     (sim/tick.py step 6; OutboundSettings.evaluateDelay semantics,
     NetworkEmulator.java:363-368).
     """
-    mean = _edge_lookup(plan.mean_delay, src, dst)
+    mean = edge_mean_delay(plan, src, dst)
     p = jnp.where(
         mean > 0, 1.0 - jnp.exp(-tick_ms / jnp.maximum(mean, 1e-9)), 1.0
     )
@@ -187,7 +255,7 @@ def round_trip_in_time_from(
     the explicit-SPMD engine draws at the full path-set shape (replicated)
     and slices its shard's rows before the Erlang-tail decision."""
     k = len(legs)
-    mean_total = sum(_edge_lookup(plan.mean_delay, s, d) for s, d in legs)
+    mean_total = sum(edge_mean_delay(plan, s, d) for s, d in legs)
     theta = mean_total / k
     has_delay = theta > 0
     x = deadline_ms / jnp.where(has_delay, theta, 1.0)
@@ -225,5 +293,23 @@ def round_trip_in_time(
     )
     u = jax.random.uniform(rng, shape)
     return round_trip_in_time_from(u, plan, legs, deadline_ms)
+
+
+def plan_any_faults(plan: FaultPlan) -> jax.Array:
+    """Scalar bool: could this fixed plan disturb ANY edge? The whole-plan
+    twin of ScheduleBuilder's per-segment ``seg_dirty`` predicate, used by
+    the serving bridge (serve/engine.py) to stamp ``plan_dirty`` on every
+    tick of a fixed-plan launch. Latency counts as dirty — inflated probe
+    deadlines raise suspicions, which the C2/C3 certifiers must be able to
+    attribute to a disturbed timeline."""
+    dirty = (
+        jnp.any(plan.block)
+        | jnp.any(plan.loss > 0)
+        | jnp.any(plan.mean_delay > 0)
+    )
+    w = plan.link_world
+    if w is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: link_world is None or a LinkWorld), not a traced value
+        dirty = dirty | w.any_faults()
+    return dirty
 
 

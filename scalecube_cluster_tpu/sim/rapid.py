@@ -115,7 +115,7 @@ from jax import lax
 from jax.tree_util import register_dataclass
 
 from scalecube_cluster_tpu.ops import merge as merge_ops
-from scalecube_cluster_tpu.sim.faults import FaultPlan, _edge_lookup, link_pass
+from scalecube_cluster_tpu.sim.faults import FaultPlan, edge_blocked, link_pass
 from scalecube_cluster_tpu.sim.knobs import _SUSP_MAX, Knobs
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
@@ -556,10 +556,10 @@ def rapid_tick(
     fd_tick = (t % params.fd_period_ticks) == 0
     in_view = mm[obs, subj]  # [N, k]: observer has this subject in view
     probe_active = fd_tick & alive[obs]
-    ping_blk = _edge_lookup(plan.block, obs, subj)
+    ping_blk = edge_blocked(plan, obs, subj)
     ping_pass = link_pass(k_probe, plan, obs, subj)
     ack_active = probe_active & ping_pass & alive[:, None]
-    ack_blk = _edge_lookup(plan.block, subj, obs)
+    ack_blk = edge_blocked(plan, subj, obs)
     ack_pass = link_pass(k_ack, plan, subj, obs)
     probe_ok = ack_active & ack_pass
     acct = _acct_add(
@@ -610,19 +610,19 @@ def rapid_tick(
         seed = jnp.clip(fb.join_seed, 0, n - 1)
         ph1 = (fb.join_phase == 1) & alive
         ph2 = (fb.join_phase == 2) & alive
-        req_blk = _edge_lookup(plan.block, col, seed)
+        req_blk = edge_blocked(plan, col, seed)
         req_pass = link_pass(k_jreq, plan, col, seed)
         acct = _acct_add(acct, _link_acct(ph1, req_blk, req_pass))
         req_ok = ph1 & req_pass & alive[seed]
-        ack_blk = _edge_lookup(plan.block, seed, col)
+        ack_blk = edge_blocked(plan, seed, col)
         ack_pass = link_pass(k_jack, plan, seed, col)
         acct = _acct_add(acct, _link_acct(req_ok, ack_blk, ack_pass))
         ack_ok = req_ok & ack_pass  # joiner is alive by ph1
-        con_blk = _edge_lookup(plan.block, col, seed)
+        con_blk = edge_blocked(plan, col, seed)
         con_pass = link_pass(k_jcon, plan, col, seed)
         acct = _acct_add(acct, _link_acct(ph2, con_blk, con_pass))
         con_ok = ph2 & con_pass & alive[seed]
-        cack_blk = _edge_lookup(plan.block, seed, col)
+        cack_blk = edge_blocked(plan, seed, col)
         cack_pass = link_pass(k_jcack, plan, seed, col)
         acct = _acct_add(acct, _link_acct(con_ok, cack_blk, cack_pass))
         cack_ok = con_ok & cack_pass
@@ -645,7 +645,7 @@ def rapid_tick(
         # (latched, like alarms — one lost broadcast never loses a cert).
         has_cert = jnp.any(join_ok_l, axis=1) & alive
         send_jb = has_cert[None, :] & (dst_p != src_p)
-        blk_jb = _edge_lookup(plan.block, src_p, dst_p)
+        blk_jb = edge_blocked(plan, src_p, dst_p)
         pass_jb = link_pass(k_jbc, plan, src_p, dst_p)
         acct = _acct_add(acct, _link_acct(send_jb, blk_jb, pass_jb))
         got_jb = ((send_jb & pass_jb) | (has_cert[None, :] & eye)) & alive[
@@ -678,7 +678,7 @@ def rapid_tick(
         is_coord = armed & (cand == col)
         coord_now = is_p0 & is_coord
         send_prep = coord_now[None, :] & (dst_p != src_p)
-        blk_pp = _edge_lookup(plan.block, src_p, dst_p)
+        blk_pp = edge_blocked(plan, src_p, dst_p)
         pass_pp = link_pass(k_prep_s, plan, src_p, dst_p)
         acct = _acct_add(acct, _link_acct(send_prep, blk_pp, pass_pp))
         heard_prep = (send_prep & pass_pp) | (coord_now[None, :] & eye)
@@ -692,7 +692,7 @@ def rapid_tick(
         # Promise replies (acceptor -> coordinator) carry the acceptor's
         # latest acceptance; a locked fast-path vote IS the rank-0 accept.
         send_rep = grant[None, :] & heard_prep.T & (dst_p != src_p)
-        blk_rp = _edge_lookup(plan.block, src_p, dst_p)
+        blk_rp = edge_blocked(plan, src_p, dst_p)
         pass_rp = link_pass(k_prep_r, plan, src_p, dst_p)
         acct = _acct_add(acct, _link_acct(send_rep, blk_rp, pass_rp))
         prom = (send_rep & pass_rp) | (grant[None, :] & heard_prep.T & eye)
@@ -760,7 +760,7 @@ def rapid_tick(
     src_a = obs[None, :, :]  # [1, N, k] broadcast over receivers
     dst_a = col[:, None, None]  # [N, 1, 1]
     send_a = any_alarm[None, :, :] & (dst_a != src_a)
-    blk_a = _edge_lookup(plan.block, src_a, dst_a)
+    blk_a = edge_blocked(plan, src_a, dst_a)
     pass_a = link_pass(k_alarm, plan, src_a, dst_a)
     acct = _acct_add(acct, _link_acct(send_a, blk_a, pass_a))
     msgs_gossip = jnp.sum(send_a, dtype=jnp.int32)
@@ -822,7 +822,7 @@ def rapid_tick(
     # Whole-batch identity (not per-subject voting) is what makes committed
     # views bit-equal across members — the R1 agreement property.
     send_p = proposing[None, :] & (dst_p != src_p)
-    blk_p = _edge_lookup(plan.block, src_p, dst_p)
+    blk_p = edge_blocked(plan, src_p, dst_p)
     pass_p = link_pass(k_prop, plan, src_p, dst_p)
     acct = _acct_add(acct, _link_acct(send_p, blk_p, pass_p))
     recv_p = (send_p & pass_p) | (proposing[None, :] & eye)
@@ -855,7 +855,7 @@ def rapid_tick(
         # classic majority.
         acc_now = is_p1 & fb.prop_ready & alive
         send_acc = acc_now[None, :] & (dst_p != src_p)
-        blk_ac = _edge_lookup(plan.block, src_p, dst_p)
+        blk_ac = edge_blocked(plan, src_p, dst_p)
         pass_ac = link_pass(k_acc_s, plan, src_p, dst_p)
         acct = _acct_add(acct, _link_acct(send_acc, blk_ac, pass_ac))
         heard_acc = (send_acc & pass_ac) | (acc_now[None, :] & eye)
@@ -875,7 +875,7 @@ def rapid_tick(
             acc_ok[:, None], prop_add_new[a_src], fb.acc_add
         )
         send_ar = acc_ok[None, :] & heard_acc.T & (dst_p != src_p)
-        blk_ar = _edge_lookup(plan.block, src_p, dst_p)
+        blk_ar = edge_blocked(plan, src_p, dst_p)
         pass_ar = link_pass(k_acc_r, plan, src_p, dst_p)
         acct = _acct_add(acct, _link_acct(send_ar, blk_ar, pass_ar))
         acc_votes = (send_ar & pass_ar) | (
@@ -900,7 +900,7 @@ def rapid_tick(
         # quorum intersection, §4) or the batch evicts the member itself.
         dec_now = is_p2 & fb.decided & alive
         send_dec = dec_now[None, :] & (dst_p != src_p)
-        blk_dc = _edge_lookup(plan.block, src_p, dst_p)
+        blk_dc = edge_blocked(plan, src_p, dst_p)
         pass_dc = link_pass(k_dec, plan, src_p, dst_p)
         acct = _acct_add(acct, _link_acct(send_dec, blk_dc, pass_dc))
         heard_dec = (send_dec & pass_dc) | (dec_now[None, :] & eye)
@@ -930,7 +930,7 @@ def rapid_tick(
     # ---- 5. view sync: laggards adopt the highest configuration ----------
     sync_tick = (t % params.sync_period_ticks) == 0
     send_s = sync_tick & alive[None, :] & (dst_p != src_p)
-    blk_s = _edge_lookup(plan.block, src_p, dst_p)
+    blk_s = edge_blocked(plan, src_p, dst_p)
     pass_s = link_pass(k_sync, plan, src_p, dst_p)
     acct = _acct_add(acct, _link_acct(send_s, blk_s, pass_s))
     msgs_sync = jnp.sum(send_p, dtype=jnp.int32) + jnp.sum(

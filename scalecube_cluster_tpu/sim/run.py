@@ -29,6 +29,7 @@ from scalecube_cluster_tpu.sim.schedule import (
 )
 from scalecube_cluster_tpu.sim.state import SimState
 from scalecube_cluster_tpu.sim.tick import sim_tick
+from scalecube_cluster_tpu.sim.topology import zone_tick_metrics
 
 
 def scan_ticks(
@@ -59,6 +60,15 @@ def scan_ticks(
             metrics["plan_dirty"] = plan_dirty_at(plan, t)
             metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
             metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+            if plan.link_world is not None:  # tpulint: disable=R1 -- None is static pytree structure, same gate as trace/record_latency
+                metrics.update(
+                    zone_tick_metrics(
+                        plan.link_world,
+                        new_state.view,
+                        new_state.alive,
+                        new_state.epoch,
+                    )
+                )
         return new_state, metrics
 
     return lax.scan(step, state, None, length=n_ticks)

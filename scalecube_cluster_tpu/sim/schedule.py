@@ -54,6 +54,11 @@ from jax.tree_util import register_dataclass
 from scalecube_cluster_tpu.ops import merge as merge_ops
 from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.state import AGE_STALE, SimState
+from scalecube_cluster_tpu.sim.topology import (
+    LinkWorld,
+    stack_segment_worlds,
+    world_segment,
+)
 
 #: Event kinds for ``FaultSchedule.ev_kind``.
 EV_KILL = 0
@@ -90,6 +95,12 @@ class FaultSchedule:
     ev_tick: jax.Array  # [E] int32 global tick (-1 = unused slot)
     ev_node: jax.Array  # [E] int32 member index
     ev_kind: jax.Array  # [E] int32 EV_KILL | EV_RESTART
+    #: Optional geo topology (sim/topology.py), stacked per segment: ``zone``
+    #: stays [N] (assignments never move mid-run), the matrices are
+    #: [K, Z, Z]; ``plan_at`` gathers segment k. None — the default — keeps
+    #: the flat-world pytree, so pre-LinkWorld schedules (and their
+    #: ``digest()`` stamps) are bit-identical.
+    link_world: LinkWorld | None = None
 
     def replace(self, **changes) -> "FaultSchedule":
         return dataclasses.replace(self, **changes)
@@ -99,13 +110,29 @@ class FaultSchedule:
         return self.starts.shape[0]
 
     def digest(self) -> str:
-        """Stable content hash for chaos reproducer lines (host-side)."""
+        """Stable content hash for chaos reproducer lines (host-side).
+
+        None fields are skipped (a flat-world schedule hashes exactly as it
+        did before the ``link_world`` field existed — old CHAOS-REPRO lines
+        stay valid); nested dataclasses (the LinkWorld) recurse field-wise,
+        so zone assignment and every [Z, Z] matrix are digest-sensitive.
+        """
         h = hashlib.sha1()
-        for field in dataclasses.fields(self):
-            arr = np.asarray(getattr(self, field.name))
-            h.update(field.name.encode())
+
+        def update(name: str, value) -> None:
+            if value is None:
+                return
+            if dataclasses.is_dataclass(value):
+                for f in dataclasses.fields(value):
+                    update(f"{name}.{f.name}", getattr(value, f.name))
+                return
+            arr = np.asarray(value)
+            h.update(name.encode())
             h.update(str(arr.shape).encode())
             h.update(np.ascontiguousarray(arr).tobytes())
+
+        for field in dataclasses.fields(self):
+            update(field.name, getattr(self, field.name))
         return h.hexdigest()[:12]
 
 
@@ -132,6 +159,7 @@ def plan_at(schedule: FaultSchedule, t: jax.Array) -> FaultPlan:
         block=block,
         loss=schedule.loss[k],
         mean_delay=schedule.mean_delay[k],
+        link_world=world_segment(schedule.link_world, k),
     )
 
 
@@ -289,7 +317,9 @@ class ScheduleBuilder:
 
     def __init__(self, n: int):
         self.n = int(n)
-        self._segments: list[tuple[int, FaultPlan, np.ndarray | None, int, int]] = []
+        self._segments: list[
+            tuple[int, FaultPlan, np.ndarray | None, int, int, LinkWorld | None]
+        ] = []
         self._events: list[tuple[int, int, int]] = []
 
     def add_segment(
@@ -300,21 +330,31 @@ class ScheduleBuilder:
         flap_mask=None,
         flap_period: int = 0,
         flap_on: int = 0,
+        link_world: LinkWorld | None = None,
     ) -> "ScheduleBuilder":
         """Arm ``plan`` from global tick ``start_tick`` until the next
         segment. Optional square-wave overlay: the links in ``flap_mask``
         ([n, n] or [1, 1] bool) are blocked for the first ``flap_on`` ticks
         of every ``flap_period``-tick window (phase anchored at
-        ``start_tick``)."""
+        ``start_tick``). ``link_world`` (or a world already attached to
+        ``plan``) arms the zone overlay for this segment; all worldly
+        segments of one schedule must share the same zone assignment
+        (sim/topology.py::stack_segment_worlds)."""
         if flap_period < 0 or flap_on < 0 or flap_on > flap_period:
             raise ValueError(
                 f"need 0 <= flap_on <= flap_period, got {flap_on}/{flap_period}"
             )
         if (flap_period > 0) != (flap_mask is not None):
             raise ValueError("flap_mask and flap_period come together")
+        if link_world is not None and plan.link_world is not None:
+            raise ValueError(
+                "pass the segment's LinkWorld either on the plan or as the "
+                "link_world kwarg, not both"
+            )
+        world = link_world if link_world is not None else plan.link_world
         mask = None if flap_mask is None else np.asarray(flap_mask, bool)
         self._segments.append(
-            (int(start_tick), plan, mask, int(flap_period), int(flap_on))
+            (int(start_tick), plan, mask, int(flap_period), int(flap_on), world)
         )
         return self
 
@@ -353,7 +393,7 @@ class ScheduleBuilder:
             raise ValueError(f"duplicate segment start ticks: {starts}")
 
         sides = {1}
-        for _, plan, mask, _, _ in segs:
+        for _, plan, mask, _, _, _ in segs:
             for m in (plan.block, plan.loss, plan.mean_delay):
                 if m.shape[0] not in (1, self.n) or m.shape[0] != m.shape[1]:
                     raise ValueError(
@@ -370,21 +410,31 @@ class ScheduleBuilder:
                 np.asarray(mat, dtype), (m_side, m_side)
             ).copy()
 
-        block = np.stack([bcast(p.block, bool) for _, p, _, _, _ in segs])
-        loss = np.stack([bcast(p.loss, np.float32) for _, p, _, _, _ in segs])
+        block = np.stack([bcast(p.block, bool) for _, p, _, _, _, _ in segs])
+        loss = np.stack([bcast(p.loss, np.float32) for _, p, _, _, _, _ in segs])
         delay = np.stack(
-            [bcast(p.mean_delay, np.float32) for _, p, _, _, _ in segs]
+            [bcast(p.mean_delay, np.float32) for _, p, _, _, _, _ in segs]
         )
         flap = np.stack(
             [
                 np.zeros((m_side, m_side), bool) if m is None else bcast(m, bool)
-                for _, _, m, _, _ in segs
+                for _, _, m, _, _, _ in segs
             ]
         )
+        worlds = [s[5] for s in segs]
+        stacked_world = stack_segment_worlds(worlds, self.n)
+        # Per-segment world dirtiness folds into seg_dirty so the O(1)
+        # plan_dirty_at gather — and through it the C2/C3 clean-tick
+        # predicates — see zone faults (latency included: inflated probe
+        # deadlines raise suspicions a "clean" timeline must not show).
+        world_dirty = [
+            w is not None and bool(jax.device_get(w.any_faults()))
+            for w in worlds
+        ]
         seg_dirty = np.array(
             [
-                bool(b.any() or (l > 0).any() or (d > 0).any())
-                for b, l, d in zip(block, loss, delay)
+                bool(b.any() or (l > 0).any() or (d > 0).any() or wd)
+                for b, l, d, wd in zip(block, loss, delay, world_dirty)
             ]
         )
         flap_any = np.array([bool(m.any()) for m in flap])
@@ -449,4 +499,5 @@ class ScheduleBuilder:
             ev_tick=jnp.asarray(ev_tick),
             ev_node=jnp.asarray(ev_node),
             ev_kind=jnp.asarray(ev_kind),
+            link_world=stacked_world,
         )

@@ -101,7 +101,7 @@ from scalecube_cluster_tpu.ops.merge import (
 from scalecube_cluster_tpu.ops.select import probe_cursor_targets
 from scalecube_cluster_tpu.sim.faults import (
     FaultPlan,
-    _edge_lookup,
+    edge_blocked,
     link_pass_from,
     round_trip_in_time_from,
 )
@@ -131,6 +131,7 @@ from scalecube_cluster_tpu.sim.schedule import (
 )
 from scalecube_cluster_tpu.sim.state import AGE_STALE
 from scalecube_cluster_tpu.sim.tick import _acct_add, _acct_zero, _link_acct
+from scalecube_cluster_tpu.sim.topology import zone_tick_metrics
 
 def sync_accept(learned, mine):
     """Merge-lattice accept test for SYNC-learned records (broadcast-poly).
@@ -911,13 +912,13 @@ def _fd_decide(
         # Fault accounting mirrors tick.py::_fd_vectors exactly: each
         # wire message is delivered, blocked, or lost; the deadline
         # draws (rt_ok/path_ok) are late deliveries, not drops.
-        blk_fwd = _edge_lookup(plan.block, col, tgt)
-        blk_ack = _edge_lookup(plan.block, tgt, col)
+        blk_fwd = edge_blocked(plan, col, tgt)
+        blk_ack = edge_blocked(plan, tgt, col)
         ack_att = probing & fwd_ok & alive_all[tgt]
-        blk1 = _edge_lookup(plan.block, col[:, None], ridx)
-        blk2 = _edge_lookup(plan.block, ridx, tgt[:, None])
-        blk3 = _edge_lookup(plan.block, tgt[:, None], ridx)
-        blk4 = _edge_lookup(plan.block, ridx, col[:, None])
+        blk1 = edge_blocked(plan, col[:, None], ridx)
+        blk2 = edge_blocked(plan, ridx, tgt[:, None])
+        blk3 = edge_blocked(plan, tgt[:, None], ridx)
+        blk4 = edge_blocked(plan, ridx, col[:, None])
         att1 = req_att
         att2 = att1 & leg_or & alive_all[ridx]
         att3 = att2 & leg_rt & alive_all[tgt][:, None]
@@ -1056,7 +1057,7 @@ def _sync_fire(
         # draw covers both directions), so a reverse attempt exists iff
         # the exchange happened (``ok``) and is always delivered.
         att_f = v_alive & (prt != col)
-        acct_f = _link_acct(att_f, _edge_lookup(plan.block, col, prt), s_pass)
+        acct_f = _link_acct(att_f, edge_blocked(plan, col, prt), s_pass)
         n_rev = jnp.sum(ok, dtype=jnp.int32)
         out = out + (acct_f[0] + n_rev, acct_f[1] + n_rev, acct_f[2], acct_f[3])
     return out
@@ -1814,7 +1815,7 @@ def sparse_tick(
         g_att_c = [m & elive[c] for c, m in enumerate(g_att_c)]
     g_acct = _acct_zero()
     for c in range(p.gossip_fanout):
-        g_blk = _edge_lookup(plan.block, inv_perm[c], col)
+        g_blk = edge_blocked(plan, inv_perm[c], col)
         g_acct = _acct_add(g_acct, _link_acct(g_att_c[c], g_blk, gpass[c]))
     acct = _acct_add(fd_out[7:11], g_acct, sy_out[7:11])
     viewer_live = alive[:, None] & active[None, :]
@@ -1926,6 +1927,15 @@ def scan_sparse_ticks(
             metrics["plan_dirty"] = plan_dirty_at(plan, t)
             metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
             metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+            if plan.link_world is not None:  # tpulint: disable=R1 -- None is static pytree structure, same gate as trace/record_latency
+                metrics.update(
+                    zone_tick_metrics(
+                        plan.link_world,
+                        effective_view(new_state),
+                        new_state.alive,
+                        new_state.epoch,
+                    )
+                )
         return new_state, metrics
 
     return lax.scan(step, state, None, length=n_ticks)

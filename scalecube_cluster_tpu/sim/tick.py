@@ -115,7 +115,7 @@ from scalecube_cluster_tpu.ops.select import (
 )
 from scalecube_cluster_tpu.sim.faults import (
     FaultPlan,
-    _edge_lookup,
+    edge_blocked,
     link_delay_within_tick,
     link_pass,
     round_trip_in_time,
@@ -250,8 +250,8 @@ def _fd_vectors(params, state, plan, keys, cand, view0, fd_round, collect):
     # collect). Each FD wire message is attributed to exactly one of
     # delivered/blocked/lost; the deadline draws (rt_ok/path_ok) are late
     # deliveries, not drops, so they do not enter the conservation split.
-    blk_fwd = _edge_lookup(plan.block, i_idx, tgt)
-    blk_ack = _edge_lookup(plan.block, tgt, i_idx)
+    blk_fwd = edge_blocked(plan, i_idx, tgt)
+    blk_ack = edge_blocked(plan, tgt, i_idx)
     ping_acct = _link_acct(probing, blk_fwd, fwd_ok)
     # The target acks only a ping it actually received while alive.
     ack_att = probing & fwd_ok & alive[tgt]
@@ -259,10 +259,10 @@ def _fd_vectors(params, state, plan, keys, cand, view0, fd_round, collect):
     # Indirect cascade: each leg's attempt requires the previous leg to have
     # delivered to a live hop (origin→relay PING_REQ, relay→target transit,
     # target→relay ack, relay→origin forward).
-    blk1 = _edge_lookup(plan.block, i_idx[:, None], ridx)
-    blk2 = _edge_lookup(plan.block, ridx, tgt[:, None])
-    blk3 = _edge_lookup(plan.block, tgt[:, None], ridx)
-    blk4 = _edge_lookup(plan.block, ridx, i_idx[:, None])
+    blk1 = edge_blocked(plan, i_idx[:, None], ridx)
+    blk2 = edge_blocked(plan, ridx, tgt[:, None])
+    blk3 = edge_blocked(plan, tgt[:, None], ridx)
+    blk4 = edge_blocked(plan, ridx, i_idx[:, None])
     att1 = req_att
     att2 = att1 & leg_or & alive[ridx]
     att3 = att2 & leg_rt & alive[tgt][:, None]
@@ -459,10 +459,10 @@ def sim_tick(
                 s_att = do_sync & p_valid
                 sync_acct = _acct_add(
                     _link_acct(
-                        s_att, _edge_lookup(plan.block, i_idx, prt), s_pass_fwd
+                        s_att, edge_blocked(plan, i_idx, prt), s_pass_fwd
                     ),
                     _link_acct(
-                        s_fwd, _edge_lookup(plan.block, prt, i_idx), s_pass_rev
+                        s_fwd, edge_blocked(plan, prt, i_idx), s_pass_rev
                     ),
                 )
             else:
@@ -790,7 +790,7 @@ def sim_tick(
     # link_attempts == link_delivered + fault_blocked + fault_lost.
     g_acct = _acct_zero()
     for c in range(params.gossip_fanout):
-        g_blk = _edge_lookup(plan.block, inv_perm[c], i_idx)
+        g_blk = edge_blocked(plan, inv_perm[c], i_idx)
         g_acct = _acct_add(g_acct, _link_acct(g_att_c[c], g_blk, gpass[c]))
     acct = _acct_add(
         tuple(fd_extras[3 + k] for k in range(4)), g_acct, tuple(sync_acct)

@@ -472,3 +472,208 @@ def chaos_soak(
             if on_result is not None:
                 on_result(r)
     return results
+
+
+# ------------------------------------------------------------------ geo
+# Geo-distributed chaos: the same seeded-trial contract over LinkWorld
+# timelines (sim/topology.py). Every geo trial is still a pure function of
+# ``(seed, n, engine)`` and reproduces from the same CHAOS-REPRO line —
+# the schedule digest hashes the zone assignment and the [Z, Z] matrices,
+# so a geo one-liner pins the whole world, not just the flat plan.
+
+#: Geo scenario variants, indexed by the draw in :func:`sample_geo_schedule`:
+#: ``split2``    — symmetric 2-zone split-brain (both cross-zone directions
+#:                 blocked); the minority datacenter goes dark.
+#: ``brownout3`` — 3-zone WAN brownout: pure latency inflation on every
+#:                 cross-zone pair, drawn to race the 500 ms probe deadline
+#:                 (no message is ever dropped — suspicions must refute).
+#: ``oneway``    — asymmetric partition: majority->minority direction
+#:                 blocked, minority->majority stays up. Pings cross, acks
+#:                 die — both sides suspect, but ``fault_blocked`` counts
+#:                 only one direction (the C1 split satellite pins this).
+GEO_VARIANTS = ("split2", "brownout3", "oneway")
+#: Engines in the default geo matrix: zone gauges certify Z1-Z3 on the two
+#: SWIM engines; the Rapid fallback trim is certified R1-R5 (its FD draws
+#: no round-trip deadline, so geo coverage comes from the block variants).
+GEO_ENGINES = ("dense", "sparse", "rapid_fb")
+#: Cross-zone brownout latency band (ms): drawn against the 500 ms probe
+#: deadline so round trips miss on the Erlang tail without any loss.
+GEO_BROWNOUT_LO_MS, GEO_BROWNOUT_HI_MS = 350.0, 450.0
+
+
+def geo_minority(n: int) -> int:
+    """Minority-zone size for the split/oneway variants (the first
+    ``m`` members form zone 1 — same pool the flat sampler partitions)."""
+    return max(2, n // 4)
+
+
+def geo_world(n: int, variant: str, rng) -> "LinkWorld":
+    """The LinkWorld of one geo variant (clean matrices disturbed per the
+    variant's draw)."""
+    from scalecube_cluster_tpu.sim.topology import LinkWorld
+
+    if variant == "brownout3":
+        w = LinkWorld.even_zones(n, 3)
+        lat = float(rng.uniform(GEO_BROWNOUT_LO_MS, GEO_BROWNOUT_HI_MS))
+        for za in range(3):
+            for zb in range(za + 1, 3):
+                w = w.with_zone_latency(za, zb, lat)
+        return w
+    m = geo_minority(n)
+    zone = np.zeros(n, np.int32)
+    zone[:m] = 1
+    w = LinkWorld.from_zones(zone, n_zones=2)
+    if variant == "split2":
+        return w.block_zones(0, 1, symmetric=True)
+    if variant == "oneway":
+        # Majority -> minority blocked: the minority still reaches out,
+        # nothing comes back.
+        return w.block_zones(0, 1, symmetric=False)
+    raise ValueError(f"unknown geo variant {variant!r}")
+
+
+def geo_trial_ticks(params: SimParams) -> int:
+    """Geo trial length: worst-case disturbance end + the Z3 zone-aware
+    heal bound at the matrix's max zone count (3) + a cadence cushion —
+    static given params, shared by every geo seed and engine."""
+    from scalecube_cluster_tpu.testlib.invariants import zone_heal_bound
+
+    return DISTURB_END_MAX + zone_heal_bound(params, 3) + 10
+
+
+def sample_geo_schedule(seed: int, n: int, with_meta: bool = False):
+    """Draw one geo chaos schedule from ``seed``: clean warm-up, one
+    LinkWorld disturbance window (split2 / brownout3 / oneway, uniformly
+    chosen), the standard kill+restart pairs on majority-zone members, then
+    clean through the end. Same static shape as :func:`sample_schedule`
+    (3 segments, ``CHAOS_KILLS`` event pairs), so a geo seed matrix shares
+    one executable per engine and zone count.
+
+    ``with_meta=True`` also returns the certification windows: ``variant``,
+    ``disturb_start``/``disturb_end``, plus the Z1/Z2 kwargs for
+    :func:`~scalecube_cluster_tpu.testlib.invariants.certify_zone_traces`
+    (``brownout``/``split`` window, ``n_zones``)."""
+    rng = np.random.default_rng(seed)
+    d0 = int(rng.integers(DISTURB_START_LO, DISTURB_START_HI + 1))
+    d1 = d0 + int(rng.integers(DISTURB_LEN_LO, DISTURB_LEN_HI + 1))
+    variant = GEO_VARIANTS[int(rng.integers(0, len(GEO_VARIANTS)))]
+    world = geo_world(n, variant, rng)
+    m = geo_minority(n)
+    clean = FaultPlan.clean(n)
+
+    b = (
+        ScheduleBuilder(n)
+        .add_segment(0, clean)
+        .add_segment(d0, clean, link_world=world)
+        .add_segment(d1, clean)
+    )
+    # Same churn recipe as the flat sampler: kill majority-zone members
+    # early in the window, restart each before it closes (the minority
+    # zone never loses a member, so Z2's clean-zone ledger stays sharp).
+    majority = np.arange(m, n)
+    victims = rng.choice(majority, size=CHAOS_KILLS, replace=False)
+    for i, node in enumerate(victims):
+        k_tick = d0 + 1 + 2 * i
+        r_tick = int(rng.integers(k_tick + 5, d1))
+        b.kill(k_tick, int(node)).restart(r_tick, int(node))
+    schedule = b.build()
+    if with_meta:
+        # Certification windows in TRACE-ROW coordinates: global tick t is
+        # trace row t-1 (the first scanned tick is t=1), so the disturbed
+        # segment [d0, d1) covers rows [d0-1, d1-1) and the first heal row
+        # is d1-1. Off by one and Z2 would see the post-heal tombstone
+        # flood (majority DEAD records reaching the minority on the heal
+        # tick, refuted ticks later) as a clean-zone verdict.
+        window = (d0 - 1, d1 - 1)
+        # Z2 scope: a zone only counts as clean if it cannot HEAR the
+        # disturbance. Under split2 neither side hears the other, so both
+        # zones certify. Under oneway the minority->majority direction
+        # stays open: the stranded minority sweeps the unreachable
+        # majority to DEAD and gossips those tombstones INTO the majority,
+        # which transiently accepts them until the subjects refute —
+        # protocol-correct traffic, not a majority-zone verdict. Only the
+        # shielded minority (zone 1) certifies Z2 there.
+        meta = {
+            "variant": variant,
+            "disturb_start": d0,
+            "disturb_end": d1,
+            "n_zones": world.n_zones,
+            "minority": m if variant != "brownout3" else None,
+            "brownout": window if variant == "brownout3" else None,
+            "split": window if variant != "brownout3" else None,
+            "clean_zones": [1] if variant == "oneway" else None,
+            "heal_row": d1 - 1,
+        }
+        return schedule, meta
+    return schedule
+
+
+def geo_trial(seed: int, n: int, engine: str) -> dict:
+    """One seeded geo trial: sample a LinkWorld timeline, run, certify.
+    SWIM engines (``dense``/``sparse``) are certified C1-C7 **and** Z1-Z3
+    from their per-zone gauges; Rapid engines add R1-R4 (R5 too for the
+    fallback trim) on top of C1-C7. Never raises — violations come back as
+    ``ok=False`` rows with the CHAOS-REPRO line, exactly like
+    :func:`chaos_trial`."""
+    from scalecube_cluster_tpu.testlib.invariants import certify_zone_traces
+
+    params = chaos_params(n)
+    schedule, meta = sample_geo_schedule(seed, n, with_meta=True)
+    ticks = geo_trial_ticks(params)
+    repro = reproducer_line(seed, n, engine, ticks, schedule.digest())
+    result = {
+        "seed": seed,
+        "n": n,
+        "engine": engine,
+        "ticks": ticks,
+        "digest": schedule.digest(),
+        "reproducer": repro,
+        "variant": meta["variant"],
+    }
+    try:
+        _, traces, conv = run_scheduled(engine, params, schedule, ticks)
+        summary = certify_traces(params, traces)
+        if engine in ("rapid", "rapid_fb"):
+            summary = {
+                **summary,
+                **certify_rapid_traces(
+                    rapid_chaos_params(n), traces,
+                    fallback=engine == "rapid_fb",
+                ),
+            }
+        else:
+            summary = {
+                **summary,
+                **certify_zone_traces(
+                    params,
+                    traces,
+                    brownout=meta["brownout"],
+                    split=meta["split"],
+                    clean_zones=meta["clean_zones"],
+                    heal_start=meta["heal_row"],
+                    context=f"geo {meta['variant']} seed={seed}",
+                ),
+            }
+        certify_heal(params, summary, conv)
+    except InvariantViolation as e:
+        result.update(ok=False, violation=e.invariant, error=str(e))
+        return result
+    result.update(ok=True, final_convergence=conv, **summary)
+    return result
+
+
+def geo_chaos_matrix(
+    seeds, n: int, engines=GEO_ENGINES, on_result=None
+) -> list[dict]:
+    """The seed x engine geo matrix (host-driven trials; the geo plans'
+    LinkWorld pytrees share one treedef per zone count, so compiles amortize
+    across seeds). Returns every row, violations included — callers
+    assert."""
+    results = []
+    for seed in seeds:
+        for engine in engines:
+            r = geo_trial(int(seed), n, engine)
+            results.append(r)
+            if on_result is not None:
+                on_result(r)
+    return results

@@ -72,6 +72,63 @@ class InboundSettings:
     shall_pass: bool = True
 
 
+@dataclass(frozen=True)
+class ZoneModel:
+    """Host twin of sim/topology.py::LinkWorld for crossval.
+
+    The same zone overlay the sim engines resolve per edge with O(1)
+    gathers (sim/faults.py::edge_blocked / edge_loss / edge_mean_delay),
+    expressed over host addresses: each address maps to a zone, each
+    zone pair carries block / extra-loss / extra-latency settings, and
+    :meth:`compose` folds them into a link's base
+    :class:`OutboundSettings` with the exact sim formulas — OR for
+    blocks, ``1-(1-p)(1-q)`` for independent drops, additive means for
+    the exponential delay stages. tests/test_crossval.py pins the
+    composition numerically against the sim helpers edge by edge.
+
+    Loss lives in PERCENT here (the emulator's unit) vs fraction in the
+    LinkWorld matrices; :meth:`from_link_world` converts.
+    """
+
+    zone: dict[Address, int]
+    latency_ms: tuple  # [Z][Z] extra one-way mean delay, ms
+    loss_percent: tuple  # [Z][Z] extra one-way drop probability, percent
+    block: tuple  # [Z][Z] one-way hard blocks
+
+    @classmethod
+    def from_link_world(cls, world, addresses) -> "ZoneModel":
+        """Build from a device LinkWorld; ``addresses[i]`` is member i."""
+        import numpy as np
+
+        zone = np.asarray(world.zone)
+        lat = np.asarray(world.latency)
+        loss = np.asarray(world.loss)
+        blk = np.asarray(world.block)
+        return cls(
+            zone={a: int(zone[i]) for i, a in enumerate(addresses)},
+            latency_ms=tuple(tuple(float(x) for x in row) for row in lat),
+            loss_percent=tuple(
+                tuple(100.0 * float(x) for x in row) for row in loss
+            ),
+            block=tuple(tuple(bool(x) for x in row) for row in blk),
+        )
+
+    def compose(
+        self, base: OutboundSettings, src: Address, dst: Address
+    ) -> OutboundSettings:
+        """Fold the src→dst zone overlay into ``base`` — the host-side
+        mirror of the three ``edge_*`` helpers in sim/faults.py."""
+        za, zb = self.zone.get(src), self.zone.get(dst)
+        if za is None or zb is None:
+            return base
+        p, q = base.loss_percent / 100.0, self.loss_percent[za][zb] / 100.0
+        return OutboundSettings(
+            loss_percent=100.0 * (1.0 - (1.0 - p) * (1.0 - q)),
+            mean_delay_ms=base.mean_delay_ms + self.latency_ms[za][zb],
+            blocked=base.blocked or self.block[za][zb],
+        )
+
+
 class NetworkEmulator:
     """Mutable fault plan + counters for one node's links."""
 
@@ -86,6 +143,7 @@ class NetworkEmulator:
         self.total_outbound_lost_count = 0
         self.total_inbound_lost_count = 0
         self._counters = None  # optional ProtocolCounters (attach_counters)
+        self._zone_model: ZoneModel | None = None
 
     def attach_counters(self, counters) -> None:
         """Feed drop events into a node's :class:`ProtocolCounters` block so
@@ -94,10 +152,22 @@ class NetworkEmulator:
         when its transport carries a ``network_emulator``)."""
         self._counters = counters
 
+    def set_zone_model(self, model: ZoneModel | None) -> None:
+        """Attach (or drop, with ``None``) the zone overlay. Per-link and
+        default settings keep working; the overlay composes on top of
+        whichever resolves, exactly as the sim's edge helpers compose the
+        LinkWorld over the FaultPlan matrices."""
+        self._zone_model = model
+
     # -- settings resolution (NetworkEmulator.java:60-85)
 
     def outbound_settings_of(self, destination: Address) -> OutboundSettings:
-        return self._outbound.get(destination, self._default_outbound)
+        settings = self._outbound.get(destination, self._default_outbound)
+        if self._zone_model is not None:
+            settings = self._zone_model.compose(
+                settings, self._local, destination
+            )
+        return settings
 
     def inbound_settings_of(self, source: Address) -> InboundSettings:
         return self._inbound.get(source, self._default_inbound)

@@ -199,3 +199,59 @@ async def test_protocol_counters_match_host():
         f"sim={result['sim_ping_rate']:.2f} host acks/period="
         f"{result['host_ack_rate']:.2f} sim={result['sim_ack_rate']:.2f}"
     )
+
+
+def test_zone_model_composition_matches_sim_edge_helpers():
+    """The host emulator's ZoneModel must compose zone overlays with the
+    EXACT formulas the sim engines resolve per edge (sim/faults.py::
+    edge_blocked / edge_loss / edge_mean_delay): OR for blocks,
+    1-(1-p)(1-q) for independent drops, additive exponential means. A
+    drawn 3-zone world over a lossy base plan is compared edge by edge —
+    bit-level agreement on blocks, float tolerance on the composed
+    loss/delay (the host computes in float64, the device in float32)."""
+    import jax.numpy as jnp
+
+    from scalecube_cluster_tpu.sim.faults import (
+        FaultPlan,
+        edge_blocked,
+        edge_loss,
+        edge_mean_delay,
+    )
+    from scalecube_cluster_tpu.sim.topology import LinkWorld
+    from scalecube_cluster_tpu.testlib.network_emulator import (
+        NetworkEmulator,
+        ZoneModel,
+    )
+    from scalecube_cluster_tpu.utils.address import Address
+
+    n = 12
+    rng = np.random.default_rng(5)
+    zone = rng.integers(0, 3, size=n).astype(np.int32)
+    world = (
+        LinkWorld.from_zones(zone, n_zones=3)
+        .with_zone_latency(0, 1, 80.0)
+        .with_zone_latency(1, 2, 400.0)
+        .with_zone_loss(0, 2, 0.25)
+        .block_zones(2, 0, symmetric=False)
+    )
+    plan = FaultPlan.uniform(loss_percent=10.0, mean_delay_ms=2.0)
+    plan = plan.with_link_world(world)
+
+    addresses = [Address("127.0.0.1", 20000 + i) for i in range(n)]
+    model = ZoneModel.from_link_world(world, addresses)
+
+    src = jnp.arange(n, dtype=jnp.int32)[:, None].repeat(n, axis=1)
+    dst = jnp.arange(n, dtype=jnp.int32)[None, :].repeat(n, axis=0)
+    sim_blk = np.asarray(edge_blocked(plan, src, dst))
+    sim_loss = np.asarray(edge_loss(plan, src, dst))
+    sim_delay = np.asarray(edge_mean_delay(plan, src, dst))
+
+    for i in range(n):
+        em = NetworkEmulator(addresses[i], seed=0)
+        em.set_default_outbound_settings(10.0, 2.0)
+        em.set_zone_model(model)
+        for j in range(n):
+            s = em.outbound_settings_of(addresses[j])
+            assert s.blocked == bool(sim_blk[i, j]), (i, j)
+            assert abs(s.loss_percent / 100.0 - float(sim_loss[i, j])) < 1e-6
+            assert abs(s.mean_delay_ms - float(sim_delay[i, j])) < 1e-4

@@ -1,0 +1,280 @@
+"""Geo-distributed LinkWorlds (sim/topology.py) across the engine fleet.
+
+Five layers:
+
+1. Parity — attaching a flat (all-clean) LinkWorld to a faulted schedule
+   is protocol-inert on dense, sparse and Rapid: every shared trace key
+   and every final state leaf is bit-identical to the ``link_world=None``
+   run, and the SWIM engines gain exactly the three per-zone gauge keys.
+   (The ``None`` path itself is pinned pre-PR by tests/test_chaos.py's
+   zero-event parity and the Rapid PR-6 golden digests.)
+2. Asymmetry — ``FaultPlan.partition_oneway`` blocks HALF the edges of the
+   symmetric partition, the C1 conservation ledger counts the difference,
+   and the dense-matrix encoding is bit-identical to the same world
+   expressed as a zone-resolved ``LinkWorld.block_zones(symmetric=False)``.
+3. Digest — the flat-schedule digest pin (old CHAOS-REPRO lines stay
+   valid) plus sensitivity: the zone assignment and every [Z, Z] matrix
+   reach the hash.
+4. Brownout — a 2-zone 400 ms cross-zone latency inflation races the
+   500 ms probe deadline: suspicions fire in-zone-crossing pairs but Z1
+   forbids any false DEAD verdict and the cluster re-converges inside the
+   zone-aware heal bound, on both SWIM engines.
+5. Seeded geo chaos — one ``oneway`` draw from the geo matrix
+   (testlib/chaos.py) certifies end-to-end on dense and on the Rapid
+   fallback trim (whose stranded-minority coordinator rotation is pinned
+   by tests/test_rapid_fallback.py), and the CHAOS-REPRO line re-samples
+   to the same schedule digest.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.sim import FaultPlan, ScheduleBuilder
+from scalecube_cluster_tpu.sim.topology import LinkWorld
+from scalecube_cluster_tpu.testlib.chaos import (
+    chaos_params,
+    geo_trial,
+    geo_trial_ticks,
+    run_scheduled,
+    sample_geo_schedule,
+)
+from scalecube_cluster_tpu.testlib.invariants import (
+    certify_heal,
+    certify_traces,
+    certify_zone_traces,
+    zone_heal_bound,
+)
+
+N = 16
+ZONE_KEYS = {"zone_intra_conv", "zone_false_dead", "zone_intra_suspects"}
+#: The pre-LinkWorld digest of the flat baseline schedule below — None
+#: fields are skipped by FaultSchedule.digest(), so every CHAOS-REPRO line
+#: minted before this PR must keep resolving to the same hash.
+FLAT_DIGEST = "83ba7a07f0ee"
+
+
+def _baseline_schedule(link_world=None):
+    """The digest-pinned flat timeline, optionally with a world attached
+    to its disturbed segment."""
+    return (
+        ScheduleBuilder(N)
+        .add_segment(0, FaultPlan.clean(N))
+        .add_segment(4, FaultPlan.uniform(loss_percent=10.0), link_world=link_world)
+        .kill(5, 1)
+        .restart(9, 1)
+        .build()
+    )
+
+
+def _faulted_schedule(link_world=None):
+    """Loss + kill/restart + heal — enough traffic to catch any RNG or
+    dataflow perturbation from the world overlay."""
+    return (
+        ScheduleBuilder(N)
+        .add_segment(0, FaultPlan.clean(N))
+        .add_segment(6, FaultPlan.uniform(loss_percent=10.0), link_world=link_world)
+        .add_segment(30, FaultPlan.clean(N))
+        .kill(8, 2)
+        .restart(20, 2)
+        .build()
+    )
+
+
+def _state_leaves(state):
+    return jax.tree_util.tree_leaves(state)
+
+
+# -- 1. flat-world attachment is protocol-inert --------------------------------
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse", "rapid"])
+def test_flat_world_attachment_is_protocol_inert(engine):
+    params = chaos_params(N)
+    ticks = 60
+    st_a, tr_a, conv_a = run_scheduled(
+        engine, params, _faulted_schedule(), ticks
+    )
+    st_b, tr_b, conv_b = run_scheduled(
+        engine, params, _faulted_schedule(LinkWorld.flat(N)), ticks
+    )
+    if engine == "rapid":
+        # Rapid keeps its R-gauge schema — no zone keys, nothing else new.
+        assert set(tr_a) == set(tr_b)
+    else:
+        assert set(tr_b) - set(tr_a) == ZONE_KEYS
+        assert not (ZONE_KEYS & set(tr_a))
+        # A flat world is one zone: the gauges are [T, 1] and vacuous.
+        assert np.asarray(tr_b["zone_false_dead"]).shape == (ticks, 1)
+    for k in sorted(set(tr_a) & set(tr_b)):
+        assert np.array_equal(np.asarray(tr_a[k]), np.asarray(tr_b[k])), (
+            engine,
+            k,
+        )
+    assert conv_a == conv_b
+    for la, lb in zip(_state_leaves(st_a), _state_leaves(st_b), strict=True):
+        assert np.array_equal(np.asarray(la), np.asarray(lb)), engine
+
+
+# -- 2. one-way vs symmetric partitions under the C1 ledger --------------------
+
+
+def test_partition_oneway_blocks_half_the_symmetric_ledger():
+    """Over the same window, the symmetric partition's ``fault_blocked``
+    total must strictly dominate the one-way cut's (both directions die
+    vs one), and both runs still balance C1-C7."""
+    params = chaos_params(N)
+    minority = list(range(4))
+    majority = list(range(4, N))
+    ticks = 140
+
+    def build(plan):
+        return (
+            ScheduleBuilder(N)
+            .add_segment(0, FaultPlan.clean(N))
+            .add_segment(10, plan)
+            .add_segment(50, FaultPlan.clean(N))
+            .build()
+        )
+
+    sym = build(FaultPlan.clean(N).partition(majority, minority))
+    one = build(FaultPlan.clean(N).partition_oneway(majority, minority))
+    _, tr_sym, _ = run_scheduled("dense", params, sym, ticks)
+    _, tr_one, _ = run_scheduled("dense", params, one, ticks)
+    certify_traces(params, tr_sym)
+    certify_traces(params, tr_one)
+    blocked_sym = int(np.asarray(tr_sym["fault_blocked"]).sum())
+    blocked_one = int(np.asarray(tr_one["fault_blocked"]).sum())
+    assert blocked_one > 0
+    assert blocked_sym > blocked_one, (blocked_sym, blocked_one)
+
+
+def test_oneway_zone_block_matches_dense_matrix_encoding():
+    """The same asymmetric world written two ways — a dense [N, N] block
+    matrix vs a zone-resolved ``block_zones(symmetric=False)`` overlay —
+    must run bit-identically on the dense engine (modulo the zone gauges
+    only the world run emits)."""
+    params = chaos_params(N)
+    minority = list(range(4))
+    majority = list(range(4, N))
+    ticks = 80
+
+    zone = np.zeros(N, np.int32)
+    zone[minority] = 1
+    world = LinkWorld.from_zones(zone, n_zones=2).block_zones(
+        0, 1, symmetric=False
+    )
+
+    def build(plan, link_world=None):
+        return (
+            ScheduleBuilder(N)
+            .add_segment(0, FaultPlan.clean(N))
+            .add_segment(10, plan, link_world=link_world)
+            .add_segment(50, FaultPlan.clean(N))
+            .build()
+        )
+
+    dense_enc = build(FaultPlan.clean(N).partition_oneway(majority, minority))
+    zone_enc = build(FaultPlan.clean(N), link_world=world)
+    st_a, tr_a, _ = run_scheduled("dense", params, dense_enc, ticks)
+    st_b, tr_b, _ = run_scheduled("dense", params, zone_enc, ticks)
+    assert set(tr_b) - set(tr_a) == ZONE_KEYS
+    for k in sorted(set(tr_a) & set(tr_b)):
+        assert np.array_equal(np.asarray(tr_a[k]), np.asarray(tr_b[k])), k
+    for la, lb in zip(_state_leaves(st_a), _state_leaves(st_b), strict=True):
+        assert np.array_equal(np.asarray(la), np.asarray(lb))
+
+
+# -- 3. digest pin + LinkWorld sensitivity -------------------------------------
+
+
+def test_flat_schedule_digest_is_pinned():
+    assert _baseline_schedule().digest() == FLAT_DIGEST
+
+
+def test_link_world_reaches_the_digest():
+    flat = _baseline_schedule()
+    with_world = _baseline_schedule(LinkWorld.even_zones(N, 2))
+    assert with_world.digest() != flat.digest()
+    # Every world field is digest-sensitive: latency, block, zone map.
+    lat = _baseline_schedule(
+        LinkWorld.even_zones(N, 2).with_zone_latency(0, 1, 400.0)
+    )
+    blk = _baseline_schedule(
+        LinkWorld.even_zones(N, 2).block_zones(0, 1, symmetric=False)
+    )
+    zone = np.zeros(N, np.int32)
+    zone[:3] = 1
+    remap = _baseline_schedule(LinkWorld.from_zones(zone, n_zones=2))
+    digests = {
+        with_world.digest(),
+        lat.digest(),
+        blk.digest(),
+        remap.digest(),
+        flat.digest(),
+    }
+    assert len(digests) == 5, digests
+
+
+# -- 4. the 2-zone brownout: suspicion without verdict (Z1) --------------------
+
+
+@pytest.mark.parametrize("engine", ["dense", "sparse"])
+def test_two_zone_brownout_certifies_z1_z3(engine):
+    """400 ms cross-zone latency against the 500 ms probe deadline: the
+    Erlang round-trip tail misses often enough to raise cross-zone
+    suspicions, but no member may ever be sworn DEAD (Z1) and the cluster
+    must re-converge inside the zone-aware heal bound once the WAN
+    recovers (Z3) — while C1-C7 keep holding through the whole timeline."""
+    params = chaos_params(N)
+    d0, d1 = 10, 70
+    ticks = d1 + zone_heal_bound(params, 2) + 10
+    world = LinkWorld.even_zones(N, 2).with_zone_latency(0, 1, 400.0)
+    sched = (
+        ScheduleBuilder(N)
+        .add_segment(0, FaultPlan.clean(N))
+        .add_segment(d0, FaultPlan.clean(N), link_world=world)
+        .add_segment(d1, FaultPlan.clean(N))
+        .build()
+    )
+    _, traces, conv = run_scheduled(engine, params, sched, ticks)
+    summary = certify_traces(params, traces)
+    zsum = certify_zone_traces(
+        params,
+        traces,
+        brownout=(d0 - 1, d1 - 1),
+        heal_start=d1 - 1,
+        context=f"2-zone brownout {engine}",
+    )
+    assert zsum["z1_checked"] and zsum["z3_checked"]
+    certify_heal(params, summary, conv)
+    # The brownout must actually bite the FD — suspicion pressure is the
+    # evidence that Z1 ran against a perturbed detector, not a quiet one.
+    suspects = np.asarray(traces["zone_intra_suspects"])
+    assert suspects.shape == (ticks, 2)
+    assert int(suspects.sum()) > 0
+    assert int(np.asarray(traces["zone_false_dead"]).sum()) == 0
+
+
+# -- 5. seeded geo chaos: the oneway draw, reproducible ------------------------
+
+
+@pytest.mark.parametrize("engine", ["dense", "rapid_fb"])
+def test_geo_chaos_oneway_seed_certifies(engine):
+    r = geo_trial(1, N, engine)
+    assert r["variant"] == "oneway"
+    assert r["ok"], r
+    # The CHAOS-REPRO line alone pins the whole world: re-sampling from
+    # the printed seed must land on the printed schedule digest.
+    m = re.fullmatch(
+        r"CHAOS-REPRO seed=(\d+) n=(\d+) engine=(\w+) "
+        r"ticks=(\d+) digest=([0-9a-f]+)",
+        r["reproducer"],
+    )
+    assert m, r["reproducer"]
+    seed, n = int(m.group(1)), int(m.group(2))
+    resampled = sample_geo_schedule(seed, n)
+    assert resampled.digest() == m.group(5)
+    assert int(m.group(4)) == geo_trial_ticks(chaos_params(n))

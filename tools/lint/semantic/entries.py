@@ -98,6 +98,48 @@ def _build_run_ticks(schedule=False):
     )
 
 
+def _geo_schedule(n):
+    # A LinkWorld-bearing schedule (sim/topology.py): 2 zones, one segment
+    # browning out the cross-zone pair, one blocking it one-way. The world
+    # is pytree STRUCTURE (link_world=None is a different treedef), so
+    # every geo entry is a distinct executable to census — and the zone
+    # gauges join the scheduled scan's trace dict on the SWIM engines.
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
+    from scalecube_cluster_tpu.sim.topology import LinkWorld
+
+    world = LinkWorld.even_zones(n, 2)
+    return (
+        ScheduleBuilder(n)
+        .add_segment(0, FaultPlan.uniform())
+        .add_segment(
+            2,
+            FaultPlan.uniform(loss_percent=10.0),
+            link_world=world.with_zone_latency(0, 1, 400.0),
+        )
+        .add_segment(
+            3,
+            FaultPlan.uniform(),
+            link_world=world.block_zones(0, 1, symmetric=False),
+        )
+        .kill(2, 1)
+        .restart(3, 1)
+        .build()
+    )
+
+
+def _build_run_ticks_geo():
+    from scalecube_cluster_tpu.sim.run import run_ticks
+
+    params, state, _, seeds = _dense_inputs()
+    return (
+        run_ticks,
+        (params, state, _geo_schedule(N), seeds, T),
+        {"collect": True},
+        {"state_argnum": 1, "state_out": _state_first},
+    )
+
+
 def _build_run_ticks_pallas():
     import dataclasses
 
@@ -159,6 +201,43 @@ def _build_run_sparse_ticks(pallas_core, schedule=False, trace_capacity=0):
             "static_argnames": ("collect",),
             "pallas": pallas_core,
         },
+    )
+
+
+def _build_run_sparse_ticks_geo():
+    from scalecube_cluster_tpu.sim.sparse import run_sparse_ticks
+
+    params, state, _ = _sparse_inputs(False)
+    return (
+        run_sparse_ticks,
+        (params, state, _geo_schedule(N), T),
+        {"collect": True},
+        {
+            "donate_argnums": (1,),
+            "state_argnum": 1,
+            "state_out": _state_first,
+            "static_argnums": (0, 3),
+            "static_argnames": ("collect",),
+        },
+    )
+
+
+def _build_run_rapid_ticks_geo():
+    from scalecube_cluster_tpu.sim.rapid import (
+        RapidParams,
+        init_rapid_full_view,
+        run_rapid_ticks,
+    )
+
+    # The geo-chaos matrix runs Rapid with the fallback armed (the
+    # minority-stranded-coordinator scenario), so census that trim.
+    params = RapidParams(n=N)
+    state = init_rapid_full_view(params, fallback=True)
+    return (
+        run_rapid_ticks,
+        (params, state, _geo_schedule(N), T),
+        {"collect": True},
+        {"state_argnum": 1, "state_out": _state_first},
     )
 
 
@@ -446,6 +525,7 @@ def _build_run_rapid_serve_batch():
 ENTRY_SPECS: tuple[EntrySpec, ...] = (
     EntrySpec("sim.run.run_ticks[plan]", lambda: _build_run_ticks(False)),
     EntrySpec("sim.run.run_ticks[schedule]", lambda: _build_run_ticks(True)),
+    EntrySpec("sim.run.run_ticks[geo]", _build_run_ticks_geo),
     EntrySpec("sim.run.run_ticks[pallas_delivery]", _build_run_ticks_pallas),
     EntrySpec(
         "sim.sparse.run_sparse_ticks[xla]",
@@ -463,6 +543,7 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
         "sim.sparse.run_sparse_ticks[traced]",
         lambda: _build_run_sparse_ticks(False, trace_capacity=256),
     ),
+    EntrySpec("sim.sparse.run_sparse_ticks[geo]", _build_run_sparse_ticks_geo),
     EntrySpec("sim.sparse.writeback_free", _build_writeback_free),
     EntrySpec(
         "parallel.spmd.run_sparse_ticks_spmd[plan]",
@@ -506,6 +587,7 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
         "sim.rapid.run_rapid_ticks[fallback]",
         lambda: _build_run_rapid_ticks(fallback=True),
     ),
+    EntrySpec("sim.rapid.run_rapid_ticks[geo]", _build_run_rapid_ticks_geo),
     EntrySpec("sim.rapid.run_ensemble_rapid_ticks", _build_run_ensemble_rapid_ticks),
     EntrySpec("serve.engine.run_serve_batch", _build_run_serve_batch),
     EntrySpec("serve.engine.run_rapid_serve_batch", _build_run_rapid_serve_batch),

@@ -83,7 +83,9 @@ def _spmd_inputs(schedule=False, record_latency=False, pallas=False):
     return params, state, plan
 
 
-def _build_run_sparse_ticks_spmd(schedule=False, record_latency=False, pallas=False):
+def _build_run_sparse_ticks_spmd(
+    schedule=False, record_latency=False, pallas=False, geo=False
+):
     import jax
 
     from scalecube_cluster_tpu.parallel.mesh import make_mesh
@@ -99,6 +101,34 @@ def _build_run_sparse_ticks_spmd(schedule=False, record_latency=False, pallas=Fa
     params, state, plan = _spmd_inputs(
         schedule=schedule, record_latency=record_latency, pallas=pallas
     )
+    if geo:
+        # A LinkWorld-bearing schedule (sim/topology.py). The whole plan
+        # pytree — zone [N] vector and [Z, Z] matrices included — rides
+        # the replicated P() operand, and every zone resolution is a local
+        # gather of replicated data: the geo twin must add ZERO collectives
+        # and keep the analytic exchange-payload pin (S2/S4) unchanged.
+        from scalecube_cluster_tpu.sim.faults import FaultPlan
+        from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
+        from scalecube_cluster_tpu.sim.topology import LinkWorld
+
+        world = LinkWorld.even_zones(N, 2)
+        plan = (
+            ScheduleBuilder(N)
+            .add_segment(0, FaultPlan.uniform())
+            .add_segment(
+                2,
+                FaultPlan.uniform(loss_percent=10.0),
+                link_world=world.with_zone_latency(0, 1, 400.0),
+            )
+            .add_segment(
+                3,
+                FaultPlan.uniform(),
+                link_world=world.block_zones(0, 1, symmetric=False),
+            )
+            .kill(2, 1)
+            .restart(3, 1)
+            .build()
+        )
     cfg = ShardConfig(d=D)
     mesh = make_mesh(jax.devices()[:D])
     return (
@@ -181,6 +211,10 @@ SPMD_ENTRY_SPECS: tuple[SpmdEntrySpec, ...] = (
     SpmdEntrySpec(
         "parallel.spmd.run_sparse_ticks_spmd[pallas,d2]",
         lambda: _build_run_sparse_ticks_spmd(pallas=True),
+    ),
+    SpmdEntrySpec(
+        "parallel.spmd.run_sparse_ticks_spmd[geo,d2]",
+        lambda: _build_run_sparse_ticks_spmd(geo=True),
     ),
     SpmdEntrySpec(
         "parallel.spmd.run_ensemble_sparse_ticks_spmd[2x2]",
