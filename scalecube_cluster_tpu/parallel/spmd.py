@@ -556,7 +556,7 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         if tracked:
             known = jnp.any(state.uinf_ids == rcv[:, None, None], axis=2)
             s_c = urows & ~known & (alive & (rcv != col))[:, None]
-            if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+            if elive is not None:
                 s_c = s_c & elive[c]
             ug_send_c.append(s_c)
             msgs_user = msgs_user + jnp.sum(s_c, axis=0)
@@ -566,7 +566,7 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
             # (bijection: equal to the oracle's receiver-indexed sum).
             ug_send_c.append(urows)
             m_c = urows & (alive & (rcv != col))[:, None]
-            if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+            if elive is not None:
                 m_c = m_c & elive[c]
             msgs_user = msgs_user + jnp.sum(m_c, axis=0)
 
@@ -611,7 +611,7 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         sid = group * sg[rotv_b] + (col + rot) % group  # global sender ids
         gpass = link_pass_from(cut(u_full[c]), plan, sid, col)
         e_ok = alive_all[sid] & gpass
-        if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+        if elive is not None:
             e_ok = e_ok & elive[c]
         edge_ok_c.append(e_ok)
         if use_kernel:
@@ -902,7 +902,7 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
     g_att_c = []
     for c in range(f):
         att = sender_active & alive & (rcv_c[c] != col)
-        if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+        if elive is not None:
             att = att & elive[c]
         g_att_c.append(att)
     g_acct = _acct_zero()

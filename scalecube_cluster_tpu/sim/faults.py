@@ -159,7 +159,7 @@ def edge_blocked(plan: FaultPlan, src: jax.Array, dst: jax.Array) -> jax.Array:
     messages would misreport as ``fault_lost``."""
     blocked = _edge_lookup(plan.block, src, dst)
     w = plan.link_world
-    if w is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: link_world is None or a LinkWorld), not a traced value
+    if w is not None:
         blocked = blocked | w.block[w.zone[src], w.zone[dst]]
     return blocked
 
@@ -169,7 +169,7 @@ def edge_loss(plan: FaultPlan, src: jax.Array, dst: jax.Array) -> jax.Array:
     as independent drops, ``1 - (1-p)·(1-q)``."""
     loss = _edge_lookup(plan.loss, src, dst)
     w = plan.link_world
-    if w is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: link_world is None or a LinkWorld), not a traced value
+    if w is not None:
         zl = w.loss[w.zone[src], w.zone[dst]]
         loss = 1.0 - (1.0 - loss) * (1.0 - zl)
     return loss
@@ -183,7 +183,7 @@ def edge_mean_delay(plan: FaultPlan, src: jax.Array, dst: jax.Array) -> jax.Arra
     :func:`round_trip_in_time` miss without dropping anything."""
     mean = _edge_lookup(plan.mean_delay, src, dst)
     w = plan.link_world
-    if w is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: link_world is None or a LinkWorld), not a traced value
+    if w is not None:
         mean = mean + w.latency[w.zone[src], w.zone[dst]]
     return mean
 
@@ -308,7 +308,7 @@ def plan_any_faults(plan: FaultPlan) -> jax.Array:
         | jnp.any(plan.mean_delay > 0)
     )
     w = plan.link_world
-    if w is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: link_world is None or a LinkWorld), not a traced value
+    if w is not None:
         dirty = dirty | w.any_faults()
     return dirty
 

@@ -74,7 +74,7 @@ def suspicion_fill(suspicion_ticks: int, knobs: Knobs | None):
     """The countdown value armed on a fresh SUSPECT record: the static
     constant without knobs (bit-identical legacy graph), else the scaled
     traced scalar."""
-    if knobs is None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+    if knobs is None:
         return suspicion_ticks
     scaled = jnp.round(suspicion_ticks * knobs.suspicion_mult).astype(jnp.int32)
     return jnp.clip(scaled, 1, _SUSP_MAX)
@@ -83,6 +83,6 @@ def suspicion_fill(suspicion_ticks: int, knobs: Knobs | None):
 def edge_live(gossip_fanout: int, knobs: Knobs | None):
     """``[fanout]`` bool mask of live gossip channels (None without knobs —
     callers skip the mask entirely and keep the legacy graph)."""
-    if knobs is None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+    if knobs is None:
         return None
     return jnp.arange(gossip_fanout, dtype=jnp.int32) < knobs.fanout_cap

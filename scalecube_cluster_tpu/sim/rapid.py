@@ -363,7 +363,7 @@ def rapid_low_watermark(params: RapidParams, knobs: Knobs | None):
     """The effective L watermark: the static constant without knobs
     (bit-identical legacy graph), else scaled by ``suspicion_mult`` — the
     Rapid analog of the SWIM suspicion-timeout knob (sim/knobs.py)."""
-    if knobs is None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+    if knobs is None:
         return params.low_watermark
     scaled = jnp.round(
         params.low_watermark * knobs.suspicion_mult
@@ -526,7 +526,7 @@ def rapid_tick(
     n, k = params.n, params.k
     t = state.tick + 1
     fb = state.fb
-    if fb is None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+    if fb is None:
         rng_next, k_probe, k_ack, k_alarm, k_prop, k_sync = jax.random.split(
             state.rng, 6
         )
@@ -585,7 +585,7 @@ def rapid_tick(
     )
     alarmed = in_view & alive[obs] & (edge_fail >= low)
     join_alarm = ~in_view & alive[obs] & (edge_join >= low)
-    if knobs is not None:  # tpulint: disable=R1 -- trace-time structure gate (knobs is None or a Knobs pytree), not a traced value
+    if knobs is not None:
         # Knobs.fanout_cap, Rapid semantics: cap the per-subject ALARM
         # FAN-OUT — only the first ``cap`` observer slots raise/broadcast
         # alarms (the edge counters keep monitoring; the cap limits who
@@ -603,7 +603,7 @@ def rapid_tick(
 
     src_p = col[None, :]
     dst_p = col[:, None]
-    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+    if fb is not None:
         # ---- join handshake: request -> ack -> confirm -> confirm-ack ----
         # Per-member single-target legs over [N] shapes; every leg rides
         # link_pass with the same conservation accounting as the probes.
@@ -782,7 +782,7 @@ def rapid_tick(
     h = params.high_watermark
     stable_rm = (tally_rm >= h) & mm
     stable_add = (tally_add >= h) & ~mm
-    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+    if fb is not None:
         # Protocol-level joins: a non-member only enters a stable add-cut
         # once SOME member holds its join certificate (the confirm latch,
         # gossiped above). Probe reachability alone no longer admits.
@@ -801,7 +801,7 @@ def rapid_tick(
         & jnp.any(stable_rm | stable_add, axis=1)
         & ~jnp.any(unstable, axis=1)
     )
-    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+    if fb is not None:
         # Vote freeze (safety): a member that has granted a classic promise
         # — this tick's phase-0 grants included — must not lock a NEW
         # fast-path vote; its promise reported "no rank-0 accept", and a
@@ -847,7 +847,7 @@ def rapid_tick(
     commit = alive & jnp.any(valid, axis=1) & ~batch_rm[col, col]
     batch_rm = batch_rm & commit[:, None]
     batch_add = batch_add & commit[:, None]
-    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+    if fb is not None:
         # ---- classic fallback, phase 1 (accept/accepted) -----------------
         # The coordinator that banked a promise majority broadcasts its
         # picked value; acceptors take it unless they have since promised a
@@ -936,7 +936,7 @@ def rapid_tick(
     msgs_sync = jnp.sum(send_p, dtype=jnp.int32) + jnp.sum(
         send_s, dtype=jnp.int32
     )
-    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+    if fb is not None:
         msgs_sync = msgs_sync + fb_msgs
     avail = (send_s & pass_s) | eye
     sync_score = jnp.where(
@@ -948,7 +948,7 @@ def rapid_tick(
     adopt = alive & (vid2[best] > vid2) & includes_self
     mm3 = jnp.where(adopt[:, None], cand_mask, mm2) | eye
     vid3 = jnp.where(adopt, vid2[best], vid2)
-    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+    if fb is not None:
         # A live member that sees a HIGHER configuration excluding itself
         # was evicted behind its back (e.g. a healed partition). It cannot
         # adopt that view; the road back is the join handshake — start one
@@ -990,7 +990,7 @@ def rapid_tick(
             col,
             aux=jnp.sum(vote_rm, axis=1, dtype=jnp.int32),  # cut size locked
         )
-        if fb is None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+        if fb is None:
             ring, _ = trace_emit(
                 ring,
                 TK_VIEW_COMMIT,
@@ -1082,7 +1082,7 @@ def rapid_tick(
     # Every view change (commit or adoption) starts a fresh configuration:
     # the old locked vote is void and the member may vote once again.
     view_changed = commit | adopt
-    if fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+    if fb is not None:
         # A view change clears every per-configuration Paxos register (the
         # wait clock, promises, acceptances, proposals) — the new config
         # starts a fresh single-decree instance. Join state survives unless
@@ -1201,7 +1201,7 @@ def scan_rapid_ticks(
         join_m = None
         if scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
             t = carry.tick + 1  # the global tick about to execute
-            if carry.fb is not None:  # tpulint: disable=R1 -- trace-time structure gate (pytree structure), not a traced value
+            if carry.fb is not None:
                 # Join-aware resolution: same plan, plus the EV_JOIN lane.
                 # The fb-None path keeps the exact legacy resolve_tick call
                 # (bit-identical graph, pinned by the PR-6 golden).
@@ -1224,7 +1224,7 @@ def scan_rapid_ticks(
             metrics["plan_dirty"] = plan_dirty_at(plan, t)
             metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
             metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
-            if join_m is not None:  # tpulint: disable=R1 -- trace-time structure gate (follows carry.fb), not a traced value
+            if join_m is not None:
                 metrics["joins_fired"] = jnp.sum(join_m, dtype=jnp.int32)
         return new_state, metrics
 

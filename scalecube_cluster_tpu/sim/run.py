@@ -60,7 +60,7 @@ def scan_ticks(
             metrics["plan_dirty"] = plan_dirty_at(plan, t)
             metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
             metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
-            if plan.link_world is not None:  # tpulint: disable=R1 -- None is static pytree structure, same gate as trace/record_latency
+            if plan.link_world is not None:
                 metrics.update(
                     zone_tick_metrics(
                         plan.link_world,

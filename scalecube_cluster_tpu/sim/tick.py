@@ -401,7 +401,7 @@ def sim_tick(
     # and counts nothing — the mask folds into edge_ok once, every consumer
     # (delivery, user gossip, accounting) sees the same masked world.
     elive = edge_live(params.gossip_fanout, knobs)
-    if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+    if elive is not None:
         edge_ok = edge_ok & elive[:, None]
     susp_fill = suspicion_fill(params.suspicion_ticks, knobs)
 
@@ -648,7 +648,7 @@ def sim_tick(
                 & ~known
                 & (alive[s] & nonself[c])[:, None]
             )  # [N, G] — message content sent along edge c (loss-independent)
-            if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+            if elive is not None:
                 sent_c = sent_c & elive[c]
             sent_cols.append(sent_c)
         got = jnp.zeros_like(urows)
@@ -779,7 +779,7 @@ def sim_tick(
         sender_active[inv_perm[c]] & alive[inv_perm[c]] & nonself[c]
         for c in range(params.gossip_fanout)
     ]
-    if elive is not None:  # tpulint: disable=R1 -- trace-time constant (pytree structure: knobs is None or a Knobs), not a traced value
+    if elive is not None:
         g_att_c = [m & elive[c] for c, m in enumerate(g_att_c)]
     msgs_gossip = sum(jnp.sum(m) for m in g_att_c)
     # Fault accounting, membership plane only (FD + SYNC + membership

@@ -174,7 +174,7 @@ def sparse_full_cadence_certify(
                 for name, st in xstates.items()
             }
         _note(f"segment {seg}: running reference, {ticks} ticks")
-        ref, tr_ref = _run_ticks_nodonate(params, ref, plan, ticks)  # tpulint: disable=R4 -- per-segment trace lengths are the certification design; one compile per SEGMENTS entry, cached across meshes
+        ref, tr_ref = _run_ticks_nodonate(params, ref, plan, ticks)
         # Serialize: JAX dispatch is async, and on an oversubscribed host
         # (CI / 1-core boxes with 8 virtual devices) the unsharded ref
         # execution would otherwise run CONCURRENTLY with the first sharded
@@ -185,7 +185,7 @@ def sparse_full_cadence_certify(
         # must run everywhere the driver does.
         jax.block_until_ready((ref, tr_ref))
         for i, m in enumerate(meshes):
-            sh, tr_sh = _run_ticks_nodonate(params, twins[i], plans_sh[i], ticks)  # tpulint: disable=R4 -- per-segment trace lengths are the certification design; one compile per SEGMENTS entry, cached across meshes
+            sh, tr_sh = _run_ticks_nodonate(params, twins[i], plans_sh[i], ticks)
             jax.block_until_ready(sh)
             twins[i] = sh
             dims = dict(zip(m.axis_names, m.devices.shape))

@@ -95,6 +95,31 @@ def spmd_result():
     return result
 
 
+@pytest.fixture(scope="session")
+def shardflow_result():
+    """One shared tpulint tier-4 run (GSPMD sharding propagation over the
+    registered auto-partitioned entries on the virtual meshes).
+
+    The sharding-census gate and the positive G1 pins in
+    test_shardflow.py consume this single run. Skips when jax is
+    unavailable, same contract as :func:`spmd_result`."""
+    from pathlib import Path
+
+    from tools.lint.semantic import jax_unavailable_reason
+    from tools.lint.shardflow import run_shardflow
+
+    reason = jax_unavailable_reason()
+    if reason is not None:  # pragma: no cover - env-dependent
+        pytest.skip(f"shardflow tier unavailable: {reason}")
+    repo = Path(__file__).resolve().parent.parent
+    result = run_shardflow(
+        root=repo, census_path=repo / "artifacts" / "shardflow_census.json"
+    )
+    if result.skipped:  # pragma: no cover - env-dependent
+        pytest.skip(result.skipped)
+    return result
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _free_compiled_executables_between_modules():
     """Release each module's jitted executables at module teardown.

@@ -1,0 +1,125 @@
+"""Census drift UX, shared across the gated tiers 2-4.
+
+Each jaxpr-reading tier pins its traced surface as a committed golden
+(R10: artifacts/jax_census.json, S4: collective_census.json, G4:
+shardflow_census.json) with the same contract: a missing golden is an
+"unpinned" finding not a crash, drift produces a reviewable diff, and the
+``--*census-update`` re-pin round-trips to a clean next run. One
+parametrized suite exercises the contract for all three census modules,
+so a UX regression in one tier can't hide behind the others' copies.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from tools.lint.semantic import jax_unavailable_reason
+
+if jax_unavailable_reason() is not None:  # pragma: no cover - env-dependent
+    pytest.skip(
+        f"census modules need jax: {jax_unavailable_reason()}",
+        allow_module_level=True,
+    )
+
+import jax
+
+from tools.lint.semantic import census as semantic_census
+from tools.lint.shardflow import census as shardflow_census
+from tools.lint.spmdcheck import census as spmd_census
+
+
+def _semantic_row(variant: str) -> dict:
+    return {
+        "jaxpr_digest": variant,
+        "n_eqns": 3,
+        "primitives": {"add": 2, "mul": 1},
+        "carry_treedef": "",
+        "donated_leaves": 0,
+        "alias_outputs": [],
+        "path": "x.py",
+    }
+
+
+def _spmd_row(variant: str) -> dict:
+    return {
+        "digest": variant,
+        "collectives": [],
+        "path": "x.py",
+        "exchange_rounds_per_tick": 3,
+        "traced_exchange_bytes_per_tick": 0,
+        "traced_reduce_bytes_per_tick": 0,
+    }
+
+
+def _shardflow_row(variant: str) -> dict:
+    return {
+        "digest": variant,
+        "path": "x.py",
+        "mesh": {"a": 2},
+        "n": 8,
+        "in_shardings": ["(a,_)"],
+        "out_shardings": ["(a,_)" if variant == "old" else "(?,_)"],
+        "g1_origins": [],
+        "g2_crossing_bytes": 0,
+        "g2_crossing_sites": 0,
+        "reduce_hazards": 0,
+        "hbm_budget_bytes": 1 << 30,
+    }
+
+
+TIERS = [
+    pytest.param(semantic_census, "R10", _semantic_row, id="semantic-R10"),
+    pytest.param(spmd_census, "S4", _spmd_row, id="spmd-S4"),
+    pytest.param(shardflow_census, "G4", _shardflow_row, id="shardflow-G4"),
+]
+
+
+def _census(mod, row_fn, variant: str, name: str = "e") -> dict:
+    return mod.build_census({name: row_fn(variant)}, jax.__version__)
+
+
+@pytest.mark.parametrize("mod,rule,row_fn", TIERS)
+def test_missing_golden_flags_unpinned(mod, rule, row_fn, tmp_path):
+    new = _census(mod, row_fn, "new")
+    findings, _ = mod.compare(
+        mod.load_census(tmp_path / "absent.json"), new, tmp_path / "absent.json"
+    )
+    assert [f.rule for f in findings] == [rule]
+    assert "unpinned" in findings[0].message
+
+
+@pytest.mark.parametrize("mod,rule,row_fn", TIERS)
+def test_drift_detected_with_reviewable_diff(mod, rule, row_fn, tmp_path):
+    old = _census(mod, row_fn, "old")
+    new = _census(mod, row_fn, "new")
+    findings, diff = mod.compare(old, new, tmp_path / "c.json")
+    assert any(f.rule == rule and "drifted" in f.message for f in findings)
+    assert any("~ e" in line for line in diff), diff
+    # Every drift finding tells the reviewer how to deliberately re-pin.
+    assert all("update" in f.hint for f in findings if f.rule == rule)
+
+
+@pytest.mark.parametrize("mod,rule,row_fn", TIERS)
+def test_new_and_vanished_entries_flag(mod, rule, row_fn, tmp_path):
+    old = _census(mod, row_fn, "old", name="kept")
+    new = mod.build_census(
+        {"kept": row_fn("old"), "added": row_fn("old")}, jax.__version__
+    )
+    findings, diff = mod.compare(old, new, tmp_path / "c.json")
+    assert any("new since" in f.message for f in findings)
+    assert any("+ added" in line for line in diff)
+    findings, diff = mod.compare(new, old, tmp_path / "c.json")
+    assert any("vanished" in f.message for f in findings)
+    assert any("- added" in line for line in diff)
+
+
+@pytest.mark.parametrize("mod,rule,row_fn", TIERS)
+def test_repin_roundtrip_is_clean(mod, rule, row_fn, tmp_path):
+    """write_census -> load_census -> compare is drift-free: what
+    ``--*census-update`` pins is exactly what the next run rebuilds."""
+    census = _census(mod, row_fn, "new")
+    golden = tmp_path / "golden.json"
+    mod.write_census(census, golden)
+    findings, diff = mod.compare(mod.load_census(golden), census, golden)
+    assert findings == []
+    assert diff == []

@@ -73,6 +73,11 @@ def test_lint_importable_without_jax():
         "tools/lint/kernelcheck.py",
         "tools/lint/spmdcheck/__init__.py",
         "tools/lint/spmdcheck/donation.py",
+        "tools/lint/lattice.py",
+        "tools/lint/shardflow/__init__.py",
+        "tools/lint/shardflow/domain.py",
+        "tools/lint/shardflow/propagate.py",
+        "tools/lint/shardflow/entries.py",
     ):
         tree = ast.parse((REPO / mod).read_text())
         top_level = {
@@ -144,14 +149,14 @@ def test_cli_exit_codes(tmp_path):
     clean = str(FIXTURES / "r1_neg.py")
     dirty = str(FIXTURES / "r1_pos.py")
     json_out = str(tmp_path / "report.json")
-    # --no-semantic/--no-spmd: exit-code plumbing is tier-1's to test; the
-    # traced tiers have their own gate tests (here and in
-    # test_tpulint_spmd.py) and re-tracing here would double the suite's
-    # tracing bill.
+    # --no-semantic/--no-spmd/--no-shardflow: exit-code plumbing is
+    # tier-1's to test; the traced tiers have their own gate tests (here,
+    # test_tpulint_spmd.py and test_shardflow.py) and re-tracing here
+    # would double the suite's tracing bill.
     assert lint_main([clean, "--no-json", "--baseline", "none",
-                      "--no-semantic", "--no-spmd"]) == 0
+                      "--no-semantic", "--no-spmd", "--no-shardflow"]) == 0
     assert lint_main([dirty, "--json", json_out, "--baseline", "none",
-                      "--no-semantic", "--no-spmd"]) == 1
+                      "--no-semantic", "--no-spmd", "--no-shardflow"]) == 1
     assert Path(json_out).exists()
 
 
@@ -175,3 +180,152 @@ def test_advisory_scope_never_gates(tmp_path):
     assert [f.rule for f in result.findings] == ["R3"]
     assert result.findings[0].advisory
     assert result.gated == []
+
+
+def test_tier1_wall_time_budget():
+    """Tier 1 is the pre-commit inner loop: linting the whole library
+    package must stay interactive (pure AST, no tracing). 2 s measured on
+    the reference box; 15 s is the slow-CI ceiling."""
+    import time
+
+    t0 = time.perf_counter()
+    result = lint(REPO / "scalecube_cluster_tpu")
+    elapsed = time.perf_counter() - t0
+    assert result.files_checked > 50
+    assert elapsed < 15.0, f"tier-1 lint took {elapsed:.1f}s (budget 15s)"
+
+
+def test_merged_json_report_shape(tmp_path):
+    """The --json artifact merges all four tiers: per-tier exit-code
+    section (None for tiers that did not run) and byte-stable key order."""
+    import json
+
+    json_out = tmp_path / "report.json"
+    lint_main([str(FIXTURES / "r1_pos.py"), "--json", str(json_out),
+               "--baseline", "none",
+               "--no-semantic", "--no-spmd", "--no-shardflow"])
+    text = json_out.read_text()
+    payload = json.loads(text)
+    assert payload["exit_codes"] == {
+        "source": 1,
+        "semantic": None,
+        "spmd": None,
+        "shardflow": None,
+        "overall": 1,
+    }
+    assert payload["gated_count"] >= 1
+    # Stable key order: the file is exactly its own sorted re-serialization.
+    assert text == json.dumps(payload, indent=2, sort_keys=True) + "\n"
+
+
+def test_tier_of_rule_mapping():
+    from tools.lint.model import RULES
+    from tools.lint.report import tier_of
+
+    assert tier_of("R1") == "source"
+    assert tier_of("P1") == "source"
+    assert tier_of("R10") == "semantic"
+    assert tier_of("K1") == "semantic"
+    assert tier_of("S4") == "spmd"
+    assert tier_of("G1") == "shardflow"
+    # Every registered rule maps to a tier.
+    assert {tier_of(r) for r in RULES} <= {
+        "source", "semantic", "spmd", "shardflow"
+    }
+
+
+# ------------------------------------------------------------ stale pragmas
+
+
+def test_stale_pragma_detected_and_stripped(tmp_path):
+    """P1 round trip: a pragma that suppresses a real finding is live; one
+    that suppresses nothing is advisory-flagged and --strip-stale removes
+    it (whole line when comment-only, comment-only when trailing)."""
+    from tools.lint.pragmas import stale_pragma_findings, strip_stale_pragmas
+
+    src = (
+        "import time\n"
+        "\n"
+        "\n"
+        "def stamp():\n"
+        "    # tpulint: disable=R3 -- wall clock is the point here\n"
+        "    return time.time()\n"
+        "\n"
+        "\n"
+        "def pure(x):  # tpulint: disable=R2 -- nothing syncs here anymore\n"
+        "    return x + 1\n"
+    )
+    f = tmp_path / "mod.py"
+    f.write_text(src)
+    used: set = set()
+    result = run_lint([f], root=tmp_path, baseline=None, pragma_used=used)
+    assert result.findings == []  # the R3 got suppressed...
+    assert used == {("mod.py", 6, "R3")}  # ...and the hit was recorded
+    stale = stale_pragma_findings(tmp_path, result.pragmas, used)
+    assert [(s.rule, s.line) for s in stale] == [("P1", 9)]
+    assert all(s.advisory for s in stale)
+
+    touched = strip_stale_pragmas(tmp_path, stale)
+    assert touched == ["mod.py"]
+    text = f.read_text()
+    assert "disable=R2" not in text
+    assert "disable=R3" in text  # the live pragma survives
+    assert "def pure(x):\n" in text  # trailing comment stripped, code kept
+    # Post-strip the file still lints to the same (suppressed) result.
+    used2: set = set()
+    result2 = run_lint([f], root=tmp_path, baseline=None, pragma_used=used2)
+    assert result2.findings == []
+    assert stale_pragma_findings(tmp_path, result2.pragmas, used2) == []
+
+
+def test_stale_comment_only_pragma_line_deleted(tmp_path):
+    from tools.lint.pragmas import stale_pragma_findings, strip_stale_pragmas
+
+    src = (
+        "# tpulint: disable=R2 -- stale own-line suppression\n"
+        "def pure(x):\n"
+        "    return x + 1\n"
+    )
+    f = tmp_path / "own.py"
+    f.write_text(src)
+    used: set = set()
+    result = run_lint([f], root=tmp_path, baseline=None, pragma_used=used)
+    stale = stale_pragma_findings(tmp_path, result.pragmas, used)
+    assert len(stale) == 1
+    strip_stale_pragmas(tmp_path, stale)
+    assert f.read_text() == "def pure(x):\n    return x + 1\n"
+
+
+# ------------------------------------------------------------ baseline UX
+
+
+def test_write_baseline_dedupes_and_sorts(tmp_path):
+    """Two tiers flagging the same file:line:rule site pin ONE baseline
+    entry; output order is deterministic; P1 hygiene is never pinned."""
+    import json
+
+    from tools.lint.model import Finding, LintResult
+    from tools.lint.report import write_baseline
+
+    def adv(rule, path, line, message):
+        f = Finding(rule=rule, path=path, line=line, message=message)
+        f.advisory = True
+        return f
+
+    result = LintResult(
+        findings=[
+            adv("R2", "tools/b.py", 9, "host sync (tier-2 jaxpr view)"),
+            adv("R2", "tools/b.py", 9, "host sync (tier-1 AST view)"),
+            adv("R4", "tools/a.py", 3, "recompile"),
+            adv("P1", "tools/a.py", 1, "stale pragma"),
+        ]
+    )
+    out = tmp_path / "baseline.json"
+    write_baseline(result, out)
+    data = json.loads(out.read_text())
+    sites = [(e["path"], e["line"], e["rule"]) for e in data["advisory"]]
+    assert sites == [("tools/a.py", 3, "R4"), ("tools/b.py", 9, "R2")]
+    # Deterministic: a second write round-trips byte-identically.
+    first = out.read_text()
+    write_baseline(result, out)
+    assert out.read_text() == first

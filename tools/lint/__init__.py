@@ -69,6 +69,7 @@ def run_lint(
     disable: tuple[str, ...] = (),
     select: tuple[str, ...] | None = None,
     baseline: str | Path | None = None,
+    pragma_used: set | None = None,
 ) -> LintResult:
     """Lint ``paths`` (files or directories). Pure: no I/O besides reading.
 
@@ -80,6 +81,9 @@ def run_lint(
       select: when given, ONLY these rules run.
       baseline: advisory baseline JSON (``DEFAULT_BASELINE`` for the shipped
         one); ``None`` disables baselining.
+      pragma_used: optional set collecting ``(path, line, rule)`` for every
+        pragma-suppressed finding — the stale-pragma (P1) consumption
+        record, shared with the tier 2-4 filters.
     """
     root = Path(root or os.getcwd()).resolve()
     disable = tuple(r.upper() for r in disable)
@@ -109,6 +113,8 @@ def run_lint(
             continue
         pragmas, bad = parse_pragmas(source, rel)
         result.findings.extend(bad)
+        if pragmas:
+            result.pragmas[rel] = pragmas
         pragma_maps[rel] = suppressed_lines(pragmas, source)
         files.append(
             SourceFile(
@@ -132,6 +138,8 @@ def run_lint(
             continue
         supp = pragma_maps.get(f.path, {}).get(f.line, frozenset())
         if f.rule != "R0" and f.rule in supp:
+            if pragma_used is not None:
+                pragma_used.add((f.path, f.line, f.rule))
             continue
         f.advisory = is_advisory_path(f.path)
         kept.append(f)

@@ -100,6 +100,33 @@ def main(argv: list[str] | None = None) -> int:
         "with and without donation and gate on any bitwise difference "
         "(costs real compiles)",
     )
+    ap.add_argument(
+        "--no-shardflow",
+        action="store_true",
+        help="skip tier 4 (GSPMD sharding-propagation rules G1-G3, "
+        "sharding census G4)",
+    )
+    ap.add_argument(
+        "--shardflow-census",
+        default="artifacts/shardflow_census.json",
+        metavar="PATH",
+        help="sharding census golden "
+        "(default: artifacts/shardflow_census.json)",
+    )
+    ap.add_argument(
+        "--shardflow-census-update",
+        action="store_true",
+        help="re-pin the sharding census golden from this run's GSPMD "
+        "propagation (mirrors --census-update; G4 drift findings are "
+        "skipped)",
+    )
+    ap.add_argument(
+        "--strip-stale",
+        action="store_true",
+        help="P1 fix mode: rewrite files removing every pragma that no "
+        "longer suppresses any finding (requires a full run: all tiers "
+        "on, no --select/--disable)",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -111,17 +138,29 @@ def main(argv: list[str] | None = None) -> int:
         baseline = None if args.baseline == "none" else Path(args.baseline)
         disable = tuple(r for r in args.disable.split(",") if r)
         select = tuple(r for r in args.select.split(",") if r) or None
+        # Stale-pragma reconciliation only means something when every
+        # finding every pragma could suppress was actually computed.
+        full_run = (
+            not disable
+            and select is None
+            and not args.no_semantic
+            and not args.no_spmd
+            and not args.no_shardflow
+        )
+        pragma_used: set = set()
         result = run_lint(
             args.paths,
             disable=disable,
             select=select,
             baseline=baseline,
+            pragma_used=pragma_used,
         )
         semantic = None
         spmd = None
-        if not args.no_spmd:
-            # Must run before anything imports jax: the tier-3 rules trace
-            # shard_map on 8 virtual CPU devices, and XLA reads the flag
+        shardflow = None
+        if not (args.no_spmd and args.no_shardflow):
+            # Must run before anything imports jax: tiers 3 and 4 trace
+            # meshes over 8 virtual CPU devices, and XLA reads the flag
             # exactly once at first import.
             from tools.lint import spmdcheck
 
@@ -134,6 +173,7 @@ def main(argv: list[str] | None = None) -> int:
                 update=args.census_update,
                 disable=disable,
                 select=select,
+                pragma_used=pragma_used,
             )
             if args.census_update and semantic.census is not None:
                 from tools.lint.semantic.census import write_census
@@ -150,6 +190,7 @@ def main(argv: list[str] | None = None) -> int:
                 disable=disable,
                 select=select,
                 sanitize=args.sanitize_donation,
+                pragma_used=pragma_used,
             )
             if args.collective_census_update and spmd.census is not None:
                 from tools.lint.spmdcheck.census import write_census
@@ -157,8 +198,46 @@ def main(argv: list[str] | None = None) -> int:
                 write_census(spmd.census, Path(args.collective_census))
                 print(f"collective census re-pinned: {args.collective_census}")
             result.findings.extend(spmd.findings)
+        if not args.no_shardflow:
+            from tools.lint.shardflow import run_shardflow
+
+            shardflow = run_shardflow(
+                census_path=args.shardflow_census,
+                update=args.shardflow_census_update,
+                disable=disable,
+                select=select,
+                pragma_used=pragma_used,
+            )
+            if args.shardflow_census_update and shardflow.census is not None:
+                from tools.lint.shardflow.census import write_census
+
+                write_census(shardflow.census, Path(args.shardflow_census))
+                print(f"sharding census re-pinned: {args.shardflow_census}")
+            result.findings.extend(shardflow.findings)
+        stale: list = []
+        if full_run and not any(
+            r is not None and r.skipped for r in (semantic, spmd, shardflow)
+        ):
+            from tools.lint.pragmas import stale_pragma_findings
+
+            stale = stale_pragma_findings(
+                Path.cwd(), result.pragmas, pragma_used
+            )
+            result.findings.extend(stale)
+        if args.strip_stale:
+            if not full_run:
+                print(
+                    "tpulint: --strip-stale needs a full run (all tiers "
+                    "on, no --select/--disable); nothing stripped",
+                    file=sys.stderr,
+                )
+            elif stale:
+                from tools.lint.pragmas import strip_stale_pragmas
+
+                for p in strip_stale_pragmas(Path.cwd(), stale):
+                    print(f"stripped stale pragma(s): {p}")
         result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
-        # Baseline accounting covers all tiers: semantic/spmd findings were
+        # Baseline accounting covers all tiers: the per-tier findings were
         # merged above, so mark known advisories (and write, on request)
         # only after the merge.
         apply_baseline(result, baseline)
@@ -166,8 +245,22 @@ def main(argv: list[str] | None = None) -> int:
             write_baseline(result, baseline)
 
         if not args.no_json:
-            write_json(result, Path(args.json), semantic=semantic, spmd=spmd)
-        print(render_text(result, quiet=args.quiet, semantic=semantic, spmd=spmd))
+            write_json(
+                result,
+                Path(args.json),
+                semantic=semantic,
+                spmd=spmd,
+                shardflow=shardflow,
+            )
+        print(
+            render_text(
+                result,
+                quiet=args.quiet,
+                semantic=semantic,
+                spmd=spmd,
+                shardflow=shardflow,
+            )
+        )
         return 1 if result.gated else 0
     except Exception:
         traceback.print_exc()

@@ -1,0 +1,213 @@
+"""Shared abstract-interpretation core for the jaxpr-walking lint tiers.
+
+Two tiers run forward dataflow analyses over (closed) jaxprs in a finite
+join-semilattice domain: tier 3's varying-set replication analysis
+(tools/lint/spmdcheck/replication.py, values = frozensets of mesh axes a
+value may vary over) and tier 4's sharding propagation
+(tools/lint/shardflow/propagate.py, values = per-dimension sharding
+lattice states). The structural machinery is identical — environment
+threading, literal/constvar bottoms, ``scan``/``while`` carry fixpoints
+(monotone joins in a finite lattice, so a small bounded round count),
+``cond`` branch joins with predicate mixing, and recursion through
+call-like primitives (``pjit``/``closed_call``/``remat``/``custom_*``) —
+so it lives here once and each tier supplies only its domain:
+
+- :meth:`AbstractInterpreter.join` — the lattice join;
+- :meth:`AbstractInterpreter.literal_value` — bottom for literals/consts;
+- :meth:`AbstractInterpreter.prim_transfer` — the per-primitive transfer
+  for everything that is not structured control flow;
+- :meth:`AbstractInterpreter.mix_pred` — how a ``while``/``cond``
+  predicate's abstract value taints loop carries / branch outputs
+  (per-shard trip counts in the replication domain, divergence-taint
+  provenance in the sharding domain);
+- :meth:`AbstractInterpreter.enter_xs` / :meth:`exit_ys` — rank
+  adjustment crossing a ``scan`` boundary (a body consumes one SLICE of
+  each xs operand and emits one slice of each ys output; domains that
+  track per-dimension facts must drop/add the leading axis, set-shaped
+  domains keep the identity default).
+"""
+
+from __future__ import annotations
+
+__all__ = [
+    "AbstractInterpreter",
+    "closed_parts",
+    "param_jaxprs",
+    "is_literal",
+    "walk",
+]
+
+#: Params keys under which call-like primitives stash their sub-jaxpr.
+CALL_JAXPR_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr")
+
+
+def is_literal(atom) -> bool:
+    """True for jaxpr Literals (which have ``val`` but no Var ``count``)."""
+    return hasattr(atom, "val") and not hasattr(atom, "count")
+
+
+def closed_parts(obj):
+    """(raw jaxpr, consts) from either a ClosedJaxpr or a raw Jaxpr."""
+    inner = getattr(obj, "jaxpr", None)
+    if inner is not None and hasattr(obj, "consts"):
+        return inner, obj.consts
+    return obj, ()
+
+
+def param_jaxprs(value):
+    """Yield raw jaxprs inside one eqn params value (jaxpr, ClosedJaxpr,
+    or any nesting of tuples/lists of them)."""
+    if hasattr(value, "eqns"):
+        yield value
+    elif hasattr(value, "jaxpr") and hasattr(value, "consts"):
+        yield value.jaxpr
+    elif isinstance(value, (tuple, list)):
+        for v in value:
+            yield from param_jaxprs(v)
+
+
+def walk(jaxpr):
+    """Yield every eqn in a raw jaxpr, recursively through params."""
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for v in eqn.params.values():
+            for sub in param_jaxprs(v):
+                yield from walk(sub)
+
+
+class AbstractInterpreter:
+    """Forward abstract interpretation over a raw jaxpr.
+
+    Subclasses implement the domain hooks; :meth:`run` drives the eqn
+    loop and the structured-control-flow fixpoints. ``max_rounds`` bounds
+    every carry fixpoint — set it at or above the domain's lattice height
+    so the break-on-stable test is the real terminator.
+    """
+
+    def __init__(self, max_rounds: int = 8):
+        self.max_rounds = max(1, int(max_rounds))
+        #: Eqns interpreted across every scope (fixpoint re-runs included).
+        self.eqns_seen = 0
+
+    # -- domain hooks -----------------------------------------------------
+
+    def join(self, a, b):
+        raise NotImplementedError
+
+    def literal_value(self, atom):
+        """Abstract value of a Literal or constvar (``atom.aval`` is
+        available on both for rank-aware domains)."""
+        raise NotImplementedError
+
+    def prim_transfer(self, eqn, ins) -> list:
+        """Transfer for one non-control-flow eqn; one value per outvar."""
+        raise NotImplementedError
+
+    def mix_pred(self, value, pred):
+        """Fold a while/cond predicate's abstract value into an output."""
+        return self.join(value, pred)
+
+    def enter_xs(self, value):
+        """A scan xs operand as seen by the body (one leading-axis slice)."""
+        return value
+
+    def exit_ys(self, value):
+        """A scan body ys output as seen outside (stacked over the loop)."""
+        return value
+
+    def call_fallback(self, eqn, ins, body) -> list:
+        """Outputs for a call-like eqn whose sub-jaxpr arity doesn't map
+        arg-for-arg (vmap-mangled signatures). Default: join of every
+        input for every output — set-shaped domains are fine with that;
+        rank-aware domains must override."""
+        acc = None
+        for v in ins:
+            acc = v if acc is None else self.join(acc, v)
+        return [acc if acc is not None else self.literal_value(v) for v in eqn.outvars]
+
+    # -- driver -----------------------------------------------------------
+
+    def run(self, jaxpr, in_vals) -> list:
+        """Interpret one raw jaxpr; returns the outvars' abstract values.
+        ``in_vals`` must align with ``jaxpr.invars``."""
+        env: dict = {}
+
+        def read(atom):
+            if is_literal(atom):
+                return self.literal_value(atom)
+            got = env.get(atom)
+            return got if got is not None else self.literal_value(atom)
+
+        for v, s in zip(jaxpr.invars, in_vals):
+            env[v] = s
+        for v in jaxpr.constvars:
+            env[v] = self.literal_value(v)
+        for eqn in jaxpr.eqns:
+            self.eqns_seen += 1
+            ins = [read(a) for a in eqn.invars]
+            outs = self.transfer(eqn, ins)
+            for v, s in zip(eqn.outvars, outs):
+                env[v] = s
+        return [read(v) for v in jaxpr.outvars]
+
+    def transfer(self, eqn, ins) -> list:
+        name = eqn.primitive.name
+
+        if name == "scan":
+            body, _ = closed_parts(eqn.params["jaxpr"])
+            nc = eqn.params["num_consts"]
+            ncar = eqn.params["num_carry"]
+            consts = ins[:nc]
+            carry = list(ins[nc : nc + ncar])
+            xs = [self.enter_xs(v) for v in ins[nc + ncar :]]
+            body_outs = None
+            for _ in range(self.max_rounds):
+                body_outs = self.run(body, consts + carry + xs)
+                new_carry = [
+                    self.join(c, b) for c, b in zip(carry, body_outs[:ncar])
+                ]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            return carry + [self.exit_ys(v) for v in body_outs[ncar:]]
+
+        if name == "while":
+            cond, _ = closed_parts(eqn.params["cond_jaxpr"])
+            body, _ = closed_parts(eqn.params["body_jaxpr"])
+            cn = eqn.params["cond_nconsts"]
+            bn = eqn.params["body_nconsts"]
+            cconsts, bconsts = ins[:cn], ins[cn : cn + bn]
+            carry = list(ins[cn + bn :])
+            pred = None
+            for _ in range(self.max_rounds):
+                pred = self.run(cond, cconsts + carry)[0]
+                body_outs = self.run(body, bconsts + carry)
+                new_carry = [self.join(c, b) for c, b in zip(carry, body_outs)]
+                if new_carry == carry:
+                    break
+                carry = new_carry
+            # A divergent predicate means per-shard trip counts: every
+            # carry leaf is then tainted by whatever the predicate carries.
+            return [self.mix_pred(c, pred) for c in carry]
+
+        if name == "cond":
+            pred, ops = ins[0], ins[1:]
+            out_vals = None
+            for br in eqn.params["branches"]:
+                body, _ = closed_parts(br)
+                outs = self.run(body, list(ops))
+                out_vals = (
+                    outs
+                    if out_vals is None
+                    else [self.join(a, b) for a, b in zip(out_vals, outs)]
+                )
+            return [self.mix_pred(v, pred) for v in out_vals]
+
+        for key in CALL_JAXPR_KEYS:
+            if key in eqn.params:
+                body, _ = closed_parts(eqn.params[key])
+                if len(body.invars) == len(ins):
+                    return self.run(body, ins)
+                return self.call_fallback(eqn, ins, body)
+
+        return self.prim_transfer(eqn, ins)

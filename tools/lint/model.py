@@ -61,6 +61,29 @@ RULES = {
     "payload surface differs from the committed "
     "artifacts/collective_census.json golden (regenerate deliberately "
     "with --collective-census-update)",
+    # -- shardflow tier (tools/lint/shardflow/): GSPMD sharding-propagation
+    #    rules over the auto-partitioned jit entries under NamedSharding
+    #    meshes (no shard_map — the partitioner infers the program).
+    "G1": "per-shard-divergent gather/scatter: data-dependent indices "
+    "derived (through a multi-axis-partitioned point-gather) from sharded "
+    "operands index across a sharded dimension — the GSPMD divergence "
+    "shape behind the 2D FD probe-selection xfail "
+    "(tests/test_spmd.py::test_2d_mesh_divergence_bisected_to_fd_probe_selection)",
+    "G2": "silent full-replication materialization: cross-shard "
+    "gather/scatter/sort traffic whose byte estimate exceeds the entry's "
+    "HBM budget — the n=1e6 guard against XLA materializing a replicated "
+    "copy of a sharded operand",
+    "G3": "partial-sum hazard: a reduction (or dot contraction) over a "
+    "dimension whose propagated sharding degraded to Unknown after "
+    "conflicting joins — the result may silently miss cross-shard "
+    "contributions",
+    "G4": "sharding census drift: an entry's (input shardings, propagated "
+    "output shardings, G2 byte totals) digest differs from the committed "
+    "artifacts/shardflow_census.json golden (regenerate deliberately with "
+    "--shardflow-census-update)",
+    # -- pragma hygiene (tools/lint/pragmas.py), reported on full runs only.
+    "P1": "stale tpulint pragma: the suppression no longer matches any "
+    "finding on its line — remove it (or run --strip-stale)",
 }
 
 #: Path segments that put a file in advisory scope: findings are reported
@@ -114,6 +137,10 @@ class Finding:
 class LintResult:
     findings: list[Finding] = field(default_factory=list)
     files_checked: int = 0
+    #: Per-file pragma inventory (relpath -> list[Pragma]) for the files
+    #: this run parsed — stale-pragma reconciliation (P1) reads it after
+    #: every tier has recorded its suppression hits.
+    pragmas: dict = field(default_factory=dict)
 
     @property
     def gated(self) -> list[Finding]:

@@ -18,8 +18,9 @@ import io
 import re
 import tokenize
 from dataclasses import dataclass
+from pathlib import Path
 
-from tools.lint.model import RULES, Finding
+from tools.lint.model import RULES, Finding, is_advisory_path
 
 _PRAGMA_RE = re.compile(r"#\s*tpulint\s*:\s*(.*)$")
 _DISABLE_RE = re.compile(
@@ -102,11 +103,148 @@ def suppressed_lines(pragmas: list[Pragma], source: str) -> dict[int, frozenset[
         out[line] = out.get(line, frozenset()) | rules
 
     for p in pragmas:
-        add(p.line, p.rules)
-        if p.own_line:
-            nxt = p.line + 1
-            while nxt <= len(lines) and not lines[nxt - 1].strip():
-                nxt += 1
-            if nxt <= len(lines):
-                add(nxt, p.rules)
+        for line in pragma_coverage(p, lines):
+            add(line, p.rules)
     return out
+
+
+def pragma_coverage(p: Pragma, lines: list[str]) -> frozenset[int]:
+    """The line numbers one pragma suppresses on (its own line, plus the
+    next non-blank line for comment-only pragmas)."""
+    covered = {p.line}
+    if p.own_line:
+        nxt = p.line + 1
+        while nxt <= len(lines) and not lines[nxt - 1].strip():
+            nxt += 1
+        if nxt <= len(lines):
+            covered.add(nxt)
+    return frozenset(covered)
+
+
+def filter_findings(
+    findings: list[Finding],
+    root: Path,
+    disable: tuple[str, ...],
+    select: tuple[str, ...] | None,
+    used: set | None = None,
+) -> list[Finding]:
+    """The shared tier-2/3/4 suppression filter: drop disabled/unselected
+    rules and pragma-suppressed findings, stamp advisory scope, sort.
+
+    ``used`` (when given) collects each pragma hit as a
+    ``(path, line, rule)`` triple — the consumption record stale-pragma
+    detection (:func:`stale_pragma_findings`) reconciles against every
+    pragma in the linted files after all tiers ran.
+    """
+    pragma_cache: dict[str, dict[int, frozenset[str]]] = {}
+
+    def suppressed(f: Finding) -> bool:
+        if f.path not in pragma_cache:
+            full = Path(root) / f.path
+            try:
+                source = full.read_text()
+            except OSError:
+                pragma_cache[f.path] = {}
+            else:
+                pragmas, _ = parse_pragmas(source, f.path)
+                pragma_cache[f.path] = suppressed_lines(pragmas, source)
+        hit = f.rule in pragma_cache[f.path].get(f.line, frozenset())
+        if hit and used is not None:
+            used.add((f.path, f.line, f.rule))
+        return hit
+
+    kept = []
+    for f in findings:
+        if f.rule in disable:
+            continue
+        if select is not None and f.rule not in select:
+            continue
+        if suppressed(f):
+            continue
+        f.advisory = is_advisory_path(f.path)
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept
+
+
+def stale_pragma_findings(
+    root: Path,
+    pragma_index: dict[str, list[Pragma]],
+    used: set,
+) -> list[Finding]:
+    """P1 advisories for pragmas that suppressed nothing this run.
+
+    A pragma is LIVE when some tier recorded a ``(path, line, rule)``
+    consumption with the line in the pragma's coverage and the rule in
+    its disable list; anything else is dead weight that silently stops
+    protecting the site it once justified. Only meaningful after a FULL
+    run (every tier enabled, no --select/--disable): a skipped tier's
+    suppressions would otherwise look stale.
+    """
+    findings: list[Finding] = []
+    for path in sorted(pragma_index):
+        pragmas = pragma_index[path]
+        if not pragmas:
+            continue
+        try:
+            lines = (Path(root) / path).read_text().splitlines()
+        except OSError:
+            lines = []
+        for p in pragmas:
+            covered = pragma_coverage(p, lines)
+            live = any(
+                u_path == path and u_line in covered and u_rule in p.rules
+                for (u_path, u_line, u_rule) in used
+            )
+            if live:
+                continue
+            src = lines[p.line - 1] if 0 < p.line <= len(lines) else ""
+            f = Finding(
+                rule="P1",
+                path=path,
+                line=p.line,
+                message=f"stale pragma: disable={','.join(sorted(p.rules))} "
+                "no longer suppresses any finding on its line",
+                hint="remove it (or 'python -m tools.lint --strip-stale'); "
+                "a dead suppression hides nothing but still reads like it "
+                "justifies something",
+                source_line=src,
+            )
+            f.advisory = True  # hygiene advice, never a gate failure
+            findings.append(f)
+    return findings
+
+
+_STRIP_RE = re.compile(r"\s*#\s*tpulint\s*:.*$")
+
+
+def strip_stale_pragmas(
+    root: Path, stale: list[Finding]
+) -> list[str]:
+    """Rewrite files removing each stale pragma comment (the fix mode of
+    P1). Comment-only pragma lines are deleted whole; trailing pragmas
+    lose just the comment. Returns the repo-relative paths rewritten."""
+    by_path: dict[str, list[int]] = {}
+    for f in stale:
+        by_path.setdefault(f.path, []).append(f.line)
+    touched: list[str] = []
+    for path, line_nos in sorted(by_path.items()):
+        full = Path(root) / path
+        try:
+            source = full.read_text()
+        except OSError:
+            continue
+        lines = source.splitlines(keepends=True)
+        for ln in sorted(set(line_nos), reverse=True):
+            if not (0 < ln <= len(lines)):
+                continue
+            raw = lines[ln - 1]
+            ending = raw[len(raw.rstrip("\r\n")) :]
+            stripped = _STRIP_RE.sub("", raw.rstrip("\r\n"))
+            if stripped.strip():
+                lines[ln - 1] = stripped + ending
+            else:
+                del lines[ln - 1]
+        full.write_text("".join(lines))
+        touched.append(path)
+    return touched

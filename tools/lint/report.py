@@ -31,30 +31,91 @@ def apply_baseline(result: LintResult, baseline_path: Path | None) -> None:
 
 
 def write_baseline(result: LintResult, baseline_path: Path) -> None:
-    entries = [
-        {
-            "fingerprint": f.fingerprint,
-            "rule": f.rule,
-            "path": f.path,
-            "line": f.line,
-            "summary": f.message,
-        }
-        for f in result.findings
-        if f.advisory
-    ]
+    """Pin this run's advisory findings. Deduped on (path, line, rule):
+    two tiers flagging the same site (tier 1's AST view and tier 2's
+    jaxpr view of one host sync, say) pin ONE entry. Deterministically
+    sorted, so a re-pin with no real change is a no-op diff. P1 (stale
+    pragma) is hygiene-of-the-moment, never inventoried."""
+    seen: set[tuple] = set()
+    entries = []
+    for f in sorted(
+        (f for f in result.findings if f.advisory and f.rule != "P1"),
+        key=lambda f: (f.path, f.line, f.rule, f.message),
+    ):
+        key = (f.path, f.line, f.rule)
+        if key in seen:
+            continue
+        seen.add(key)
+        entries.append(
+            {
+                "fingerprint": f.fingerprint,
+                "rule": f.rule,
+                "path": f.path,
+                "line": f.line,
+                "summary": f.message,
+            }
+        )
     baseline_path.parent.mkdir(parents=True, exist_ok=True)
     baseline_path.write_text(
-        json.dumps({"version": 1, "advisory": entries}, indent=2) + "\n"
+        json.dumps(
+            {"version": 1, "advisory": entries}, indent=2, sort_keys=True
+        )
+        + "\n"
     )
 
 
-def write_json(result: LintResult, path: Path, semantic=None, spmd=None) -> None:
+#: Tier names in run order (the JSON exit_codes section's key set).
+TIERS = ("source", "semantic", "spmd", "shardflow")
+
+
+def tier_of(rule: str) -> str:
+    """Which tier owns a rule id (G* shardflow, S* spmd, jaxpr rules and
+    the kernel audit semantic, everything else — R0-R5, P1 — source)."""
+    if rule.startswith("G"):
+        return "shardflow"
+    if rule.startswith("S"):
+        return "spmd"
+    if rule.startswith("K") or rule in ("R6", "R7", "R8", "R9", "R10"):
+        return "semantic"
+    return "source"
+
+
+def tier_exit_codes(
+    result: LintResult, semantic=None, spmd=None, shardflow=None
+) -> dict:
+    """Per-tier exit codes for the merged report: 0 clean, 1 gated,
+    None when the tier did not run (not requested or skipped). The
+    ``overall`` key is the process exit code."""
+    gated_tiers = {tier_of(f.rule) for f in result.gated}
+    codes: dict = {"source": 1 if "source" in gated_tiers else 0}
+    for name, res in (
+        ("semantic", semantic),
+        ("spmd", spmd),
+        ("shardflow", shardflow),
+    ):
+        if res is None or res.skipped:
+            codes[name] = None
+        else:
+            codes[name] = 1 if name in gated_tiers else 0
+    codes["overall"] = 1 if result.gated else 0
+    return codes
+
+
+def write_json(
+    result: LintResult, path: Path, semantic=None, spmd=None, shardflow=None
+) -> None:
+    """The merged machine-readable report across all four tiers. Keys are
+    emitted sorted at every level, so the artifact diffs cleanly run to
+    run."""
     path.parent.mkdir(parents=True, exist_ok=True)
     payload = {
         "files_checked": result.files_checked,
         "gated_count": len(result.gated),
         "advisory_count": len(result.advisory),
         "findings": [f.to_json() for f in result.findings],
+        "exit_codes": tier_exit_codes(
+            result, semantic=semantic, spmd=spmd, shardflow=shardflow
+        ),
     }
     if semantic is not None:
         payload["semantic"] = {
@@ -76,7 +137,18 @@ def write_json(result: LintResult, path: Path, semantic=None, spmd=None) -> None
             "collective_diff": spmd.diff,
             "sanitized": spmd.sanitized,
         }
-    path.write_text(json.dumps(payload, indent=2) + "\n")
+    if shardflow is not None:
+        payload["shardflow"] = {
+            "skipped": shardflow.skipped,
+            "entries_traced": shardflow.entries_traced,
+            "eqns_interpreted": shardflow.eqns_interpreted,
+            "sites_checked": shardflow.sites_checked,
+            "sharding_digest": (
+                shardflow.census["digest"] if shardflow.census else None
+            ),
+            "sharding_diff": shardflow.diff,
+        }
+    path.write_text(json.dumps(payload, indent=2, sort_keys=True) + "\n")
 
 
 def render_text(
@@ -84,9 +156,11 @@ def render_text(
     quiet: bool = False,
     semantic=None,
     spmd=None,
+    shardflow=None,
 ) -> str:
     """Console report. ``semantic`` is the tier-2 SemanticResult, ``spmd``
-    the tier-3 SpmdResult (either None when the tier was not requested)."""
+    the tier-3 SpmdResult, ``shardflow`` the tier-4 ShardflowResult (each
+    None when the tier was not requested)."""
     lines: list[str] = []
     gated = result.gated
     advisory = result.advisory
@@ -104,6 +178,10 @@ def render_text(
     if spmd is not None and spmd.diff:
         lines.append("collective census drift (committed golden vs this trace):")
         lines.extend(spmd.diff)
+        lines.append("")
+    if shardflow is not None and shardflow.diff:
+        lines.append("sharding census drift (committed golden vs this trace):")
+        lines.extend(shardflow.diff)
         lines.append("")
     lines.append(
         f"tpulint: {result.files_checked} files, "
@@ -141,6 +219,17 @@ def render_text(
                 f"spmd: {spmd.entries_traced} shard_map entries traced, "
                 f"{spmd.collectives_verified} collective sites verified, "
                 f"collective digest {spmd.census['digest'][:12]}…{sanitized}"
+            )
+    if shardflow is not None:
+        if shardflow.skipped:
+            lines.append(f"shardflow: {shardflow.skipped}")
+        else:
+            lines.append(
+                f"shardflow: {shardflow.entries_traced} GSPMD entries "
+                f"propagated, {shardflow.eqns_interpreted} eqns "
+                f"interpreted, {shardflow.sites_checked} cross-shard "
+                f"sites checked, sharding digest "
+                f"{shardflow.census['digest'][:12]}…"
             )
     if gated:
         lines.append("gate: FAIL (fix the finding or suppress with "

@@ -19,8 +19,8 @@ import sys
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from tools.lint.model import Finding, is_advisory_path
-from tools.lint.pragmas import parse_pragmas, suppressed_lines
+from tools.lint.model import Finding
+from tools.lint.pragmas import filter_findings
 
 __all__ = ["run_semantic", "SemanticResult", "DEFAULT_CENSUS", "jax_unavailable_reason"]
 
@@ -65,40 +65,6 @@ class SemanticResult:
         return [f for f in self.findings if not f.advisory and not f.baselined]
 
 
-def _filter_findings(
-    findings: list[Finding],
-    root: Path,
-    disable: tuple[str, ...],
-    select: tuple[str, ...] | None,
-) -> list[Finding]:
-    pragma_cache: dict[str, dict[int, frozenset[str]]] = {}
-
-    def suppressed(f: Finding) -> bool:
-        if f.path not in pragma_cache:
-            full = root / f.path
-            try:
-                source = full.read_text()
-            except OSError:
-                pragma_cache[f.path] = {}
-            else:
-                pragmas, _ = parse_pragmas(source, f.path)
-                pragma_cache[f.path] = suppressed_lines(pragmas, source)
-        return f.rule in pragma_cache[f.path].get(f.line, frozenset())
-
-    kept = []
-    for f in findings:
-        if f.rule in disable:
-            continue
-        if select is not None and f.rule not in select:
-            continue
-        if suppressed(f):
-            continue
-        f.advisory = is_advisory_path(f.path)
-        kept.append(f)
-    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
-    return kept
-
-
 def run_semantic(
     *,
     root: str | Path | None = None,
@@ -106,6 +72,7 @@ def run_semantic(
     update: bool = False,
     disable: tuple[str, ...] = (),
     select: tuple[str, ...] | None = None,
+    pragma_used: set | None = None,
 ) -> SemanticResult:
     """Run the semantic tier. Pure besides reading the census golden —
     writing an updated census is the caller's move (mirrors run_lint vs
@@ -114,6 +81,8 @@ def run_semantic(
     Args:
       update: census-regeneration mode — skip drift findings (the caller is
         about to re-pin the golden from :attr:`SemanticResult.census`).
+      pragma_used: optional shared set recording pragma-suppression hits
+        as ``(path, line, rule)`` for stale-pragma (P1) reconciliation.
     """
     root = Path(root or os.getcwd()).resolve()
     census_path = Path(census_path or DEFAULT_CENSUS)
@@ -175,5 +144,7 @@ def run_semantic(
         result.findings.extend(drift)
         result.diff = diff
 
-    result.findings = _filter_findings(result.findings, root, disable, select)
+    result.findings = filter_findings(
+        result.findings, root, disable, select, used=pragma_used
+    )
     return result

@@ -1,0 +1,164 @@
+"""tpulint tier 4 — "shardflow": GSPMD sharding-propagation analysis.
+
+Tier 3 verifies the shard_map programs the repo writes by hand; this tier
+verifies the programs GSPMD WRITES FOR US. It traces the registered
+auto-partitioned jit entries (tools/lint/shardflow/entries.py) under
+their NamedSharding probe meshes and abstract-interprets each closed
+jaxpr over a per-dimension sharding lattice (``Sharded(axes)`` /
+``Replicated`` / ``Unknown`` — tools/lint/shardflow/domain.py), the
+static twin of what the partitioner infers, built on the same fixpoint
+core (tools/lint/lattice.py) as tier 3's replication analysis:
+
+- **G1 per-shard-divergent gather/scatter** (propagate.py + rules.py):
+  data-dependent indices born at a multi-axis-partitioned point-gather
+  carry a divergence taint; any downstream gather/scatter that uses them
+  across a sharded dim fires, deduped back to the taint ORIGIN. On the
+  2D viewers×subjects mesh this pins the exact divergence the runtime
+  xfail tests/test_spmd.py::test_2d_mesh_divergence_bisected_to_fd_probe_selection
+  bisected to FD probe selection.
+- **G2 replication blowup**: cross-shard gather/scatter/sort byte
+  estimates summed per entry against its HBM budget.
+- **G3 partial-sum hazard**: reductions whose dim sharding degraded to
+  Unknown, or that leave the reduced mesh axis alive on an unreduced dim.
+- **G4 sharding census** (census.py): per-entry (input shardings,
+  propagated output shardings, G2 totals, G1 origins) pinned as
+  ``artifacts/shardflow_census.json``; drift gates, re-pin with
+  ``--shardflow-census-update``.
+
+Importable WITHOUT jax (the obs/ lazy-import discipline): jax is only
+imported inside :func:`run_shardflow`; absence degrades to a skipped
+tier, mirroring tiers 2 and 3.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from tools.lint.model import Finding
+from tools.lint.pragmas import filter_findings
+
+__all__ = ["run_shardflow", "ShardflowResult", "DEFAULT_SHARDFLOW_CENSUS"]
+
+#: Committed sharding-census golden (repo-anchored, like the tier 2/3 ones).
+DEFAULT_SHARDFLOW_CENSUS = (
+    Path(__file__).resolve().parents[3] / "artifacts" / "shardflow_census.json"
+)
+
+#: Devices the probe meshes need: the 2×2 viewers×subjects and
+#: universes×members meshes take 4; spmdcheck's ensure_virtual_devices
+#: provisions 8.
+MIN_DEVICES = 4
+
+
+@dataclass
+class ShardflowResult:
+    findings: list[Finding] = field(default_factory=list)
+    census: dict | None = None  # this run's rebuilt sharding census
+    diff: list[str] = field(default_factory=list)  # drift vs the golden
+    skipped: str | None = None  # reason when the tier didn't run
+    entries_traced: int = 0
+    eqns_interpreted: int = 0  # jaxpr eqns the lattice walked (all scopes)
+    sites_checked: int = 0  # gather/scatter/reduce/sort event sites
+
+    @property
+    def gated(self) -> list[Finding]:
+        return [f for f in self.findings if not f.advisory and not f.baselined]
+
+
+def run_shardflow(
+    *,
+    root: str | Path | None = None,
+    census_path: str | Path | None = None,
+    update: bool = False,
+    disable: tuple[str, ...] = (),
+    select: tuple[str, ...] | None = None,
+    pragma_used: set | None = None,
+) -> ShardflowResult:
+    """Run the shardflow tier. Pure besides reading the census golden —
+    writing an updated census is the caller's move (mirrors run_spmd).
+
+    Args:
+      update: census-regeneration mode — skip G4 drift findings (the
+        caller is about to re-pin the golden from
+        :attr:`ShardflowResult.census`).
+      pragma_used: optional shared set recording pragma-suppression hits
+        as ``(path, line, rule)`` for stale-pragma (P1) reconciliation.
+    """
+    from tools.lint.semantic import jax_unavailable_reason
+    from tools.lint.spmdcheck import ensure_virtual_devices
+
+    root = Path(root or os.getcwd()).resolve()
+    census_path = Path(census_path or DEFAULT_SHARDFLOW_CENSUS)
+    disable = tuple(r.upper() for r in disable)
+    select = tuple(r.upper() for r in select) if select is not None else None
+
+    reason = jax_unavailable_reason()
+    if reason is not None:
+        return ShardflowResult(skipped=f"shardflow tier skipped: {reason}")
+    ensure_virtual_devices()
+    import jax
+
+    if len(jax.devices()) < MIN_DEVICES:
+        return ShardflowResult(
+            skipped=f"shardflow tier skipped: {len(jax.devices())} device(s) "
+            f"available; the 2x2 probe meshes need >= {MIN_DEVICES} (set "
+            "XLA_FLAGS --xla_force_host_platform_device_count before "
+            "importing jax)"
+        )
+
+    from tools.lint.shardflow import census as census_mod
+    from tools.lint.shardflow import entries as entries_mod
+    from tools.lint.shardflow import rules as rules_mod
+    from tools.lint.shardflow.propagate import ShardflowInterp
+
+    result = ShardflowResult()
+    entries, failures = entries_mod.build_entries(str(root))
+    result.entries_traced = len(entries)
+    for spec, err in failures:
+        result.findings.append(
+            Finding(
+                rule="G4",
+                path="tools/lint/shardflow/entries.py",
+                line=1,
+                message=f"[{spec.name}] GSPMD entry failed to trace: "
+                f"{type(err).__name__}: {err}",
+                hint="the auto-partitioned surface the census pins doesn't "
+                "build; fix the library (or the entry's probe mesh/inputs)",
+            )
+        )
+
+    rows: dict[str, dict] = {}
+    for entry in entries:
+        mesh_axes = frozenset(str(a) for a in entry.mesh.shape)
+        interp = ShardflowInterp(
+            mesh_axes,
+            root=str(root),
+            fallback_site=(entry.path, entry.line),
+        )
+        out_svs = interp.run(entry.closed.jaxpr, entry.in_svs)
+        events = interp.events
+        result.eqns_interpreted += interp.eqns_seen
+        result.sites_checked += len(events)
+        result.findings.extend(rules_mod.check_entry(entry, events, root))
+        rows[entry.name] = census_mod.entry_row(
+            entry, events, out_svs, str(root)
+        )
+
+    result.census = census_mod.build_census(rows, jax.__version__)
+    if not update:
+        try:
+            display = census_path.relative_to(root)
+        except ValueError:
+            display = census_path
+        drift, diff = census_mod.compare(
+            census_mod.load_census(census_path), result.census, display
+        )
+        result.findings.extend(drift)
+        result.diff = diff
+
+    result.findings = filter_findings(
+        result.findings, root, disable, select, used=pragma_used
+    )
+    return result

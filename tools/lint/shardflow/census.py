@@ -1,0 +1,213 @@
+"""G4 — the sharding census: what the partitioner infers, pinned.
+
+``artifacts/shardflow_census.json`` records, per registered GSPMD entry,
+the probe mesh, the input PartitionSpecs, the PROPAGATED output shardings
+(what the analysis says each traced output looks like on the mesh), the
+G2 cross-shard byte totals and the G1 taint origins (as line-independent
+finding fingerprints, so unrelated edits above the site don't churn the
+golden). The file is committed; the tier rebuilds it and gates on ANY
+drift, so "the 2D entry grew a second divergent gather" or "an output
+silently went fully replicated" becomes a reviewed diff. Regeneration::
+
+    python -m tools.lint --shardflow-census-update
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from tools.lint.model import Finding
+from tools.lint.shardflow.domain import SV, sv_from_pspec
+from tools.lint.shardflow.rules import _source_line
+
+#: Bump when the census wire format changes shape.
+SHARDFLOW_CENSUS_SCHEMA = 1
+
+
+def _fingerprint(root, path: str, line: int) -> str:
+    """The G1 finding's fingerprint at an origin site (model.Finding's
+    path:rule:source-line hash — stable across unrelated line shifts)."""
+    src = _source_line(Path(root), path, line)
+    return Finding(
+        rule="G1", path=path, line=line, message="", source_line=src
+    ).fingerprint
+
+
+def entry_row(entry, events, out_svs, root: str) -> dict:
+    """One census row: mesh, in/out shardings, event totals, G1 origins."""
+    crossing = [
+        e for e in events if e.kind in ("gather", "scatter", "sort") and e.crossed
+    ]
+    origins = sorted(
+        {(e.origin or (e.path, e.line)) for e in events if e.fired}
+    )
+    in_svs = [
+        sv_from_pspec(s, len(v.dims))
+        for s, v in zip(entry.in_specs, entry.in_svs)
+    ]
+    row = {
+        "mesh": {name: int(size) for name, size in entry.mesh.shape.items()},
+        "n": int(entry.n),
+        "in_shardings": [sv.render() for sv in in_svs],
+        "out_shardings": [
+            sv.render() if isinstance(sv, SV) else "()" for sv in out_svs
+        ],
+        "g1_origins": [
+            {"path": p, "fingerprint": _fingerprint(root, p, ln)}
+            for p, ln in origins
+        ],
+        "g2_crossing_bytes": int(sum(e.nbytes for e in crossing)),
+        "g2_crossing_sites": len(crossing),
+        "reduce_hazards": sum(
+            1 for e in events if e.kind == "reduce" and e.hazard
+        ),
+        "hbm_budget_bytes": int(entry.hbm_budget),
+        "path": entry.path,
+    }
+    row["digest"] = hashlib.sha256(
+        json.dumps(
+            {k: row[k] for k in row if k != "path"}, sort_keys=True
+        ).encode()
+    ).hexdigest()
+    return row
+
+
+def build_census(rows: dict[str, dict], jax_version: str) -> dict:
+    digest = hashlib.sha256(
+        json.dumps(
+            {name: row["digest"] for name, row in sorted(rows.items())},
+            sort_keys=True,
+        ).encode()
+    ).hexdigest()
+    return {
+        "shardflow_census_schema": SHARDFLOW_CENSUS_SCHEMA,
+        "jax_version": jax_version,
+        "digest": digest,
+        "entries": dict(sorted(rows.items())),
+    }
+
+
+def load_census(path: Path) -> dict | None:
+    try:
+        return json.loads(Path(path).read_text())
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def write_census(census: dict, path: Path) -> None:
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(census, indent=2, sort_keys=True) + "\n")
+
+
+def _sharding_diff(old: dict, new: dict) -> list[str]:
+    lines: list[str] = []
+    for key in ("in_shardings", "out_shardings"):
+        o, n = old.get(key, []), new.get(key, [])
+        if o != n:
+            changed = sum(1 for a, b in zip(o, n) if a != b) + abs(
+                len(o) - len(n)
+            )
+            lines.append(f"    {key}: {changed} leaf/leaves changed")
+    for key in (
+        "g2_crossing_bytes",
+        "g2_crossing_sites",
+        "reduce_hazards",
+        "mesh",
+        "n",
+    ):
+        if old.get(key) != new.get(key):
+            lines.append(f"    {key}: {old.get(key)} -> {new.get(key)}")
+    og = {d["fingerprint"] for d in old.get("g1_origins", [])}
+    ng = {d["fingerprint"] for d in new.get("g1_origins", [])}
+    for fp in sorted(og - ng):
+        lines.append(f"    - g1 origin {fp}")
+    for fp in sorted(ng - og):
+        lines.append(f"    + g1 origin {fp}")
+    return lines
+
+
+def compare(
+    old: dict | None, new: dict, census_path: Path
+) -> tuple[list[Finding], list[str]]:
+    """Drift between the committed sharding census and this rebuild."""
+    hint = (
+        f"review the drift, then 'python -m tools.lint "
+        f"--shardflow-census-update' to re-pin {census_path}"
+    )
+    if old is None:
+        f = Finding(
+            rule="G4",
+            path=str(census_path),
+            line=1,
+            message="sharding census golden missing or unreadable — the "
+            "GSPMD propagation surface is unpinned",
+            hint=hint,
+        )
+        return [f], ["sharding census golden missing: full rebuild required"]
+
+    findings: list[Finding] = []
+    diff: list[str] = []
+    if old.get("shardflow_census_schema") != new["shardflow_census_schema"]:
+        findings.append(
+            Finding(
+                rule="G4",
+                path=str(census_path),
+                line=1,
+                message=f"sharding census schema changed: "
+                f"{old.get('shardflow_census_schema')} -> "
+                f"{new['shardflow_census_schema']}",
+                hint=hint,
+            )
+        )
+    if old.get("jax_version") != new["jax_version"]:
+        diff.append(
+            f"  jax version: {old.get('jax_version')} -> {new['jax_version']}"
+        )
+    old_entries = old.get("entries", {})
+    new_entries = new["entries"]
+    for name in sorted(set(old_entries) | set(new_entries)):
+        o, n = old_entries.get(name), new_entries.get(name)
+        if o is None:
+            findings.append(
+                Finding(
+                    rule="G4",
+                    path=n.get("path") or str(census_path),
+                    line=1,
+                    message=f"[{name}] GSPMD entry is new since the "
+                    "committed sharding census",
+                    hint=hint,
+                )
+            )
+            diff.append(f"  + {name}")
+            continue
+        if n is None:
+            findings.append(
+                Finding(
+                    rule="G4",
+                    path=o.get("path") or str(census_path),
+                    line=1,
+                    message=f"[{name}] GSPMD entry vanished from the "
+                    "sharding census",
+                    hint=hint,
+                )
+            )
+            diff.append(f"  - {name}")
+            continue
+        if o.get("digest") == n["digest"]:
+            continue
+        findings.append(
+            Finding(
+                rule="G4",
+                path=n.get("path") or str(census_path),
+                line=1,
+                message=f"[{name}] sharding surface drifted from the "
+                "committed census",
+                hint=hint,
+            )
+        )
+        diff.append(f"  ~ {name}:")
+        diff.extend(_sharding_diff(o, n))
+    return findings, diff

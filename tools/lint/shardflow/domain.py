@@ -1,0 +1,144 @@
+"""The per-dimension sharding lattice tier 4 propagates.
+
+Each traced array maps to a :class:`SV` (sharding value):
+
+- ``dims`` — one lattice element per array dimension:
+  ``frozenset()`` (REPLICATED: every shard holds the whole extent),
+  a non-empty frozenset of mesh-axis names (SHARDED over those axes),
+  or the :data:`UNKNOWN` sentinel (conflicting joins — the analysis
+  lost track of which shard holds what). The dimension join mirrors
+  GSPMD's propagation preference: replicated yields to sharded
+  (``join(REP, {m}) = {m}``), and two DIFFERENT shardings collapse to
+  Unknown (``join({m}, {s}) = UNKNOWN``) — height 2, so every fixpoint
+  terminates fast.
+
+- ``deps`` — divergence-taint provenance: the set of mesh axes a value's
+  *contents* may depend on in a per-shard-inconsistent way. Taint is
+  injected in exactly one place (propagate.py): a point-gather whose
+  indexed dimensions span >= 2 distinct mesh axes of the operand — the
+  dual-sharded coordinate-resolution shape PR 14's bisect pinned under
+  the 2D mesh (single-axis crossings and reductions are deterministic
+  collectives GSPMD resolves; the 1D engine is runtime-certified clean).
+  Everything downstream unions deps like any dataflow taint.
+
+- ``origin`` — the ``(path, line)`` where the taint was born, threaded
+  through joins so every downstream G1 firing dedupes back to ONE
+  finding at the birth site (one pragma per root cause, not one per
+  symptom).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class _Unknown:
+    """Singleton sentinel: sharding no longer tracked for this dim."""
+
+    __slots__ = ()
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return "UNKNOWN"
+
+
+#: Conflicting-join top of the per-dimension lattice.
+UNKNOWN = _Unknown()
+
+#: Replicated bottom of the per-dimension lattice.
+REP: frozenset = frozenset()
+
+
+def join_dim(a, b):
+    """Join two per-dimension lattice elements."""
+    if a is UNKNOWN or b is UNKNOWN:
+        return UNKNOWN
+    if a == b:
+        return a
+    if not a:
+        return b
+    if not b:
+        return a
+    return UNKNOWN
+
+
+def dim_axes(d) -> frozenset:
+    """Mesh axes a dim element shards over (empty for REP and UNKNOWN)."""
+    return d if isinstance(d, frozenset) else REP
+
+
+def fmt_dim(d) -> str:
+    if d is UNKNOWN:
+        return "?"
+    if not d:
+        return "_"
+    return "+".join(sorted(d))
+
+
+@dataclass(frozen=True)
+class SV:
+    """Abstract sharding value of one traced array."""
+
+    dims: tuple = ()
+    deps: frozenset = field(default_factory=frozenset)
+    origin: tuple | None = None  # (path, line) where deps were injected
+
+    def render(self) -> str:
+        return "(" + ",".join(fmt_dim(d) for d in self.dims) + ")"
+
+    @property
+    def sharded_axes(self) -> frozenset:
+        out: set = set()
+        for d in self.dims:
+            out |= dim_axes(d)
+        return frozenset(out)
+
+
+def replicated(rank: int) -> SV:
+    return SV(dims=(REP,) * rank)
+
+
+def join_sv(a: SV, b: SV) -> SV:
+    """Join two sharding values. Rank mismatches (which a well-typed jaxpr
+    never produces, but a defensive analysis must survive) collapse the
+    dims to Unknown at the shorter rank."""
+    deps = a.deps | b.deps
+    origin = a.origin if a.origin is not None else b.origin
+    if len(a.dims) != len(b.dims):
+        rank = min(len(a.dims), len(b.dims))
+        return SV(dims=(UNKNOWN,) * rank, deps=deps, origin=origin)
+    return SV(
+        dims=tuple(join_dim(x, y) for x, y in zip(a.dims, b.dims)),
+        deps=deps,
+        origin=origin,
+    )
+
+
+def with_taint(v: SV, of: SV) -> SV:
+    """``v`` tainted by another value's deps (dims untouched) — predicate
+    mixing for while/cond and index-provenance flow."""
+    if of is None or (not of.deps and of.origin is None):
+        return v
+    if of.deps <= v.deps and (v.origin is not None or of.origin is None):
+        return v
+    return SV(
+        dims=v.dims,
+        deps=v.deps | of.deps,
+        origin=v.origin if v.origin is not None else of.origin,
+    )
+
+
+def sv_from_pspec(spec, rank: int) -> SV:
+    """A :class:`SV` from a ``PartitionSpec`` (``None`` means fully
+    replicated; trailing dims pad to replicated; multi-axis tuple entries
+    flatten to their axis set)."""
+    dims = []
+    for entry in tuple(spec) if spec is not None else ():
+        if entry is None:
+            dims.append(REP)
+        elif isinstance(entry, tuple):
+            dims.append(frozenset(entry))
+        else:
+            dims.append(frozenset((entry,)))
+    while len(dims) < rank:
+        dims.append(REP)
+    return SV(dims=tuple(dims[:rank]))

@@ -1,0 +1,325 @@
+"""Registry of the GSPMD-partitioned jit entries tier 4 analyzes.
+
+These are the auto-partitioned twins of the shard_map registry
+(tools/lint/spmdcheck/entries.py): the SAME library entry points
+(``run_sparse_ticks``, the ensemble twin, the dense and Rapid engines)
+but driven the GSPMD way — plain ``jax.jit`` with ``NamedSharding``
+inputs, the partitioner inferring every collective. Each entry pairs a
+traced ClosedJaxpr with the PartitionSpecs of its flattened inputs
+(straight from parallel/mesh.py, the single layout source), which seed
+the sharding-propagation analysis (propagate.py).
+
+Mesh coverage mirrors the runtime certification surface:
+
+- ``run_sparse_ticks`` under the 1D members mesh (runtime-certified
+  bit-clean) AND under the 2×2 viewers×subjects mesh — the layout whose
+  FD probe-selection divergence is pinned as
+  tests/test_spmd.py::test_2d_mesh_divergence_bisected_to_fd_probe_selection;
+  the 2D entry MUST fire G1 at that bisected site.
+- the ensemble twin under the 2×2 universes×members mesh (single
+  member axis per matrix — G1-silent by the same analysis that fires
+  on the 2D layout).
+- the dense and Rapid engines under the 1D members mesh (their
+  certified production layout; neither ships a 2D layout, so none is
+  registered — registering one would merely rediscover the same
+  dual-sharded point-gather class G1 already pins on the sparse 2D
+  entry).
+
+Entry names key ``artifacts/shardflow_census.json`` (G4); adding or
+removing one here is itself a reviewed census diff.
+"""
+
+from __future__ import annotations
+
+import warnings
+from dataclasses import dataclass, field
+from typing import Callable
+
+from tools.lint.semantic.entries import _fn_location
+from tools.lint.shardflow.domain import SV, sv_from_pspec
+
+#: Probe shapes — n % (d * 32) == 0 (group-32 fan-out × 2 member shards),
+#: matching the spmdcheck registry.
+N = 128
+S = 128
+B = 2
+T = 4
+D = 2
+
+#: Default per-entry HBM materialization budget (G2): generous for the
+#: probe shapes, and the census pins the actual byte totals so growth is
+#: a reviewed diff long before the budget gates.
+DEFAULT_HBM_BUDGET = 1 << 30
+
+
+@dataclass
+class TracedShardflowEntry:
+    """One traced GSPMD entry plus everything the rule pack needs."""
+
+    name: str
+    path: str
+    line: int
+    closed: object  # ClosedJaxpr
+    mesh: object  # the probe Mesh
+    in_svs: list  # SV per closed.jaxpr.invars entry
+    in_specs: list  # the PartitionSpecs the SVs were seeded from
+    n: int
+    hbm_budget: int = DEFAULT_HBM_BUDGET
+
+
+@dataclass(frozen=True)
+class ShardflowEntrySpec:
+    name: str
+    build: Callable[[], tuple]  # () -> (fn, args, kwargs, meta-dict)
+    meta: dict = field(default_factory=dict)
+
+
+def _leaf_specs(arg_trees, spec_trees) -> list:
+    """Flatten matching (value, spec) pytrees into an invar-ordered spec
+    list — jit flattens dynamic args in tree order, so the two flatten
+    identically as long as the spec tree mirrors the value tree's
+    structure (None fields included)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(arg_trees)
+    specs = jax.tree_util.tree_leaves(spec_trees)
+    if len(leaves) != len(specs):
+        raise ValueError(
+            f"pspec tree mismatch: {len(leaves)} arg leaves vs "
+            f"{len(specs)} specs"
+        )
+    return specs
+
+
+def _member_major_pspecs(tree, n: int):
+    """Shape-driven member-major layout for engines without a shipped
+    pspec helper (Rapid): any leaf whose leading dim is ``n`` shards
+    viewers across the members axis, everything else replicates — the
+    exact rule state_shardings applies to the dense SimState."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from scalecube_cluster_tpu.parallel.mesh import AXIS
+
+    def spec(leaf):
+        shape = getattr(leaf, "shape", ())
+        if len(shape) >= 1 and int(shape[0]) == n:
+            return P(AXIS, *([None] * (len(shape) - 1)))
+        return P()
+
+    return jax.tree_util.tree_map(spec, tree)
+
+
+def _replicated_plan_pspecs(plan):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(lambda _: P(), plan)
+
+
+def _sparse_inputs():
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+    )
+
+    params = SparseParams.for_n(N, slot_budget=S)
+    state = init_sparse_full_view(
+        N, slot_budget=S, user_gossip_slots=params.base.user_gossip_slots
+    )
+    return params, state, FaultPlan.uniform()
+
+
+def _build_run_sparse_ticks(two_d: bool):
+    import jax
+
+    from scalecube_cluster_tpu.parallel.mesh import (
+        make_mesh,
+        make_mesh2d,
+        sparse_state_pspecs,
+    )
+    from scalecube_cluster_tpu.sim.sparse import run_sparse_ticks
+
+    params, state, plan = _sparse_inputs()
+    mesh = (
+        make_mesh2d((D, D)) if two_d else make_mesh(jax.devices()[:D])
+    )
+    state_specs = sparse_state_pspecs(like=state, two_d=two_d)
+    specs = _leaf_specs(
+        (state, plan), (state_specs, _replicated_plan_pspecs(plan))
+    )
+    return (
+        run_sparse_ticks,
+        (params, state, plan, T),
+        {"collect": True},
+        {"mesh": mesh, "in_specs": specs, "n": N},
+    )
+
+
+def _build_run_ensemble_sparse_ticks():
+    from jax.sharding import PartitionSpec as P
+
+    from scalecube_cluster_tpu.parallel.mesh import (
+        UNIVERSE_AXIS,
+        make_universe_member_mesh,
+        sparse_state_pspecs,
+    )
+    from scalecube_cluster_tpu.sim.ensemble import (
+        init_ensemble_sparse,
+        run_ensemble_sparse_ticks,
+        stack_universes,
+    )
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import SparseParams
+
+    import jax
+
+    params = SparseParams.for_n(N, slot_budget=S)
+    mesh = make_universe_member_mesh((B, D))
+    states = init_ensemble_sparse(
+        N,
+        [0] * B,
+        slot_budget=S,
+        user_gossip_slots=params.base.user_gossip_slots,
+    )
+    plans = stack_universes(FaultPlan.uniform() for _ in range(B))
+    state_specs = sparse_state_pspecs(
+        like=states, two_d=False, prefix=(UNIVERSE_AXIS,)
+    )
+    plan_specs = jax.tree_util.tree_map(lambda _: P(UNIVERSE_AXIS), plans)
+    specs = _leaf_specs((states, plans), (state_specs, plan_specs))
+    return (
+        run_ensemble_sparse_ticks,
+        (params, states, plans, T),
+        {"collect": True},
+        {"mesh": mesh, "in_specs": specs, "n": N},
+    )
+
+
+def _build_run_ticks():
+    import jax
+
+    from scalecube_cluster_tpu.parallel.mesh import make_mesh, state_shardings
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.params import SimParams
+    from scalecube_cluster_tpu.sim.run import run_ticks
+    from scalecube_cluster_tpu.sim.state import init_full_view, seeds_mask
+
+    params = SimParams(n=N)
+    state = init_full_view(N, params.user_gossip_slots)
+    plan = FaultPlan.uniform()
+    seeds = seeds_mask(N, [0])
+    mesh = make_mesh(jax.devices()[:D])
+    state_specs = jax.tree_util.tree_map(
+        lambda ns: ns.spec, state_shardings(mesh)
+    )
+    seed_specs = _member_major_pspecs(seeds, N)
+    specs = _leaf_specs(
+        (state, plan, seeds),
+        (state_specs, _replicated_plan_pspecs(plan), seed_specs),
+    )
+    return (
+        run_ticks,
+        (params, state, plan, seeds, T),
+        {"collect": True},
+        {"mesh": mesh, "in_specs": specs, "n": N},
+    )
+
+
+def _build_run_rapid_ticks():
+    import jax
+
+    from scalecube_cluster_tpu.parallel.mesh import make_mesh
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.rapid import (
+        RapidParams,
+        init_rapid_full_view,
+        run_rapid_ticks,
+    )
+
+    params = RapidParams(n=N)
+    state = init_rapid_full_view(params)
+    plan = FaultPlan.uniform()
+    mesh = make_mesh(jax.devices()[:D])
+    specs = _leaf_specs(
+        (state, plan),
+        (
+            _member_major_pspecs(state, N),
+            _replicated_plan_pspecs(plan),
+        ),
+    )
+    return (
+        run_rapid_ticks,
+        (params, state, plan, T),
+        {"collect": True},
+        {"mesh": mesh, "in_specs": specs, "n": N},
+    )
+
+
+SHARDFLOW_ENTRY_SPECS: tuple[ShardflowEntrySpec, ...] = (
+    ShardflowEntrySpec(
+        "sim.sparse.run_sparse_ticks[gspmd1d,d2]",
+        lambda: _build_run_sparse_ticks(False),
+    ),
+    ShardflowEntrySpec(
+        "sim.sparse.run_sparse_ticks[gspmd2d,2x2]",
+        lambda: _build_run_sparse_ticks(True),
+    ),
+    ShardflowEntrySpec(
+        "sim.ensemble.run_ensemble_sparse_ticks[gspmd,2x2]",
+        _build_run_ensemble_sparse_ticks,
+    ),
+    ShardflowEntrySpec(
+        "sim.run.run_ticks[gspmd1d,d2]", _build_run_ticks
+    ),
+    ShardflowEntrySpec(
+        "sim.rapid.run_rapid_ticks[gspmd1d,d2]", _build_run_rapid_ticks
+    ),
+)
+
+
+def trace_entry(spec: ShardflowEntrySpec, root: str) -> TracedShardflowEntry:
+    """Build inputs and trace one entry (abstract eval only — the probe
+    mesh is virtual, nothing executes), then seed one SV per invar."""
+    fn, args, kwargs, meta = spec.build()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        traced = fn.trace(*args, **kwargs)
+    closed = traced.jaxpr
+    specs = meta["in_specs"]
+    invars = closed.jaxpr.invars
+    if len(specs) != len(invars):
+        raise ValueError(
+            f"[{spec.name}] {len(specs)} input specs vs "
+            f"{len(invars)} traced invars — the spec pytrees drifted from "
+            "the entry signature"
+        )
+    in_svs: list[SV] = [
+        sv_from_pspec(s, len(getattr(v.aval, "shape", ())))
+        for s, v in zip(specs, invars)
+    ]
+    path, line = _fn_location(meta.get("unwrap", fn), root)
+    return TracedShardflowEntry(
+        name=spec.name,
+        path=path,
+        line=line,
+        closed=closed,
+        mesh=meta["mesh"],
+        in_svs=in_svs,
+        in_specs=list(specs),
+        n=meta["n"],
+        hbm_budget=meta.get("hbm_budget", DEFAULT_HBM_BUDGET),
+    )
+
+
+def build_entries(root: str):
+    """Trace every registered entry; ``(entries, failures)``."""
+    entries: list[TracedShardflowEntry] = []
+    failures: list[tuple[ShardflowEntrySpec, Exception]] = []
+    for spec in SHARDFLOW_ENTRY_SPECS:
+        try:
+            entries.append(trace_entry(spec, root))
+        except Exception as e:  # surfaced as G4 by the orchestrator
+            failures.append((spec, e))
+    return entries, failures

@@ -1,0 +1,759 @@
+"""GSPMD sharding propagation over a whole auto-partitioned jaxpr.
+
+Runs the shared fixpoint core (tools/lint/lattice.py) in the per-dim
+sharding domain (tools/lint/shardflow/domain.py) over the closed jaxpr of
+a jit entry whose inputs carry ``NamedSharding`` specs, approximating
+what the XLA partitioner will infer (GSPMD, arXiv 2105.04663):
+
+- elementwise ops preserve shardings (right-aligned broadcast join);
+- reductions over a sharded dim RESOLVE it (XLA inserts a deterministic
+  all-reduce) — the dim disappears, no divergence taint;
+- gathers/scatters crossing a sharded dim are cross-shard traffic: each
+  is recorded as an :class:`Event` with a byte estimate (G2's input) and
+  checked for divergence-tainted indices (G1's input);
+- a POINT-gather whose indexed dims span >= 2 distinct mesh axes (the
+  dual-sharded coordinate resolution of ``view_T[subject, viewer]`` under
+  a 2D mesh) INJECTS divergence taint: this is the op class the PR-14
+  bisect showed GSPMD resolves per-shard-inconsistently, and everything
+  computed from its result may differ across shards;
+- ``scan``/``while``/``cond`` get carry-fixpoint/branch-join treatment
+  from the shared core; a tainted while-predicate or cond-predicate
+  taints the outputs (per-shard trip counts / branch choices);
+- opaque primitives fall back to replicated dims + deps union —
+  optimistic on purpose: G rules are lints, and pessimism here would bury
+  the one real finding under rank-mismatch noise.
+
+Event streams are deduped by call site keeping the LAST (post-fixpoint,
+strongest) observation, so census counts and G2 byte totals are
+deterministic and don't scale with fixpoint round count.
+"""
+
+from __future__ import annotations
+
+import posixpath
+from dataclasses import dataclass
+
+from tools.lint.lattice import AbstractInterpreter
+from tools.lint.shardflow.domain import (
+    REP,
+    SV,
+    UNKNOWN,
+    dim_axes,
+    join_dim,
+    join_sv,
+    replicated,
+    with_taint,
+)
+
+#: Reduction primitives with an ``axes`` params entry.
+_REDUCE_PRIMS = {
+    "reduce_sum",
+    "reduce_prod",
+    "reduce_max",
+    "reduce_min",
+    "reduce_and",
+    "reduce_or",
+    "reduce_xor",
+    "argmax",
+    "argmin",
+}
+
+#: Scatter family (operand, indices, updates) -> operand-shaped output.
+_SCATTER_PRIMS = {
+    "scatter",
+    "scatter-add",
+    "scatter-mul",
+    "scatter-min",
+    "scatter-max",
+    "scatter_apply",
+}
+
+#: Dim-preserving unary/structural prims handled as identity-on-dims.
+_PRESERVE_PRIMS = {
+    "copy",
+    "convert_element_type",
+    "stop_gradient",
+    "reduce_precision",
+    "rev",
+    "pad",
+    "cumsum",
+    "cumprod",
+    "cummax",
+    "cummin",
+    "cumlogsumexp",
+    "clamp",
+    "device_put",
+    "optimization_barrier",
+}
+
+
+@dataclass
+class Event:
+    """One cross-shard op observation (deduped by ``key``)."""
+
+    kind: str  # "gather" | "scatter" | "reduce" | "sort"
+    prim: str
+    path: str
+    line: int
+    crossed: frozenset  # mesh axes the op moves data across
+    nbytes: int  # operand bytes the crossing may materialize
+    fired: bool = False  # G1: divergence-tainted indices crossed a shard
+    origin: tuple | None = None  # taint birth site the firing dedupes to
+    hazard: str = ""  # G3: non-empty describes the partial-sum hazard
+    injected: bool = False  # this site injected divergence taint
+
+    @property
+    def key(self):
+        return (self.path, self.line, self.kind, self.prim, self.nbytes)
+
+
+def _aval_bytes(aval) -> int:
+    size = 1
+    for d in getattr(aval, "shape", ()):
+        size *= int(d)
+    dtype = getattr(aval, "dtype", None)
+    return size * (dtype.itemsize if dtype is not None else 1)
+
+
+def _rank(atom) -> int:
+    return len(getattr(getattr(atom, "aval", None), "shape", ()))
+
+
+def _shape(atom) -> tuple:
+    return tuple(
+        int(d) for d in getattr(getattr(atom, "aval", None), "shape", ())
+    )
+
+
+class ShardflowInterp(AbstractInterpreter):
+    """Sharding propagation + event collection for one traced entry."""
+
+    def __init__(self, mesh_axes, root: str, fallback_site: tuple[str, int]):
+        # Lattice height per dim is 2 and deps grow to |axes|; the +3 keeps
+        # break-on-stable the real terminator even for taint+origin churn.
+        super().__init__(max_rounds=2 * len(mesh_axes) + 3)
+        self.mesh_axes = frozenset(mesh_axes)
+        self.root = str(root)
+        self.fallback_site = fallback_site
+        self._events: dict[tuple, Event] = {}
+        self._site_cache: dict[int, tuple[str, int]] = {}
+
+    # -- events -----------------------------------------------------------
+
+    @property
+    def events(self) -> list[Event]:
+        return list(self._events.values())
+
+    def _record(self, ev: Event) -> None:
+        self._events[ev.key] = ev
+
+    def _site(self, eqn) -> tuple[str, int]:
+        """Innermost user frame under the repo root (the lint package's own
+        frames never qualify); falls back to the entry's def site."""
+        cached = self._site_cache.get(id(eqn))
+        if cached is not None:
+            return cached
+        site = self.fallback_site
+        try:
+            from jax._src import source_info_util
+
+            for fr in source_info_util.user_frames(eqn.source_info):
+                name = fr.file_name.replace("\\", "/")
+                if "/tools/lint/" in name or "/jax/" in name:
+                    continue
+                if name.startswith(self.root):
+                    rel = posixpath.normpath(
+                        name[len(self.root) :].lstrip("/")
+                    )
+                    site = (rel, int(fr.start_line))
+                    break
+        except Exception:
+            pass
+        self._site_cache[id(eqn)] = site
+        return site
+
+    # -- domain hooks -----------------------------------------------------
+
+    def join(self, a: SV, b: SV) -> SV:
+        return join_sv(a, b)
+
+    def literal_value(self, atom) -> SV:
+        return replicated(_rank(atom))
+
+    def mix_pred(self, value: SV, pred: SV) -> SV:
+        return with_taint(value, pred)
+
+    def enter_xs(self, value: SV) -> SV:
+        return SV(dims=value.dims[1:], deps=value.deps, origin=value.origin)
+
+    def exit_ys(self, value: SV) -> SV:
+        return SV(
+            dims=(REP,) + value.dims, deps=value.deps, origin=value.origin
+        )
+
+    def call_fallback(self, eqn, ins, body):
+        deps: frozenset = frozenset()
+        origin = None
+        for v in ins:
+            deps |= v.deps
+            if origin is None:
+                origin = v.origin
+        return [
+            SV(dims=(REP,) * _rank(v), deps=deps, origin=origin)
+            for v in eqn.outvars
+        ]
+
+    # -- transfer ---------------------------------------------------------
+
+    def _default(self, eqn, ins):
+        """Right-aligned broadcast join: NumPy broadcasting aligns trailing
+        dims, and elementwise GSPMD propagation follows the data."""
+        deps: frozenset = frozenset()
+        origin = None
+        for v in ins:
+            deps |= v.deps
+            if origin is None:
+                origin = v.origin
+        outs = []
+        for ov in eqn.outvars:
+            rank = _rank(ov)
+            shape = _shape(ov)
+            dims = [REP] * rank
+            for iv, sv in zip(eqn.invars, ins):
+                r = len(sv.dims)
+                ishape = _shape(iv)
+                for i, d in enumerate(sv.dims):
+                    o = rank - r + i
+                    if o < 0:
+                        continue
+                    # size-1 broadcast dims contribute nothing.
+                    if i < len(ishape) and ishape[i] == 1 and shape[o] != 1:
+                        continue
+                    dims[o] = (
+                        d
+                        if dims[o] == REP
+                        else dims[o]
+                        if d == REP or d == dims[o]
+                        else UNKNOWN
+                    )
+            outs.append(SV(dims=tuple(dims), deps=deps, origin=origin))
+        return outs
+
+    def prim_transfer(self, eqn, ins):
+        name = eqn.primitive.name
+
+        if name == "gather":
+            return [self._gather(eqn, ins)]
+        if name in _SCATTER_PRIMS:
+            return [self._scatter(eqn, ins)]
+        if name == "dynamic_slice":
+            return [self._dynamic_slice(eqn, ins)]
+        if name == "dynamic_update_slice":
+            return [self._dynamic_update_slice(eqn, ins)]
+        if name in _REDUCE_PRIMS or (
+            name == "reduce" and "dimensions" in eqn.params
+        ):
+            return self._reduce(eqn, ins)
+        if name == "dot_general":
+            return [self._dot_general(eqn, ins)]
+        if name == "broadcast_in_dim":
+            return [self._broadcast_in_dim(eqn, ins)]
+        if name == "reshape":
+            return [self._reshape(eqn, ins)]
+        if name == "transpose":
+            perm = eqn.params["permutation"]
+            sv = ins[0]
+            return [
+                SV(
+                    dims=tuple(sv.dims[p] for p in perm),
+                    deps=sv.deps,
+                    origin=sv.origin,
+                )
+            ]
+        if name == "squeeze":
+            drop = set(eqn.params["dimensions"])
+            sv = ins[0]
+            return [
+                SV(
+                    dims=tuple(
+                        d for i, d in enumerate(sv.dims) if i not in drop
+                    ),
+                    deps=sv.deps,
+                    origin=sv.origin,
+                )
+            ]
+        if name == "concatenate":
+            return [self._concatenate(eqn, ins)]
+        if name == "iota":
+            return [replicated(_rank(eqn.outvars[0]))]
+        if name == "sort":
+            return self._sort(eqn, ins)
+        if name == "top_k":
+            sv = ins[0]
+            if sv.dims and dim_axes(sv.dims[-1]):
+                path, line = self._site(eqn)
+                self._record(
+                    Event(
+                        kind="sort",
+                        prim=name,
+                        path=path,
+                        line=line,
+                        crossed=dim_axes(sv.dims[-1]),
+                        nbytes=_aval_bytes(eqn.invars[0].aval),
+                    )
+                )
+            dims = sv.dims[:-1] + (REP,) if sv.dims else sv.dims
+            return [
+                SV(dims=dims, deps=sv.deps, origin=sv.origin)
+                for _ in eqn.outvars
+            ]
+        if name in _PRESERVE_PRIMS:
+            deps: frozenset = frozenset()
+            origin = None
+            for v in ins:
+                deps |= v.deps
+                if origin is None:
+                    origin = v.origin
+            first = ins[0] if ins else replicated(0)
+            return [
+                SV(
+                    dims=first.dims
+                    if len(first.dims) == _rank(ov)
+                    else (REP,) * _rank(ov),
+                    deps=deps,
+                    origin=origin,
+                )
+                for ov in eqn.outvars
+            ]
+        if name == "slice":
+            sv = ins[0]
+            # Static windows keep the dim's sharding when they span it
+            # whole; a proper sub-window of a sharded dim is a (cheap,
+            # deterministic) cross-shard slice — keep REP.
+            shape = _shape(eqn.invars[0])
+            start = eqn.params.get("start_indices", ())
+            limit = eqn.params.get("limit_indices", ())
+            dims = []
+            for i, d in enumerate(sv.dims):
+                whole = (
+                    i < len(start)
+                    and i < len(limit)
+                    and start[i] == 0
+                    and i < len(shape)
+                    and limit[i] == shape[i]
+                )
+                dims.append(d if whole else REP)
+            return [SV(dims=tuple(dims), deps=sv.deps, origin=sv.origin)]
+
+        return self._default(eqn, ins)
+
+    # -- gather/scatter ---------------------------------------------------
+
+    def _gather(self, eqn, ins) -> SV:
+        operand, indices = ins[0], ins[1]
+        dnums = eqn.params["dimension_numbers"]
+        slice_sizes = tuple(eqn.params.get("slice_sizes", ()))
+        op_shape = _shape(eqn.invars[0])
+        collapsed = set(dnums.collapsed_slice_dims)
+        op_batch = set(getattr(dnums, "operand_batching_dims", ()))
+        offset_dims = tuple(dnums.offset_dims)
+
+        indexed = [
+            d
+            for d in dnums.start_index_map
+            if d < len(slice_sizes)
+            and d < len(op_shape)
+            and slice_sizes[d] != op_shape[d]
+        ]
+        crossed: set = set()
+        unknown_crossing = False
+        for d in indexed:
+            if d < len(operand.dims):
+                dd = operand.dims[d]
+                if dd is UNKNOWN:
+                    unknown_crossing = True
+                crossed |= dim_axes(dd)
+        crossed_f = frozenset(crossed)
+
+        deps = operand.deps | indices.deps
+        origin = (
+            operand.origin if operand.origin is not None else indices.origin
+        )
+        injected = False
+        multi_axis = len(crossed_f) >= 2 or unknown_crossing
+        path, line = self._site(eqn)
+        if multi_axis:
+            # The dual-sharded point-gather: the partitioner must resolve a
+            # per-element coordinate across two mesh axes at once — the op
+            # class the 2D-mesh bisect showed diverging per shard.
+            injected = True
+            deps = deps | crossed_f
+            if origin is None:
+                origin = (path, line)
+
+        fired = bool(indices.deps) and bool(crossed_f or unknown_crossing)
+        if crossed_f or unknown_crossing or fired:
+            self._record(
+                Event(
+                    kind="gather",
+                    prim="gather",
+                    path=path,
+                    line=line,
+                    crossed=crossed_f,
+                    nbytes=_aval_bytes(eqn.invars[0].aval),
+                    fired=fired,
+                    origin=indices.origin if fired else origin,
+                    injected=injected,
+                )
+            )
+
+        # Output dims: batch positions take the indices' non-vector dims in
+        # order; offset positions take the surviving operand window dims
+        # (keeping a dim's sharding only when the slice spans it whole).
+        out_rank = _rank(eqn.outvars[0])
+        window = [
+            operand.dims[d]
+            if d < len(operand.dims) and slice_sizes[d] == op_shape[d]
+            else REP
+            for d in range(len(op_shape))
+            if d not in collapsed and d not in op_batch
+        ]
+        batch_src = list(indices.dims[:-1]) if len(indices.dims) else []
+        dims = []
+        wi = 0
+        bi = 0
+        for o in range(out_rank):
+            if o in offset_dims:
+                dims.append(window[wi] if wi < len(window) else REP)
+                wi += 1
+            else:
+                dims.append(batch_src[bi] if bi < len(batch_src) else REP)
+                bi += 1
+        return SV(dims=tuple(dims), deps=deps, origin=origin)
+
+    def _scatter(self, eqn, ins) -> SV:
+        operand, indices, updates = ins[0], ins[1], ins[2]
+        dnums = eqn.params["dimension_numbers"]
+        crossed: set = set()
+        unknown_crossing = False
+        for d in dnums.scatter_dims_to_operand_dims:
+            if d < len(operand.dims):
+                dd = operand.dims[d]
+                if dd is UNKNOWN:
+                    unknown_crossing = True
+                crossed |= dim_axes(dd)
+        crossed_f = frozenset(crossed)
+
+        deps = operand.deps | indices.deps | updates.deps
+        origin = next(
+            (
+                v.origin
+                for v in (operand, indices, updates)
+                if v.origin is not None
+            ),
+            None,
+        )
+        injected = False
+        if len(crossed_f) >= 2 or unknown_crossing:
+            injected = True
+            deps = deps | crossed_f
+            if origin is None:
+                origin = self._site(eqn)
+
+        fired = bool(indices.deps) and bool(crossed_f or unknown_crossing)
+        if crossed_f or unknown_crossing or fired:
+            path, line = self._site(eqn)
+            self._record(
+                Event(
+                    kind="scatter",
+                    prim=eqn.primitive.name,
+                    path=path,
+                    line=line,
+                    crossed=crossed_f,
+                    nbytes=_aval_bytes(eqn.invars[2].aval),
+                    fired=fired,
+                    origin=indices.origin if fired else origin,
+                    injected=injected,
+                )
+            )
+        return SV(dims=operand.dims, deps=deps, origin=origin)
+
+    def _dynamic_slice(self, eqn, ins) -> SV:
+        operand = ins[0]
+        starts = ins[1:]
+        slice_sizes = tuple(eqn.params.get("slice_sizes", ()))
+        op_shape = _shape(eqn.invars[0])
+        start_deps: frozenset = frozenset()
+        start_origin = None
+        for s in starts:
+            start_deps |= s.deps
+            if start_origin is None:
+                start_origin = s.origin
+        crossed: set = set()
+        dims = []
+        for i, d in enumerate(operand.dims):
+            whole = i < len(slice_sizes) and slice_sizes[i] == op_shape[i]
+            if not whole:
+                crossed |= dim_axes(d)
+            dims.append(d if whole else REP)
+        crossed_f = frozenset(crossed)
+        fired = bool(start_deps) and bool(crossed_f)
+        if crossed_f:
+            path, line = self._site(eqn)
+            self._record(
+                Event(
+                    kind="gather",
+                    prim="dynamic_slice",
+                    path=path,
+                    line=line,
+                    crossed=crossed_f,
+                    nbytes=_aval_bytes(eqn.invars[0].aval),
+                    fired=fired,
+                    origin=start_origin,
+                )
+            )
+        deps = operand.deps | start_deps
+        origin = (
+            operand.origin if operand.origin is not None else start_origin
+        )
+        return SV(dims=tuple(dims), deps=deps, origin=origin)
+
+    def _dynamic_update_slice(self, eqn, ins) -> SV:
+        operand, update = ins[0], ins[1]
+        starts = ins[2:]
+        up_shape = _shape(eqn.invars[1])
+        op_shape = _shape(eqn.invars[0])
+        start_deps: frozenset = frozenset()
+        start_origin = None
+        for s in starts:
+            start_deps |= s.deps
+            if start_origin is None:
+                start_origin = s.origin
+        crossed: set = set()
+        for i, d in enumerate(operand.dims):
+            if (
+                i < len(up_shape)
+                and i < len(op_shape)
+                and up_shape[i] != op_shape[i]
+            ):
+                crossed |= dim_axes(d)
+        crossed_f = frozenset(crossed)
+        fired = bool(start_deps) and bool(crossed_f)
+        if crossed_f:
+            path, line = self._site(eqn)
+            self._record(
+                Event(
+                    kind="scatter",
+                    prim="dynamic_update_slice",
+                    path=path,
+                    line=line,
+                    crossed=crossed_f,
+                    nbytes=_aval_bytes(eqn.invars[1].aval),
+                    fired=fired,
+                    origin=start_origin,
+                )
+            )
+        deps = operand.deps | update.deps | start_deps
+        origin = next(
+            (
+                v
+                for v in (operand.origin, update.origin, start_origin)
+                if v is not None
+            ),
+            None,
+        )
+        return SV(dims=operand.dims, deps=deps, origin=origin)
+
+    # -- reductions -------------------------------------------------------
+
+    def _reduce(self, eqn, ins):
+        axes = eqn.params.get("axes", eqn.params.get("dimensions", ()))
+        axes = set(int(a) for a in axes)
+        sv = ins[0]
+        deps: frozenset = frozenset()
+        origin = None
+        for v in ins:
+            deps |= v.deps
+            if origin is None:
+                origin = v.origin
+        hazard = ""
+        reduced_axes: set = set()
+        for a in axes:
+            if a < len(sv.dims):
+                d = sv.dims[a]
+                if d is UNKNOWN:
+                    hazard = (
+                        f"reduction over dim {a} whose sharding degraded to "
+                        "Unknown — the partitioner may drop a mesh axis's "
+                        "contribution"
+                    )
+                reduced_axes |= dim_axes(d)
+        kept = [d for i, d in enumerate(sv.dims) if i not in axes]
+        # NOTE deliberately NOT a hazard: the same mesh axis alive on both
+        # a reduced and a kept dim. That shape falls out of ordinary
+        # dot/gather joins (both free dims member-sharded) and GSPMD
+        # resolves it with a deterministic reshard — flagging it buried
+        # the dense/rapid engines in noise. Only the Unknown degradation,
+        # where the propagation (and the partitioner's heuristics) lost
+        # track entirely, gates.
+        if hazard or reduced_axes:
+            path, line = self._site(eqn)
+            self._record(
+                Event(
+                    kind="reduce",
+                    prim=eqn.primitive.name,
+                    path=path,
+                    line=line,
+                    crossed=frozenset(reduced_axes),
+                    nbytes=0,
+                    hazard=hazard,
+                    origin=origin,
+                )
+            )
+        out = SV(dims=tuple(kept), deps=deps, origin=origin)
+        return [out for _ in eqn.outvars]
+
+    def _dot_general(self, eqn, ins) -> SV:
+        lhs, rhs = ins[0], ins[1]
+        (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+        deps = lhs.deps | rhs.deps
+        origin = lhs.origin if lhs.origin is not None else rhs.origin
+        for d in lc:
+            if d < len(lhs.dims) and lhs.dims[d] is UNKNOWN:
+                path, line = self._site(eqn)
+                self._record(
+                    Event(
+                        kind="reduce",
+                        prim="dot_general",
+                        path=path,
+                        line=line,
+                        crossed=frozenset(),
+                        nbytes=0,
+                        hazard="contraction over a dim whose sharding "
+                        "degraded to Unknown",
+                        origin=origin,
+                    )
+                )
+        batch = [
+            join_dim_pair(lhs.dims, rhs.dims, dl, dr)
+            for dl, dr in zip(lb, rb)
+        ]
+        lfree = [
+            lhs.dims[i]
+            for i in range(len(lhs.dims))
+            if i not in lc and i not in lb
+        ]
+        rfree = [
+            rhs.dims[i]
+            for i in range(len(rhs.dims))
+            if i not in rc and i not in rb
+        ]
+        return SV(
+            dims=tuple(batch + lfree + rfree), deps=deps, origin=origin
+        )
+
+    # -- structure --------------------------------------------------------
+
+    def _broadcast_in_dim(self, eqn, ins) -> SV:
+        sv = ins[0]
+        out_shape = tuple(int(d) for d in eqn.params["shape"])
+        bdims = tuple(eqn.params["broadcast_dimensions"])
+        in_shape = _shape(eqn.invars[0])
+        dims = [REP] * len(out_shape)
+        for i, o in enumerate(bdims):
+            if i < len(sv.dims) and i < len(in_shape):
+                if in_shape[i] == out_shape[o]:
+                    dims[o] = sv.dims[i]
+        return SV(dims=tuple(dims), deps=sv.deps, origin=sv.origin)
+
+    def _reshape(self, eqn, ins) -> SV:
+        sv = ins[0]
+        in_shape = _shape(eqn.invars[0])
+        out_shape = _shape(eqn.outvars[0])
+        if in_shape == out_shape:
+            return sv
+        in_nontrivial = [
+            (s, sv.dims[i] if i < len(sv.dims) else REP)
+            for i, s in enumerate(in_shape)
+            if s != 1
+        ]
+        out_nontrivial = [i for i, s in enumerate(out_shape) if s != 1]
+        if [s for s, _ in in_nontrivial] == [
+            out_shape[i] for i in out_nontrivial
+        ]:
+            # Pure squeeze/unsqueeze: non-trivial dims map 1:1 in order.
+            dims = [REP] * len(out_shape)
+            for (_, d), o in zip(in_nontrivial, out_nontrivial):
+                dims[o] = d
+            return SV(dims=tuple(dims), deps=sv.deps, origin=sv.origin)
+        # Merging/splitting reshape: sharded participants lose tracking.
+        if any(d != REP for _, d in in_nontrivial):
+            dims = tuple(
+                UNKNOWN if s != 1 else REP for s in out_shape
+            )
+            return SV(dims=dims, deps=sv.deps, origin=sv.origin)
+        return SV(
+            dims=(REP,) * len(out_shape), deps=sv.deps, origin=sv.origin
+        )
+
+    def _concatenate(self, eqn, ins) -> SV:
+        cdim = int(eqn.params["dimension"])
+        deps: frozenset = frozenset()
+        origin = None
+        rank = _rank(eqn.outvars[0])
+        dims = [REP] * rank
+        for sv in ins:
+            deps |= sv.deps
+            if origin is None:
+                origin = sv.origin
+            for i, d in enumerate(sv.dims):
+                if i == cdim:
+                    continue
+                if i < rank:
+                    dims[i] = join_dim(dims[i], d)
+        # Concatenating ALONG a sharded dim re-shapes the shard layout;
+        # flag the dim Unknown unless every input is replicated there.
+        concat_in = [
+            sv.dims[cdim] for sv in ins if cdim < len(sv.dims)
+        ]
+        dims[cdim] = REP if all(d == REP for d in concat_in) else UNKNOWN
+        return SV(dims=tuple(dims), deps=deps, origin=origin)
+
+    def _sort(self, eqn, ins):
+        sdim = int(eqn.params.get("dimension", -1))
+        deps: frozenset = frozenset()
+        origin = None
+        for v in ins:
+            deps |= v.deps
+            if origin is None:
+                origin = v.origin
+        outs = []
+        for sv, ov in zip(ins, eqn.outvars):
+            dims = list(
+                sv.dims if len(sv.dims) == _rank(ov) else (REP,) * _rank(ov)
+            )
+            if dims and -len(dims) <= sdim < len(dims):
+                if dim_axes(dims[sdim]) or dims[sdim] is UNKNOWN:
+                    path, line = self._site(eqn)
+                    self._record(
+                        Event(
+                            kind="sort",
+                            prim="sort",
+                            path=path,
+                            line=line,
+                            crossed=dim_axes(dims[sdim]),
+                            nbytes=_aval_bytes(eqn.invars[0].aval),
+                            origin=origin,
+                        )
+                    )
+                dims[sdim] = REP
+            outs.append(SV(dims=tuple(dims), deps=deps, origin=origin))
+        while len(outs) < len(eqn.outvars):
+            outs.append(SV(dims=(), deps=deps, origin=origin))
+        return outs[: len(eqn.outvars)]
+
+
+def join_dim_pair(ldims, rdims, dl, dr):
+    a = ldims[dl] if dl < len(ldims) else REP
+    b = rdims[dr] if dr < len(rdims) else REP
+    return join_dim(a, b)

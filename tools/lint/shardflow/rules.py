@@ -1,0 +1,122 @@
+"""G1/G2/G3 — findings from one entry's propagation event stream.
+
+The analysis (propagate.py) reports *events*; this module turns them into
+findings with the repo's finding discipline:
+
+- **G1** fires once per taint ORIGIN, not once per symptom: every
+  divergence-tainted gather/scatter downstream of one dual-sharded
+  point-gather dedupes back to the line where the taint was born, so the
+  bisected 2D FD probe-selection bug is ONE finding at the
+  ``my_record_of`` read in sim/sparse.py (and one pragma), not a dozen
+  findings across the FD/suspicion/writeback chain.
+- **G2** gates the per-entry cross-shard materialization estimate against
+  the entry's HBM budget — the n=1e6 guard: at probe shapes the bytes are
+  trivial, but the census (census.py) pins them, so the REVIEW sees the
+  multiplier long before a pod slice does.
+- **G3** fires at each reduction whose dim sharding degraded to Unknown
+  (or whose mesh axis survives on an unreduced dim) — the partial-sum
+  hazard class.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from tools.lint.model import Finding
+from tools.lint.shardflow.propagate import Event
+
+#: The runtime pin every G1 message cross-references.
+XFAIL_TEST = (
+    "tests/test_spmd.py::test_2d_mesh_divergence_bisected_to_fd_probe_selection"
+)
+
+
+def _source_line(root: Path, path: str, line: int) -> str:
+    try:
+        lines = (Path(root) / path).read_text().splitlines()
+        return lines[line - 1] if 0 < line <= len(lines) else ""
+    except OSError:
+        return ""
+
+
+def check_entry(entry, events: list[Event], root) -> list[Finding]:
+    findings: list[Finding] = []
+    root = Path(root)
+
+    # ---------------------------------------------------------------- G1
+    fired = [e for e in events if e.fired]
+    by_origin: dict[tuple, list[Event]] = {}
+    for e in fired:
+        origin = e.origin or (e.path, e.line)
+        by_origin.setdefault(origin, []).append(e)
+    for (path, line), evs in sorted(by_origin.items()):
+        axes = sorted(set().union(*(e.crossed for e in evs)))
+        downstream = sorted(
+            {(e.path, e.line) for e in evs if (e.path, e.line) != (path, line)}
+        )
+        where = (
+            f"; tainted indices reach {len(downstream)} further "
+            f"cross-shard site(s)"
+            if downstream
+            else ""
+        )
+        findings.append(
+            Finding(
+                rule="G1",
+                path=path,
+                line=line,
+                message=f"[{entry.name}] per-shard-divergent gather/scatter: "
+                "indices derived from this multi-axis-partitioned "
+                f"point-gather index across sharded dim(s) {axes}{where} — "
+                "the GSPMD divergence shape bisected by "
+                f"{XFAIL_TEST}",
+                hint="make the selection shard-invariant (replicated cursor) "
+                "or resolve the record read through a single-axis layout; "
+                "until the fix lands the site carries a justified pragma",
+                source_line=_source_line(root, path, line),
+            )
+        )
+
+    # ---------------------------------------------------------------- G2
+    crossing = [
+        e for e in events if e.kind in ("gather", "scatter", "sort") and e.crossed
+    ]
+    total = sum(e.nbytes for e in crossing)
+    if total > entry.hbm_budget:
+        top = sorted(crossing, key=lambda e: -e.nbytes)[:3]
+        sites = ", ".join(
+            f"{e.path}:{e.line} ({e.nbytes}B {e.kind})" for e in top
+        )
+        findings.append(
+            Finding(
+                rule="G2",
+                path=entry.path,
+                line=entry.line,
+                message=f"[{entry.name}] cross-shard materialization "
+                f"estimate {total}B exceeds the entry HBM budget "
+                f"{entry.hbm_budget}B (top sites: {sites})",
+                hint="reshard the hot operand so the gather stays local, or "
+                "raise the entry's hbm_budget deliberately in "
+                "tools/lint/shardflow/entries.py",
+                source_line=_source_line(root, entry.path, entry.line),
+            )
+        )
+
+    # ---------------------------------------------------------------- G3
+    for e in sorted(
+        (e for e in events if e.kind == "reduce" and e.hazard),
+        key=lambda e: (e.path, e.line),
+    ):
+        findings.append(
+            Finding(
+                rule="G3",
+                path=e.path,
+                line=e.line,
+                message=f"[{entry.name}] partial-sum hazard: {e.hazard}",
+                hint="keep the reduced dim's sharding trackable (avoid "
+                "conflicting joins feeding a reduction) or reduce over "
+                "every dim the mesh axis shards",
+                source_line=_source_line(root, e.path, e.line),
+            )
+        )
+    return findings

@@ -37,8 +37,8 @@ import sys
 from dataclasses import dataclass, field
 from pathlib import Path
 
-from tools.lint.model import Finding, is_advisory_path
-from tools.lint.pragmas import parse_pragmas, suppressed_lines
+from tools.lint.model import Finding
+from tools.lint.pragmas import filter_findings
 
 __all__ = [
     "run_spmd",
@@ -92,40 +92,6 @@ class SpmdResult:
         return [f for f in self.findings if not f.advisory and not f.baselined]
 
 
-def _filter_findings(
-    findings: list[Finding],
-    root: Path,
-    disable: tuple[str, ...],
-    select: tuple[str, ...] | None,
-) -> list[Finding]:
-    pragma_cache: dict[str, dict[int, frozenset[str]]] = {}
-
-    def suppressed(f: Finding) -> bool:
-        if f.path not in pragma_cache:
-            full = root / f.path
-            try:
-                source = full.read_text()
-            except OSError:
-                pragma_cache[f.path] = {}
-            else:
-                pragmas, _ = parse_pragmas(source, f.path)
-                pragma_cache[f.path] = suppressed_lines(pragmas, source)
-        return f.rule in pragma_cache[f.path].get(f.line, frozenset())
-
-    kept = []
-    for f in findings:
-        if f.rule in disable:
-            continue
-        if select is not None and f.rule not in select:
-            continue
-        if suppressed(f):
-            continue
-        f.advisory = is_advisory_path(f.path)
-        kept.append(f)
-    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
-    return kept
-
-
 def run_spmd(
     *,
     root: str | Path | None = None,
@@ -134,6 +100,7 @@ def run_spmd(
     disable: tuple[str, ...] = (),
     select: tuple[str, ...] | None = None,
     sanitize: bool = False,
+    pragma_used: set | None = None,
 ) -> SpmdResult:
     """Run the SPMD tier. Pure besides reading the census golden — writing
     an updated census is the caller's move (mirrors run_semantic).
@@ -144,6 +111,8 @@ def run_spmd(
       sanitize: also EXECUTE each registered donated entry twice (donating
         and non-donating compiles) and gate on any bitwise difference —
         the runtime leg of S3. Costs real compiles; off by default.
+      pragma_used: optional shared set recording pragma-suppression hits
+        as ``(path, line, rule)`` for stale-pragma (P1) reconciliation.
     """
     from tools.lint.semantic import jax_unavailable_reason
 
@@ -217,5 +186,7 @@ def run_spmd(
         result.findings.extend(drift)
         result.diff = diff
 
-    result.findings = _filter_findings(result.findings, root, disable, select)
+    result.findings = filter_findings(
+        result.findings, root, disable, select, used=pragma_used
+    )
     return result

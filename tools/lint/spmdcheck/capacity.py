@@ -23,7 +23,8 @@ that invariant into a static gate:
 from __future__ import annotations
 
 from tools.lint.model import Finding
-from tools.lint.spmdcheck.replication import _walk, shard_map_eqns
+from tools.lint.lattice import walk as _walk
+from tools.lint.spmdcheck.replication import shard_map_eqns
 
 #: Where the capacity logic lives — config findings anchor here.
 _SPMD_PATH = "scalecube_cluster_tpu/parallel/spmd.py"

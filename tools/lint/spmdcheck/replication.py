@@ -8,8 +8,10 @@ counter partial, a per-shard value leaking into a "global" merge — would
 ship whichever shard XLA happens to read.
 
 This module rebuilds that guarantee statically as a varying-set abstract
-interpretation over the shard_map body jaxpr. Each variable maps to the
-set of mesh axes its value may VARY over:
+interpretation over the shard_map body jaxpr, driven by the shared
+fixpoint core (tools/lint/lattice.py — the same machinery tier 4's
+sharding propagation runs in its per-dimension domain). Each variable
+maps to the set of mesh axes its value may VARY over:
 
 - inputs vary over the axes their ``in_names`` shard them on; consts and
   literals are replicated;
@@ -32,6 +34,12 @@ collective names a live mesh axis.
 
 from __future__ import annotations
 
+from tools.lint.lattice import (
+    AbstractInterpreter,
+    closed_parts,
+    param_jaxprs,
+    walk,
+)
 from tools.lint.model import Finding
 
 #: Reduce-to-replicated collectives: result provably equal across `axes`.
@@ -40,12 +48,6 @@ _REDUCING = {"psum", "pmax", "pmin", "all_gather", "all_gather_invariant"}
 _SHUFFLING = {"all_to_all", "ppermute", "pshuffle", "psum_scatter", "pvary"}
 #: Everything S1 counts as a collective call site (axis-liveness check).
 COLLECTIVES = _REDUCING | _SHUFFLING | {"axis_index", "pbroadcast"}
-
-_LOOPS = {"scan", "while", "cond"}
-
-
-def _is_literal(atom) -> bool:
-    return hasattr(atom, "val") and not hasattr(atom, "count")
 
 
 def _axis_names(params) -> tuple:
@@ -63,14 +65,6 @@ def _named_sets(names) -> frozenset:
     return frozenset(ax for axes in names.values() for ax in axes)
 
 
-def _closed_parts(obj):
-    """(raw jaxpr, consts) from either a ClosedJaxpr or a raw Jaxpr."""
-    inner = getattr(obj, "jaxpr", None)
-    if inner is not None and hasattr(obj, "consts"):
-        return inner, obj.consts
-    return obj, ()
-
-
 def _introduced_axes(jaxpr) -> frozenset:
     """Axes any nested primitive could make a value vary over — the
     conservative contribution of a sub-jaxpr we can't map arg-for-arg."""
@@ -83,140 +77,58 @@ def _introduced_axes(jaxpr) -> frozenset:
             if name in _SHUFFLING or name == "axis_index":
                 out.update(_axis_names(eqn.params))
             for v in eqn.params.values():
-                for sub in _param_jaxprs(v):
+                for sub in param_jaxprs(v):
                     stack.append(sub)
     return frozenset(out)
 
 
-def _param_jaxprs(value):
-    """Yield raw jaxprs inside one params value (mirrors semantic.jaxprs)."""
-    if hasattr(value, "eqns"):
-        yield value
-    elif hasattr(value, "jaxpr") and hasattr(value, "consts"):
-        yield value.jaxpr
-    elif isinstance(value, (tuple, list)):
-        for v in value:
-            yield from _param_jaxprs(v)
+class _VaryingSets(AbstractInterpreter):
+    """The varying-set domain: frozensets of mesh axes, join = union."""
+
+    def __init__(self, mesh_axes: frozenset):
+        super().__init__(max_rounds=len(mesh_axes) + 1)
+        self.mesh_axes = mesh_axes
+
+    def join(self, a, b):
+        return a | b
+
+    def literal_value(self, atom):
+        return frozenset()
+
+    def call_fallback(self, eqn, ins, body):
+        union = frozenset().union(*ins) if ins else frozenset()
+        intro = _introduced_axes(body)
+        return [union | intro for _ in eqn.outvars]
+
+    def prim_transfer(self, eqn, ins):
+        name = eqn.primitive.name
+        union = frozenset().union(*ins) if ins else frozenset()
+
+        if name == "axis_index":
+            return [frozenset(_axis_names(eqn.params))]
+        if name in {"psum", "pmax", "pmin"}:
+            # n-ary: operand i maps to output i, each loses the reduced axes.
+            axes = frozenset(_axis_names(eqn.params))
+            return [s - axes for s in ins]
+        if name in _REDUCING:  # all_gather family — single operand
+            axes = frozenset(_axis_names(eqn.params))
+            return [union - axes for _ in eqn.outvars]
+        if name in _SHUFFLING:
+            axes = frozenset(_axis_names(eqn.params))
+            return [union | axes for _ in eqn.outvars]
+        return [union for _ in eqn.outvars]
 
 
 def analyze(jaxpr, in_sets, mesh_axes):
     """Abstract-interpret one (raw) jaxpr; returns the outvars' varying
     sets. ``in_sets`` must match ``jaxpr.invars``."""
-    env: dict = {}
-
-    def read(atom):
-        if _is_literal(atom):
-            return frozenset()
-        return env.get(atom, frozenset())
-
-    def write(var, s):
-        env[var] = s
-
-    for v, s in zip(jaxpr.invars, in_sets):
-        write(v, s)
-    for v in jaxpr.constvars:
-        write(v, frozenset())
-
-    for eqn in jaxpr.eqns:
-        ins = [read(a) for a in eqn.invars]
-        outs = _transfer(eqn, ins, mesh_axes)
-        for v, s in zip(eqn.outvars, outs):
-            write(v, s)
-    return [read(v) for v in jaxpr.outvars]
-
-
-def _transfer(eqn, ins, mesh_axes):
-    name = eqn.primitive.name
-    union = frozenset().union(*ins) if ins else frozenset()
-
-    if name == "axis_index":
-        return [frozenset(_axis_names(eqn.params))]
-    if name in {"psum", "pmax", "pmin"}:
-        # n-ary: operand i maps to output i, each loses the reduced axes.
-        axes = frozenset(_axis_names(eqn.params))
-        return [s - axes for s in ins]
-    if name in _REDUCING:  # all_gather family — single operand
-        axes = frozenset(_axis_names(eqn.params))
-        return [union - axes for _ in eqn.outvars]
-    if name in _SHUFFLING:
-        axes = frozenset(_axis_names(eqn.params))
-        return [union | axes for _ in eqn.outvars]
-    if name == "pbroadcast":
-        return [union for _ in eqn.outvars]
-
-    if name == "scan":
-        body, _ = _closed_parts(eqn.params["jaxpr"])
-        nc = eqn.params["num_consts"]
-        ncar = eqn.params["num_carry"]
-        consts, carry, xs = ins[:nc], list(ins[nc : nc + ncar]), ins[nc + ncar :]
-        body_outs = None
-        for _ in range(len(mesh_axes) + 1):
-            body_outs = analyze(body, consts + carry + xs, mesh_axes)
-            new_carry = [c | b for c, b in zip(carry, body_outs[:ncar])]
-            if new_carry == carry:
-                break
-            carry = new_carry
-        return carry + body_outs[ncar:]
-
-    if name == "while":
-        cond, _ = _closed_parts(eqn.params["cond_jaxpr"])
-        body, _ = _closed_parts(eqn.params["body_jaxpr"])
-        cn = eqn.params["cond_nconsts"]
-        bn = eqn.params["body_nconsts"]
-        cconsts, bconsts = ins[:cn], ins[cn : cn + bn]
-        carry = list(ins[cn + bn :])
-        pred = frozenset()
-        for _ in range(len(mesh_axes) + 1):
-            pred = analyze(cond, cconsts + carry, mesh_axes)[0]
-            body_outs = analyze(body, bconsts + carry, mesh_axes)
-            new_carry = [c | b for c, b in zip(carry, body_outs)]
-            if new_carry == carry:
-                break
-            carry = new_carry
-        # A shard-varying predicate means per-shard trip counts: every
-        # carry leaf may then differ across those axes.
-        return [c | pred for c in carry]
-
-    if name == "cond":
-        pred, ops = ins[0], ins[1:]
-        out_sets = None
-        for br in eqn.params["branches"]:
-            body, _ = _closed_parts(br)
-            outs = analyze(body, list(ops), mesh_axes)
-            out_sets = (
-                outs
-                if out_sets is None
-                else [a | b for a, b in zip(out_sets, outs)]
-            )
-        return [s | pred for s in out_sets]
-
-    # Call-like primitives (pjit / closed_call / remat / custom_*): recurse
-    # when the sub-jaxpr maps arg-for-arg; otherwise fall back to the
-    # input union plus every axis the sub-jaxpr could introduce.
-    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
-        if key in eqn.params:
-            body, _ = _closed_parts(eqn.params[key])
-            if len(body.invars) == len(ins):
-                return analyze(body, ins, mesh_axes)
-            intro = _introduced_axes(body)
-            return [union | intro for _ in eqn.outvars]
-
-    return [union for _ in eqn.outvars]
-
-
-def _walk(jaxpr):
-    """Yield every eqn in a raw jaxpr, recursively through params."""
-    for eqn in jaxpr.eqns:
-        yield eqn
-        for v in eqn.params.values():
-            for sub in _param_jaxprs(v):
-                yield from _walk(sub)
+    return _VaryingSets(frozenset(mesh_axes)).run(jaxpr, list(in_sets))
 
 
 def shard_map_eqns(closed):
     """The shard_map eqns anywhere inside a traced ClosedJaxpr."""
-    jaxpr, _ = _closed_parts(closed)
-    return [e for e in _walk(jaxpr) if e.primitive.name == "shard_map"]
+    jaxpr, _ = closed_parts(closed)
+    return [e for e in walk(jaxpr) if e.primitive.name == "shard_map"]
 
 
 def check_s1(entry) -> tuple[list[Finding], int]:
@@ -230,7 +142,7 @@ def check_s1(entry) -> tuple[list[Finding], int]:
         in_names = sm.params["in_names"]
         out_names = sm.params["out_names"]
 
-        for sub in _walk(body):
+        for sub in walk(body):
             prim = sub.primitive.name
             if prim not in COLLECTIVES:
                 continue
