@@ -61,6 +61,11 @@ serve/load.py: a seeded fleet of honest + adversarial loopback-TCP
 producers with churn drives one live session; the ``kind="load"`` row —
 events/s, backpressure pauses, rejections, conservation verdicts — plus
 the probe attempt land in bench_history.jsonl), or ``python bench.py
+--tracer-overhead [n]`` (the flight-recorder cost rung: the same churny
+sparse trajectory run tracer-off and tracer-on; the ``kind="bench_tracer"``
+row carries the on/off wall-time ratio, tracer-on ns_per_member, and the
+events-recorded/overflow accounting, and both the probe attempt and the
+row land in bench_history.jsonl), or ``python bench.py
 --geo [n]`` (the geo-distributed rung, sim/topology.py: the dense engine
 under a 2-zone 400 ms WAN brownout schedule; the ``kind="bench_geo"``
 row reports member·rounds/s, ns_per_member and the flat-world overhead
@@ -640,6 +645,73 @@ def _measure_load(producers: int = 32, n_members: int = 1024) -> dict:
     return res["row"]
 
 
+def _measure_tracer_overhead(
+    n_members: int = 4096, chunk: int = 48, reps: int = 4
+) -> dict:
+    """The ``--tracer-overhead [n]`` rung: the same sparse trajectory run
+    tracer-off and tracer-on (flight recorder armed via ``trace_capacity``),
+    reporting the on/off wall-time ratio next to the tracer-on throughput.
+
+    The timeline carries real churn (kills, a restart, 5% loss) so the
+    recorder's emission paths — probe episodes, suspicions, verdicts —
+    actually fire; a quiet cluster would measure only the ring's fixed
+    per-tick cost. The per-shard recorder in the SPMD engine reuses the
+    exact same emission code on shard-local shapes (parallel/spmd.py §9.5),
+    so this single-device ratio is the per-member cost model for both.
+    """
+    from scalecube_cluster_tpu.obs.trace import ring_overflow
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+        run_sparse_ticks,
+    )
+
+    params = SparseParams.for_n(n_members)
+    sched = (
+        ScheduleBuilder(n_members)
+        .add_segment(0, FaultPlan.uniform(loss_percent=5.0))
+        .kill(3, 7)
+        .kill(5, n_members // 2)
+        .restart(25, 7)
+        .build()
+    )
+    capacity = 1 << 16
+
+    def timed(trace_capacity: int):
+        state = init_sparse_full_view(
+            n_members, params.slot_budget, trace_capacity=trace_capacity
+        )
+        # Warmup: compile + steady state, same discipline as the other rungs.
+        state, _ = run_sparse_ticks(params, state, sched, chunk, collect=False)
+        int(state.view_T[0, 0])
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            state, _ = run_sparse_ticks(
+                params, state, sched, chunk, collect=False
+            )
+            int(state.view_T[0, 0])
+        return time.perf_counter() - t0, state
+
+    dt_off, _ = timed(0)
+    dt_on, traced = timed(capacity)
+    value = n_members * (reps * chunk / dt_on)
+    return {
+        "metric": "member_gossip_rounds_per_sec",
+        "value": round(value, 1),
+        "unit": "member·rounds/s",
+        "vs_baseline": round(value / BASELINE_MEMBER_ROUNDS_PER_SEC, 3),
+        "ns_per_member": _ns_per_member(value),
+        "tracer_overhead": round(dt_on / dt_off, 4),
+        "trace_capacity": capacity,
+        "events_recorded": int(traced.trace.cursor),
+        "trace_overflow": int(ring_overflow(traced.trace)),
+        "n_members": n_members,
+        "engine": "sparse-traced",
+    }
+
+
 def _measure(engine: str, n_members: int, slot_budget: int | None = None) -> dict:
     """Run one benchmark config in-process and return the result dict."""
     if engine in ("sparse", "sparse-pallas"):
@@ -1190,6 +1262,61 @@ if __name__ == "__main__":
                         )
                         if k in row
                     },
+                },
+            )
+        try:
+            append_jsonl(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "artifacts",
+                    "bench_history.jsonl",
+                ),
+                [row],
+            )
+        except Exception:
+            pass
+        print(jsonl_line(row), flush=True)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--tracer-overhead":
+        try:
+            from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+            enable_repo_jax_cache()
+        except Exception:
+            pass
+        from scalecube_cluster_tpu.obs.export import (
+            append_jsonl,
+            jsonl_line,
+            make_row,
+            run_metadata,
+        )
+
+        n_arg = int(sys.argv[2]) if len(sys.argv) > 2 else 4096
+        # One recorded backend probe first (same discipline as --shard-map:
+        # outage budget must leave evidence in bench_history.jsonl).
+        t_probe = time.monotonic()
+        probe_err = _probe_once()
+        _record_probe_attempt(1, probe_err, time.monotonic() - t_probe)
+        if probe_err is not None:
+            row = make_row(
+                "bench_tracer",
+                {"error": probe_err, "n_members": n_arg, **_self_evidence()},
+                run_metadata(seed=0),
+            )
+        else:
+            out = _measure_tracer_overhead(n_arg)
+            row = make_row("bench_tracer", out, run_metadata(seed=0))
+            # The probe history is the long-lived per-round record: the
+            # recorder's cost trend belongs in the same timeline as outages
+            # and throughput, so a tracer regression reads off one file.
+            _record_probe_attempt(
+                2,
+                None,
+                time.monotonic() - t_probe,
+                extra={
+                    "scenario": "tracer_overhead",
+                    "n_members": n_arg,
+                    "tracer_overhead": out["tracer_overhead"],
+                    "ns_per_member": out["ns_per_member"],
                 },
             )
         try:

@@ -107,8 +107,150 @@ def ring_events(ring) -> list[dict]:
 
 def ring_overflow(ring) -> int:
     """Events the bounded ring could not record (lossless accounting:
-    emitted == recorded + overflow, the SHARED_COUNTERS discipline)."""
-    return int(np.asarray(ring.overflow))
+    emitted == recorded + overflow, the SHARED_COUNTERS discipline).
+    Sums over shards for a sharded recorder."""
+    return int(np.asarray(ring.overflow).sum())
+
+
+# ------------------------------------------------------- sharded-ring merge
+#: Within-tick causal emission order of the sparse engine's event kinds —
+#: the phase a kind is emitted in during one tick (apply-events first, then
+#: FD, SYNC, the verdict sweep, and finally user-gossip infection edges).
+#: The merge sorts by (tick, phase, shard, local position): phase ordering
+#: is what keeps rewritten cause refs strictly backwards across shards, and
+#: because single-device emission follows the same order within a tick, a
+#: d=1 merge is the identity permutation (bit-parity for free). Kinds not
+#: in the table (Rapid chain kinds — never emitted by the sharded engine)
+#: sort after everything in their tick, preserving local order.
+_PHASE_INJECTED_GOSSIP = 2
+
+
+def _event_phase(kind: int, aux: int) -> int:
+    if kind == TK_GOSSIP_EDGE:
+        return _PHASE_INJECTED_GOSSIP if aux == 1 else 9
+    return {
+        TK_KILL: 0,
+        TK_RESTART: 1,
+        TK_PROBE_SENT: 3,
+        TK_PROBE_MISSED: 4,
+        TK_SUSPECT_START: 5,
+        TK_SYNC_ACCEPT: 6,
+        TK_VERDICT_DEAD: 7,
+        TK_VERDICT_ALIVE: 8,
+    }.get(kind, 10)
+
+
+def _shard_ring_events(ring) -> list[dict]:
+    """Decode every shard of a ShardTraceRing into per-shard event dicts
+    (``i`` = shard-LOCAL ring position, plus a ``shard`` column)."""
+    cursors = np.asarray(ring.cursor)
+    names = ("ev_kind", "ev_tick", "ev_actor", "ev_subject",
+             "ev_cause", "ev_aux")
+    fields = {name: np.asarray(getattr(ring, name)) for name in names}
+    out = []
+    for s in range(int(cursors.shape[0])):
+        for i in range(int(cursors[s])):
+            kind = int(fields["ev_kind"][s, i])
+            out.append(
+                {
+                    "i": i,
+                    "shard": s,
+                    "tick": int(fields["ev_tick"][s, i]),
+                    "kind": kind,
+                    "kind_name": TK_NAMES.get(kind, f"kind_{kind}"),
+                    "actor": int(fields["ev_actor"][s, i]),
+                    "subject": int(fields["ev_subject"][s, i]),
+                    "cause": int(fields["ev_cause"][s, i]),
+                    "aux": int(fields["ev_aux"][s, i]),
+                }
+            )
+    return out
+
+
+def merge_shard_rings(ring) -> list[dict]:
+    """Deterministically merge a sharded flight recorder
+    (obs/tracer.py::ShardTraceRing) into ONE globally causally-ordered
+    event log.
+
+    Events sort by ``(tick, phase, shard, local position)`` (stable), get
+    renumbered ``i`` = merged position, and every intra-shard ``cause`` is
+    rewritten to the cause event's merged position. Verdicts whose origin
+    was recorded on a DIFFERENT shard carry ``cause == -1`` on device (the
+    shard-local origin register never saw the suspicion); a final relink
+    pass replays the origin register over the merged order — SUSPECT_START
+    sets it, RESTART clears it, an intra-shard verdict cause republishes
+    it, and the latest PROBE_SENT about the subject is the direct-probe
+    fallback — and rewires exactly those cross-shard verdicts. Same-shard
+    ``-1`` causes are left alone, so a d=1 merge is bit-equal to the
+    single-device ring's decode (modulo the added ``shard`` column).
+
+    A plain single-device :class:`TraceRing` passes through unchanged
+    (``shard`` = 0 added) — callers can hand either recorder over.
+    """
+    if np.asarray(ring.cursor).ndim == 0:  # plain single-device ring
+        out = ring_events(ring)
+        for ev in out:
+            ev["shard"] = 0
+        return out
+
+    events = _shard_ring_events(ring)
+    events.sort(
+        key=lambda e: (e["tick"], _event_phase(e["kind"], e["aux"]),
+                       e["shard"], e["i"])
+    )
+    pos_map = {(e["shard"], e["i"]): m for m, e in enumerate(events)}
+    merged = []
+    for m, e in enumerate(events):
+        ev = dict(e)
+        ev["i"] = m
+        if ev["cause"] >= 0:
+            ev["cause"] = pos_map.get((ev["shard"], ev["cause"]), -1)
+        merged.append(ev)
+
+    # Relink pass: host replay of the per-subject origin register, global.
+    origin_reg: dict[int, tuple[int, int]] = {}  # subject -> (merged i, shard)
+    last_sent: dict[int, tuple[int, int]] = {}
+    for ev in merged:
+        kind, subj = ev["kind"], ev["subject"]
+        if kind == TK_RESTART:
+            origin_reg.pop(subj, None)
+            last_sent.pop(subj, None)
+        elif kind == TK_PROBE_SENT:
+            last_sent[subj] = (ev["i"], ev["shard"])
+        elif kind == TK_SUSPECT_START:
+            origin_reg[subj] = (ev["i"], ev["shard"])
+        elif kind == TK_VERDICT_DEAD:
+            if ev["cause"] >= 0:
+                # Intra-shard verdicts republish their shard's register
+                # (covers the direct epoch-mismatch probe origin, which
+                # never emits a SUSPECT_START).
+                cause_ev = merged[ev["cause"]]
+                origin_reg[subj] = (ev["cause"], cause_ev["shard"])
+            else:
+                hit = origin_reg.get(subj) or last_sent.get(subj)
+                if hit is not None and hit[1] != ev["shard"]:
+                    ev["cause"] = hit[0]
+    return merged
+
+
+def trace_occupancy(ring) -> list[dict]:
+    """Per-shard ring pressure gauges: one row per shard with ``cursor``
+    (events recorded), ``capacity`` and ``overflow``. Duck-typed over both
+    recorders — a plain TraceRing reports as shard 0."""
+    cursors = np.asarray(ring.cursor)
+    overflows = np.asarray(ring.overflow)
+    cap = int(ring.capacity)
+    if cursors.ndim == 0:
+        cursors, overflows = cursors[None], overflows[None]
+    return [
+        {
+            "shard": s,
+            "cursor": int(cursors[s]),
+            "capacity": cap,
+            "overflow": int(overflows[s]),
+        }
+        for s in range(int(cursors.shape[0]))
+    ]
 
 
 def write_events_jsonl(path: str, events: list[dict]) -> None:
@@ -190,7 +332,23 @@ def chrome_trace(
         {"ph": "M", "pid": 2, "name": "process_name",
          "args": {"name": "host transport"}},
     ]
+    shard_tracks = sorted(
+        {ev["shard"] for ev in events or [] if "shard" in ev}
+    )
+    for s in shard_tracks:
+        out.append(
+            {"ph": "M", "pid": 0, "tid": s, "name": "thread_name",
+             "args": {"name": f"shard {s}"}}
+        )
     for ev in events or []:
+        # Merged multi-shard logs get one track per RECORDING shard (the
+        # satellite contract for tools/trace_explain.py --chrome); plain
+        # single-device decodes keep the original one-track-per-actor view.
+        tid = ev["shard"] if "shard" in ev else max(ev["actor"], 0)
+        args = {k: ev[k] for k in
+                ("i", "tick", "actor", "subject", "cause", "aux")}
+        if "shard" in ev:
+            args["shard"] = ev["shard"]
         out.append(
             {
                 "name": ev.get("kind_name", TK_NAMES.get(ev["kind"], "event")),
@@ -198,9 +356,8 @@ def chrome_trace(
                 "s": "t",
                 "ts": ev["tick"] * tick_us,
                 "pid": 0,
-                "tid": max(ev["actor"], 0),
-                "args": {k: ev[k] for k in
-                         ("i", "tick", "actor", "subject", "cause", "aux")},
+                "tid": tid,
+                "args": args,
             }
         )
     host_t0 = [s["t0"] for s in (launch_spans or [])] + [
@@ -223,6 +380,22 @@ def chrome_trace(
                 },
             }
         )
+        # Per-shard trace-ring pressure rides the launch timeline as
+        # Perfetto counter tracks (one gauge per shard) when the serving
+        # state carries a flight recorder (serve/bridge.py stamps
+        # ``ring_occupancy`` from obs/trace.py::trace_occupancy).
+        for occ in sp.get("ring_occupancy") or []:
+            out.append(
+                {
+                    "name": f"trace_ring_occupancy/shard{occ['shard']}",
+                    "ph": "C",
+                    "ts": (sp["t1"] - origin) * 1e6,
+                    "pid": 1,
+                    "tid": 0,
+                    "args": {"events": occ["cursor"],
+                             "overflow": occ.get("overflow", 0)},
+                }
+            )
     for sp in message_spans or []:
         out.append(
             {

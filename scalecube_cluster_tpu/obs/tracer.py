@@ -95,6 +95,102 @@ def init_trace_ring(n: int, capacity: int) -> TraceRing:
     )
 
 
+@register_dataclass
+@dataclass
+class ShardTraceRing:
+    """Per-shard flight recorder for the explicit-SPMD engine: ``d``
+    independent :class:`TraceRing`\\ s stacked on a leading shard axis.
+
+    Every leaf carries the shard axis so the whole structure shards over
+    the member mesh axis with one `P(AXIS, ...)` spec — inside shard_map
+    each shard sees the ``[1, ...]`` slice of ITS ring, squeezes it into a
+    plain :class:`TraceRing` (:func:`shard_local_ring`), runs the
+    unchanged single-device emission code, and re-stacks. Cursors are
+    shard-LOCAL (no collective touches the recorder), which is exactly
+    what keeps the tier-3 S2/S4 exchange pins intact; the host merge
+    (obs/trace.py::merge_shard_rings) rebuilds the one global log.
+    """
+
+    ev_kind: jax.Array  # [d, R]
+    ev_tick: jax.Array  # [d, R]
+    ev_actor: jax.Array  # [d, R]
+    ev_subject: jax.Array  # [d, R]
+    ev_cause: jax.Array  # [d, R] shard-LOCAL ring position, -1 = root
+    ev_aux: jax.Array  # [d, R]
+    cursor: jax.Array  # [d] per-shard append cursor
+    overflow: jax.Array  # [d] per-shard lossless overflow count
+    last_miss: jax.Array  # [d, N] per-shard causal register
+    origin: jax.Array  # [d, N] per-shard causal register
+
+    def replace(self, **changes) -> "ShardTraceRing":
+        return dataclasses.replace(self, **changes)
+
+    @property
+    def capacity(self) -> int:
+        return int(self.ev_kind.shape[1])
+
+    @property
+    def shards(self) -> int:
+        return int(self.ev_kind.shape[0])
+
+
+def init_shard_trace_rings(n: int, capacity: int, d: int) -> ShardTraceRing:
+    """``d`` empty per-shard rings for an ``n``-member cluster. Capacity is
+    PER SHARD (total recordable events = d * capacity)."""
+    if capacity < 1:
+        raise ValueError("trace ring capacity must be >= 1")
+    if d < 1:
+        raise ValueError("shard trace ring needs d >= 1 shards")
+    full = lambda v: jnp.full((d, capacity), v, jnp.int32)  # noqa: E731
+    return ShardTraceRing(
+        ev_kind=full(0),
+        ev_tick=full(-1),
+        ev_actor=full(-1),
+        ev_subject=full(-1),
+        ev_cause=full(-1),
+        ev_aux=full(0),
+        cursor=jnp.zeros((d,), jnp.int32),
+        overflow=jnp.zeros((d,), jnp.int32),
+        last_miss=jnp.full((d, n), -1, jnp.int32),
+        origin=jnp.full((d, n), -1, jnp.int32),
+    )
+
+
+def shard_local_ring(rings: ShardTraceRing) -> TraceRing:
+    """Inside shard_map: squeeze this shard's ``[1, ...]`` slice into a
+    plain :class:`TraceRing` so the single-device emission code runs
+    verbatim (d=1 bit-parity is free — it IS the same program)."""
+    return TraceRing(
+        ev_kind=rings.ev_kind[0],
+        ev_tick=rings.ev_tick[0],
+        ev_actor=rings.ev_actor[0],
+        ev_subject=rings.ev_subject[0],
+        ev_cause=rings.ev_cause[0],
+        ev_aux=rings.ev_aux[0],
+        cursor=rings.cursor[0],
+        overflow=rings.overflow[0],
+        last_miss=rings.last_miss[0],
+        origin=rings.origin[0],
+    )
+
+
+def shard_rewrap_ring(ring: TraceRing) -> ShardTraceRing:
+    """Inverse of :func:`shard_local_ring`: re-expand the leading shard axis
+    so the shard_map carry keeps the ``P(AXIS, ...)`` layout."""
+    return ShardTraceRing(
+        ev_kind=ring.ev_kind[None],
+        ev_tick=ring.ev_tick[None],
+        ev_actor=ring.ev_actor[None],
+        ev_subject=ring.ev_subject[None],
+        ev_cause=ring.ev_cause[None],
+        ev_aux=ring.ev_aux[None],
+        cursor=ring.cursor[None],
+        overflow=ring.overflow[None],
+        last_miss=ring.last_miss[None],
+        origin=ring.origin[None],
+    )
+
+
 def trace_emit(ring: TraceRing, kind: int, mask, tick, actor, subject,
                cause=-1, aux=0):
     """Append one event per True element of ``mask`` (any shape).
@@ -111,7 +207,7 @@ def trace_emit(ring: TraceRing, kind: int, mask, tick, actor, subject,
     size = int(flat.shape[0])
     R = ring.ev_kind.shape[0]
     cap = min(size, R)
-    idx = jnp.flatnonzero(flat, size=cap, fill_value=-1)
+    idx = jnp.flatnonzero(flat, size=cap, fill_value=-1)  # tpulint: disable=G3 -- reshape(-1) collapses the mask's member sharding to Unknown for the propagation analysis; under GSPMD the partitioner materializes the gather globally (replicated ring), and the explicit-SPMD twin calls this on shard-LOCAL masks where the compaction is local by construction
     valid = idx >= 0
     safe = jnp.where(valid, idx, 0)
     pos = ring.cursor + jnp.arange(cap, dtype=jnp.int32)
@@ -122,7 +218,7 @@ def trace_emit(ring: TraceRing, kind: int, mask, tick, actor, subject,
         b = jnp.broadcast_to(jnp.asarray(x, jnp.int32), mask.shape)
         return b.reshape(-1)[safe]
 
-    total = jnp.sum(flat, dtype=jnp.int32)
+    total = jnp.sum(flat, dtype=jnp.int32)  # tpulint: disable=G3 -- overflow accounting is logically GLOBAL under GSPMD (partitioner inserts the all-reduce over the replicated ring's counter) and shard-LOCAL by design in the explicit-SPMD twin, where the mask is already the shard's slice
     recorded = jnp.sum(rec, dtype=jnp.int32)
     ring = ring.replace(
         ev_kind=ring.ev_kind.at[route].set(kind, mode="drop"),

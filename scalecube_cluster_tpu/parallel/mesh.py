@@ -220,10 +220,38 @@ def sparse_state_pspecs(like=None, two_d: bool = False, prefix: tuple = ()):
     passes ``(UNIVERSE_AXIS,)`` to stack a universe axis in front of each
     leaf's member layout.
     """
+    from scalecube_cluster_tpu.obs.tracer import ShardTraceRing, TraceRing
     from scalecube_cluster_tpu.sim.sparse import SparseState
 
     def mk(*axes):
         return P(*prefix, *axes)
+
+    def trace_specs():
+        """Flight-recorder layout. A ShardTraceRing (the explicit-SPMD
+        engine's per-shard recorder) shards its leading shard axis across
+        ``members`` — each device owns exactly ITS ring. A plain TraceRing
+        (GSPMD engines) replicates: the append cursor is a global, so the
+        partitioner must keep every leaf whole."""
+        if like is None or like.trace is None:
+            return None
+        if isinstance(like.trace, ShardTraceRing):  # tpulint: disable=R1 -- trace-time constant (isinstance on the trace field's pytree type), not a traced value
+            return ShardTraceRing(
+                ev_kind=mk(AXIS, None),
+                ev_tick=mk(AXIS, None),
+                ev_actor=mk(AXIS, None),
+                ev_subject=mk(AXIS, None),
+                ev_cause=mk(AXIS, None),
+                ev_aux=mk(AXIS, None),
+                cursor=mk(AXIS),
+                overflow=mk(AXIS),
+                last_miss=mk(AXIS, None),
+                origin=mk(AXIS, None),
+            )
+        return TraceRing(
+            ev_kind=rep, ev_tick=rep, ev_actor=rep, ev_subject=rep,
+            ev_cause=rep, ev_aux=rep, cursor=rep, overflow=rep,
+            last_miss=rep, origin=rep,
+        )
 
     # view_T [subj, viewer]
     row = mk(SUBJECT_AXIS, AXIS) if two_d else mk(None, AXIS)
@@ -262,6 +290,7 @@ def sparse_state_pspecs(like=None, two_d: bool = False, prefix: tuple = ()):
         wb_valid=(
             rep if like is not None and like.wb_valid is not None else None
         ),
+        trace=trace_specs(),
     )
 
 

@@ -78,6 +78,23 @@ from scalecube_cluster_tpu.ops.merge import (
     is_suspect_key,
     merge_views,
 )
+from scalecube_cluster_tpu.obs.trace import DEAD_VIA_EXPIRY, DEAD_VIA_GOSSIP
+from scalecube_cluster_tpu.obs.tracer import (
+    ShardTraceRing,
+    TK_GOSSIP_EDGE,
+    TK_KILL,
+    TK_PROBE_MISSED,
+    TK_PROBE_SENT,
+    TK_RESTART,
+    TK_SUSPECT_START,
+    TK_SYNC_ACCEPT,
+    TK_VERDICT_ALIVE,
+    TK_VERDICT_DEAD,
+    shard_local_ring,
+    shard_rewrap_ring,
+    trace_emit,
+    trace_reset_members,
+)
 from scalecube_cluster_tpu.parallel.mesh import AXIS, UNIVERSE_AXIS, sparse_state_pspecs
 from scalecube_cluster_tpu.sim.faults import FaultPlan, edge_blocked, link_pass_from
 from scalecube_cluster_tpu.sim.knobs import Knobs, edge_live, suspicion_fill
@@ -219,18 +236,26 @@ def exchange_payload_bytes_per_tick(
     }
 
 
-def _apply_events_local(params, st, kill_mask, restart_mask, cut):
+def _apply_events_local(params, st, kill_mask, restart_mask, cut,
+                        col=None, ring=None):
     """sim/sparse.py::apply_events_sparse on one shard's rows.
 
     ``kill_mask``/``restart_mask`` arrive replicated [N]; row-indexed state
     uses the shard's slice (``cut``), while the suppression-ring scrub
     indexes the GLOBAL mask with the ring's global member ids — the exact
     computation the oracle runs, restricted to local rows.
+
+    ``ring`` (a plain per-shard TraceRing view, see _tick_spmd) threads the
+    flight recorder: each shard records the kill/restart events of ITS OWN
+    members (subjects ``col``) so the union over shards is the oracle's
+    full emission, while the causal-register reset consumes the FULL
+    restart mask (a shard's registers reference arbitrary global
+    subjects). Returns ``(state, ring)`` when tracing, else the state.
     """
     n = params.base.n
     any_ev = jnp.any(kill_mask | restart_mask)
 
-    def apply(st):
+    def apply_state(st):
         km, rm = cut(kill_mask), cut(restart_mask)
         new_epoch = jnp.where(
             rm, jnp.minimum(st.epoch + 1, EPOCH_MAX), st.epoch
@@ -259,7 +284,25 @@ def _apply_events_local(params, st, kill_mask, restart_mask, cut):
             st = st.replace(wb_valid=jnp.zeros((), bool))
         return st
 
-    return lax.cond(any_ev, apply, lambda s: s, st)
+    if ring is not None:
+
+        def apply_tr(args):
+            st, rg = args
+            st = apply_state(st)
+            # Control-plane events land in the ring BEFORE anything the
+            # tick body emits at this tick (same emission point as the
+            # oracle's apply_events_sparse), restricted to MY members.
+            t_ev = st.tick + 1
+            rg, _ = trace_emit(rg, TK_KILL, cut(kill_mask), t_ev, -1, col)
+            rg, _ = trace_emit(
+                rg, TK_RESTART, cut(restart_mask), t_ev, -1, col
+            )
+            rg = trace_reset_members(rg, restart_mask)
+            return st, rg
+
+        return lax.cond(any_ev, apply_tr, lambda a: a, (st, ring))
+
+    return lax.cond(any_ev, apply_state, lambda s: s, st)
 
 
 def _free_plan_spmd(params, st, col, gate):
@@ -333,8 +376,25 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
     def cut(a):
         return lax.dynamic_slice_in_dim(a, lo, nl, axis=0)
 
+    # Flight recorder (structure-gated like the latency recorder): each
+    # shard squeezes ITS ring out of the ShardTraceRing carry and runs the
+    # oracle's emission code verbatim on local-row masks — positions are
+    # shard-local, no collective ever touches the recorder, and the host
+    # merge (obs/trace.py::merge_shard_rings) rebuilds the global log.
+    tracing = state.trace is not None  # static: pytree structure
+    ring = shard_local_ring(state.trace) if tracing else None
+    if tracing:
+        state = state.replace(trace=None)
+
     if events is not None:
-        state = _apply_events_local(params, state, events[0], events[1], cut)
+        if tracing:
+            state, ring = _apply_events_local(
+                params, state, events[0], events[1], cut, col=col, ring=ring
+            )
+        else:
+            state = _apply_events_local(
+                params, state, events[0], events[1], cut
+            )
         restart_m = events[1]
     t = state.tick + 1
     (rng_next, k_tgt, k_ping, k_relay, k_gsel, k_glink, k_ssel, k_slink) = (
@@ -363,10 +423,12 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
             p, plan, t, k_tgt, k_ping, k_relay, n,
             lrow=lrow, col=col, cut=cut, record_of=my_record_of,
             v_alive=alive, alive_all=alive_all, epoch_all=epoch_all,
-            collect=collect,
+            collect=collect, trace=tracing,
         )
 
-    fd_out = lax.cond(do_fd, fd_fire_phase, lambda _: _fd_zeros(nl, collect), None)
+    fd_out = lax.cond(
+        do_fd, fd_fire_phase, lambda _: _fd_zeros(nl, collect, tracing), None
+    )
     fd_tgt, fd_key, fd_fire, msgs_fd = fd_out[:4]
 
     # ------------------------------------- 2. own-record SYNC
@@ -856,6 +918,82 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         lat_s = jnp.where(first_s, t, lat_s)
         lat_d = jnp.where(first_d, t, lat_d)
 
+    # --------------------- 9.5 causal flight recorder (structure-gated)
+    # The oracle's emission sequence (sim/sparse.py §9.5) on LOCAL rows:
+    # same kinds, same within-tick order, global member ids as actors/
+    # subjects, shard-local ring positions. Cross-shard events (SYNC
+    # accepts) record the RECEIVING shard's view with the sender's shard in
+    # ``aux`` (sy_subj // nl — 0 at d=1, so the single-shard ring stays
+    # bit-identical to the oracle's). Verdicts whose suspicion originated
+    # on another shard stamp cause=-1 here (the local origin register never
+    # saw it) — merge_shard_rings relinks them from the merged order.
+    # Requires the XLA tick core (``expired``): the scan drivers reject
+    # tracing + pallas_core.
+    if tracing:
+        probing_tr, missed_tr, gone_tr = fd_out[-3:]
+        ring, sent_pos = trace_emit(
+            ring, TK_PROBE_SENT, probing_tr, t, col, fd_tgt
+        )
+        ring, miss_pos = trace_emit(
+            ring, TK_PROBE_MISSED, missed_tr, t, col, fd_tgt, cause=sent_pos
+        )
+        ring = ring.replace(
+            last_miss=ring.last_miss.at[
+                jnp.where(miss_pos >= 0, fd_tgt, n)
+            ].max(miss_pos, mode="drop")
+        )
+        ring, susp_pos = trace_emit(
+            ring, TK_SUSPECT_START, fd_fire & ~gone_tr, t, col, fd_tgt,
+            cause=miss_pos,
+        )
+        origin = ring.origin.at[jnp.where(susp_pos >= 0, fd_tgt, n)].max(
+            susp_pos, mode="drop"
+        )
+        gone_fire = fd_fire & gone_tr & (sent_pos >= 0)
+        origin = origin.at[jnp.where(gone_fire, fd_tgt, n)].max(
+            sent_pos, mode="drop"
+        )
+        ring = ring.replace(origin=origin)
+        ring, _ = trace_emit(
+            ring, TK_SYNC_ACCEPT, sy_accept, t, col, sy_subj,
+            aux=sy_subj // nl,
+        )
+        viewer_live_tr = alive[:, None] & active[None, :]
+        was_dead_tr = ((slab0 & DEAD_BIT) != 0) & (slab0 >= 0)
+        now_dead_tr = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
+        subj_mat = jnp.broadcast_to(slot_subj[None, :], (nl, S))
+        cause_mat = ring.origin[jnp.clip(subj_mat, 0, n - 1)]
+        ring, _ = trace_emit(
+            ring,
+            TK_VERDICT_DEAD,
+            now_dead_tr & ~was_dead_tr & viewer_live_tr,
+            t,
+            col[:, None],
+            subj_mat,
+            cause=cause_mat,
+            aux=jnp.where(expired, DEAD_VIA_EXPIRY, DEAD_VIA_GOSSIP),
+        )
+        ring, _ = trace_emit(
+            ring,
+            TK_VERDICT_ALIVE,
+            is_alive_key(slab2)
+            & ~is_alive_key(slab0)
+            & (slab0 >= 0)
+            & viewer_live_tr,
+            t,
+            col[:, None],
+            subj_mat,
+            cause=cause_mat,
+        )
+        ring, _ = trace_emit(
+            ring,
+            TK_GOSSIP_EDGE,
+            new_seen & ~state.useen,
+            t,
+            col[:, None],
+            jnp.arange(G, dtype=jnp.int32)[None, :],
+        )
+
     wb_pinned, wb_valid = state.wb_pinned, state.wb_valid
     if wb_pinned is not None:
         if need_wb:
@@ -888,6 +1026,8 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         wb_pinned=wb_pinned,
         wb_valid=wb_valid,
     )
+    if tracing:
+        new_state = new_state.replace(trace=shard_rewrap_ring(ring))
     if not collect:
         return new_state, {"tick": t}
 
@@ -912,7 +1052,7 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         g_blk = edge_blocked(plan, col, rcv_c[c])
         g_pass = link_pass_from(u_full[c][rcv_c[c]], plan, col, rcv_c[c])
         g_acct = _acct_add(g_acct, _link_acct(g_att_c[c], g_blk, g_pass))
-    acct = _acct_add(fd_out[7:], g_acct, sy_out[7:])
+    acct = _acct_add(fd_out[7:11], g_acct, sy_out[7:11])
     viewer_live = alive[:, None] & active[None, :]
     was_dead = ((slab0 & DEAD_BIT) != 0) & (slab0 >= 0)
     now_dead = ((slab2 & DEAD_BIT) != 0) & (slab2 >= 0)
@@ -946,6 +1086,11 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         "fault_lost": acct[3],
         "exchange_overflow": overflow_part,
     }
+    if tracing:
+        # Per-shard lossless overflow rides the ONE existing counter psum —
+        # a new dict key, not a new collective (the tier-3 S2/S4 exchange
+        # pins stay at exactly 3 exchange rounds).
+        partials["trace_overflow"] = ring.overflow
     summed = lax.psum(partials, AXIS)
     metrics = {
         "tick": t,
@@ -992,6 +1137,10 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         "ingest_backpressure": jnp.zeros((), jnp.int32),
         "serve_batches": jnp.zeros((), jnp.int32),
     }
+    if tracing:
+        # Summed over shards — equals the oracle's single-ring counter at
+        # d=1 and the total recorder pressure at d>1.
+        metrics["trace_overflow"] = summed["trace_overflow"]
     return new_state, metrics
 
 
@@ -1057,11 +1206,26 @@ def scan_sparse_ticks_spmd(
         )
     _validate(params, cfg)
     if state.trace is not None:
-        raise ValueError(
-            "the explicit-SPMD engine does not support the flight recorder "
-            "(state.trace must be None): the ring's append cursor is a "
-            "global sequence that per-shard emission would fork"
-        )
+        if not isinstance(state.trace, ShardTraceRing):  # tpulint: disable=R1 -- trace-time constant (isinstance on the trace field's pytree type), not a traced value
+            raise ValueError(
+                "the explicit-SPMD engine needs the SHARDED flight recorder "
+                "(a single TraceRing's append cursor is a global sequence "
+                "that per-shard emission would fork) — init the state with "
+                f"init_sparse_full_view(..., trace_shards={cfg.d})"
+            )
+        if state.trace.shards != cfg.d:  # tpulint: disable=R1 -- trace-time constant (the ring's static shards field vs the host int d), not a traced value
+            raise ValueError(
+                f"ShardTraceRing carries {state.trace.shards} per-shard "
+                f"rings but the engine runs d={cfg.d} shards — init with "
+                f"trace_shards={cfg.d}"
+            )
+        if params.pallas_core:
+            raise ValueError(
+                "flight-recorder tracing requires the XLA tick core: the "
+                "fused Pallas kernel does not expose the per-cell expiry "
+                "mask the verdict events need (set pallas_core=False or "
+                "drop the trace rings)"
+            )
     scheduled = isinstance(plan, FaultSchedule)
     pspecs = sparse_state_pspecs(like=state)
     body = _scan_body(params, cfg, n_ticks, collect, scheduled)
@@ -1136,9 +1300,9 @@ def run_ensemble_sparse_ticks_spmd(
     _validate(params, cfg)
     if states.trace is not None:
         raise ValueError(
-            "the explicit-SPMD engine does not support the flight recorder "
-            "(state.trace must be None): the ring's append cursor is a "
-            "global sequence that per-shard emission would fork"
+            "the ensemble SPMD twin does not carry the flight recorder yet "
+            "(states.trace must be None) — trace single-universe runs via "
+            "run_sparse_ticks_spmd with init_sparse_full_view(trace_shards=d)"
         )
     scheduled = isinstance(plans, FaultSchedule)
     pspecs = sparse_state_pspecs(like=states, prefix=(UNIVERSE_AXIS,))

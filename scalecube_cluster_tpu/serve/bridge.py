@@ -13,6 +13,13 @@ Every launch emits a ``kind="serve_batch"`` row and the session close a
 ``kind="serve"`` summary row through the schema-versioned exporter
 (obs/export.py), with ingest→verdict SLO latency percentiles from
 obs/latency.py::percentile_summary.
+
+While the session runs, :meth:`ServeBridge.live_metrics` exposes the
+rolling-window view of the same SLO numbers (obs/slo.py) — published over
+the session's transport (``serve/metrics`` polls) and as a Prometheus
+scrape target by serve/telemetry.py. Session and rolling views share one
+tracker, so a live scrape and the close-time summary can never disagree
+about the same launches.
 """
 
 from __future__ import annotations
@@ -25,7 +32,8 @@ import numpy as np
 
 from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS
 from scalecube_cluster_tpu.obs.export import append_jsonl, make_row, run_metadata
-from scalecube_cluster_tpu.obs.latency import percentile_summary
+from scalecube_cluster_tpu.obs.slo import RollingSLOTracker
+from scalecube_cluster_tpu.obs.trace import trace_occupancy
 from scalecube_cluster_tpu.serve.engine import run_serve_batch
 from scalecube_cluster_tpu.serve.ingest import EventBatcher, ServeEvent, TcpEventSource
 from scalecube_cluster_tpu.sim.faults import FaultPlan
@@ -67,6 +75,7 @@ class ServeBridge:
         max_pending: int = 65536,
         low_watermark: int | None = None,
         overflow_policy: str = "defer",
+        slo_window: int = 64,
     ):
         self.params = params
         self.state = state
@@ -102,8 +111,12 @@ class ServeBridge:
         self.serve_batches = 0  # host accounting: a batch is a launch
         self.ticks_run = 0
         self.events_served = 0
-        self._lat_ms: list[float] = []
-        self._exec_s = 0.0
+        # ONE SLO bookkeeper for both the close-time summary and the live
+        # telemetry plane (obs/slo.py): the session view and the rolling
+        # window share a percentile code path, so a live scrape and the
+        # close() summary can never disagree on the same launches.
+        self.slo = RollingSLOTracker(slo_window)
+        self._bp_seen = 0  # backpressure total already attributed to a launch
         self._counter_totals = {k: 0 for k in SHARED_COUNTERS}
         # Live event sources this bridge has pumped from (run_live attaches
         # one per call): their malformed-payload rejections are session
@@ -162,21 +175,27 @@ class ServeBridge:
         t0 = stats.get("oldest_ingest") or stats["t_assemble"]
         lat_ms = (t_done - t0) * 1000.0
         exec_s = t_done - stats["t_assemble"]
-        self._lat_ms.append(lat_ms)
-        self._exec_s += exec_s
+        bp = self.batcher.backpressure_total
+        self.slo.record(
+            lat_ms, stats["n_events"], exec_s, backpressure=bp - self._bp_seen
+        )
+        self._bp_seen = bp
         self.serve_batches += 1
         self.ticks_run += self.batcher.n_ticks
         self.events_served += stats["n_events"]
-        self.spans.append(
-            {
-                "batch": self.serve_batches - 1,
-                "base_tick": int(stats["base_tick"]),
-                "batch_ticks": self.batcher.n_ticks,
-                "n_events": stats["n_events"],
-                "t0": stats["t_assemble"],
-                "t1": t_done,
-            }
-        )
+        span = {
+            "batch": self.serve_batches - 1,
+            "base_tick": int(stats["base_tick"]),
+            "batch_ticks": self.batcher.n_ticks,
+            "n_events": stats["n_events"],
+            "t0": stats["t_assemble"],
+            "t1": t_done,
+        }
+        if self.state.trace is not None:
+            # Per-shard recorder occupancy at launch close — chrome_trace
+            # renders these as Perfetto counter tracks alongside the spans.
+            span["ring_occupancy"] = trace_occupancy(self.state.trace)
+        self.spans.append(span)
         payload = {
             "batch": self.serve_batches - 1,
             "base_tick": int(stats["base_tick"]),
@@ -318,10 +337,41 @@ class ServeBridge:
         totals["ingest_backpressure"] = self.batcher.backpressure_total
         return totals
 
+    def live_metrics(self) -> dict:
+        """The ``kind="serve_live"`` row: rolling-window SLO + queue state.
+
+        This is what the telemetry plane publishes while the session runs —
+        the ``serve/metrics`` transport responder returns it verbatim and
+        the Prometheus endpoint renders it as gauges (serve/telemetry.py).
+        Window math lives in obs/slo.py; the close-time summary reads the
+        same tracker, so live and final numbers share one code path.
+        """
+        roll = self.slo.rolling()
+        lat = roll["latency"]
+        payload = {
+            "batches": self.serve_batches,
+            "window": roll["window"],
+            "window_launches": roll["launches"],
+            "window_events": roll["events"],
+            "events_per_sec": roll["events_per_sec"],
+            "backpressure": roll["backpressure"],
+            "events_pending": len(self.batcher),
+            "ingest_rejected": self.ingest_rejected,
+            "latency_ms_p50": lat.get("p50", 0.0),
+            "latency_ms_p95": lat.get("p95", 0.0),
+            "latency_ms_p99": lat.get("p99", 0.0),
+            "latency_ms_mean": lat.get("mean", 0.0),
+        }
+        if self.state.trace is not None:
+            for occ in trace_occupancy(self.state.trace):
+                payload[f"trace_occupancy_shard{occ['shard']}"] = occ["cursor"]
+                payload[f"trace_overflow_shard{occ['shard']}"] = occ["overflow"]
+        return make_row("serve_live", payload, self.meta)
+
     def summary_row(self) -> dict:
         """The ``kind="serve"`` session row (bench + artifacts schema)."""
-        lat = percentile_summary(self._lat_ms)
-        exec_s = max(self._exec_s, 1e-9)
+        lat = self.slo.session()["latency"]
+        exec_s = max(self.slo.exec_s_total, 1e-9)
         payload = {
             "batches": self.serve_batches,
             "batch_ticks": self.batcher.n_ticks,

@@ -117,7 +117,9 @@ from scalecube_cluster_tpu.obs.tracer import (
     TK_SYNC_ACCEPT,
     TK_VERDICT_ALIVE,
     TK_VERDICT_DEAD,
+    ShardTraceRing,
     TraceRing,
+    init_shard_trace_rings,
     init_trace_ring,
     trace_emit,
     trace_host_event,
@@ -367,7 +369,7 @@ class SparseState:
     # compiled hot graph — bit-identical to tracer-off builds; requires the
     # XLA tick core (sparse_tick raises under pallas_core, and the SPMD
     # engine rejects it in _validate).
-    trace: TraceRing | None = None
+    trace: TraceRing | ShardTraceRing | None = None
 
     def replace(self, **changes) -> "SparseState":
         return dataclasses.replace(self, **changes)
@@ -381,6 +383,7 @@ def init_sparse_full_view(
     infected_k: int = 16,
     record_latency: bool = False,
     trace_capacity: int = 0,
+    trace_shards: int = 0,
 ) -> SparseState:
     """Post-join steady state, nothing active: the common 100k starting point.
 
@@ -395,6 +398,12 @@ def init_sparse_full_view(
     ``trace_capacity > 0`` attaches the causal flight recorder's event ring
     (obs/tracer.py) sized for that many events across the whole run; 0 (the
     default) keeps the bench pytree identical to pre-recorder builds.
+
+    ``trace_shards > 0`` (with ``trace_capacity > 0``) attaches the SHARDED
+    recorder instead — ``trace_shards`` per-shard rings of ``trace_capacity``
+    events each, the explicit-SPMD engine's layout (parallel/spmd.py;
+    ``trace_shards`` must equal the engine's ``ShardConfig.d``). Only that
+    engine accepts it: sparse_tick rejects a ShardTraceRing.
     """
     return SparseState(
         view_T=jnp.full((n, n), encode_key(0, 0), jnp.int32),
@@ -420,7 +429,12 @@ def init_sparse_full_view(
         ),
         wb_pinned=jnp.zeros((slot_budget,), bool),
         wb_valid=jnp.zeros((), bool),
-        trace=init_trace_ring(n, trace_capacity) if trace_capacity else None,
+        trace=(
+            init_shard_trace_rings(n, trace_capacity, trace_shards)
+            if trace_capacity and trace_shards
+            else init_trace_ring(n, trace_capacity) if trace_capacity
+            else None
+        ),
     )
 
 
@@ -1328,6 +1342,12 @@ def sparse_tick(
             "Pallas kernel does not expose the per-cell expiry mask the "
             "verdict events need (set pallas_core=False or drop the trace "
             "ring)"
+        )
+    if isinstance(state.trace, ShardTraceRing):  # tpulint: disable=R1 -- trace-time constant (isinstance on the trace field's pytree type), not a traced value
+        raise ValueError(
+            "single-device sparse_tick cannot carry a ShardTraceRing — the "
+            "per-shard recorder belongs to the explicit-SPMD engine "
+            "(parallel/spmd.py); init with trace_shards=0 for this engine"
         )
     fold = params.pallas_fold if use_kernel else frozenset()
     need_wb = "wb_mask" in fold

@@ -243,7 +243,7 @@ def test_shipped_gspmd_entries_clean(shardflow_result):
     -justified, G2/G3 are silent, and the rebuilt sharding census matches
     the committed artifacts/shardflow_census.json."""
     assert shardflow_result.skipped is None
-    assert shardflow_result.entries_traced == 5
+    assert shardflow_result.entries_traced == 6
     assert shardflow_result.eqns_interpreted > 1000
     assert shardflow_result.gated == [], "\n".join(
         f.render() for f in shardflow_result.gated

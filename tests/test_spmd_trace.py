@@ -1,0 +1,315 @@
+"""Per-shard flight recorder on the explicit-SPMD engine (obs/tracer.py).
+
+The sharded recorder (PR 17) gives the shard_map engine the same forensic
+surface the single-device engine has had since the TraceRing landed: each
+shard appends into its own ring row with a shard-local cursor, the only
+cross-shard traffic is the scalar ``trace_overflow`` riding the EXISTING
+metrics psum (tpulint S2/S4 pin zero new collectives), and the host merge
+(obs/trace.py::merge_shard_rings) reconstructs one deterministic global
+log. These tests pin the contract end to end: tracing never perturbs the
+trajectory, d=1 is bit-equal to the single-device ring, d=8/n=2048 yields
+the same event SET and every DEAD verdict still walks back to its missed
+probe through tools/trace_explain.py — including chains whose cause hops
+shards in the merged order.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.obs.trace import (
+    TK_PROBE_SENT,
+    TK_SYNC_ACCEPT,
+    TK_VERDICT_DEAD,
+    merge_shard_rings,
+    ring_events,
+    ring_overflow,
+    write_events_jsonl,
+)
+from scalecube_cluster_tpu.obs.tracer import shard_local_ring
+from scalecube_cluster_tpu.parallel.mesh import make_mesh
+from scalecube_cluster_tpu.parallel.spmd import (
+    ShardConfig,
+    exchange_rounds_per_tick,
+    run_sparse_ticks_spmd,
+)
+from scalecube_cluster_tpu.sim.faults import FaultPlan
+from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
+from scalecube_cluster_tpu.sim.sparse import (
+    init_sparse_full_view,
+    run_sparse_ticks,
+)
+from scalecube_cluster_tpu.testlib.certify import certify_params
+from tools.trace_explain import (
+    check_c6,
+    explain_verdict,
+    main as explain_main,
+)
+
+
+def _sched(n, kill_hi):
+    """Kills (one per half), a restart, and a lossy middle segment — the
+    scenario that exercises every verdict path the explainer walks."""
+    return (
+        ScheduleBuilder(n)
+        .add_segment(0, FaultPlan.uniform())
+        .add_segment(12, FaultPlan.uniform(loss_percent=20.0, mean_delay_ms=40.0))
+        .kill(7, 3)
+        .kill(9, kill_hi)
+        .restart(21, 3)
+        .build()
+    )
+
+
+def _event_key(ev):
+    # SYNC_ACCEPT aux records the responder's local view round, which is
+    # shard-relative scan bookkeeping, not protocol state — everything
+    # else must match field-for-field across engines.
+    aux = 0 if ev["kind"] == TK_SYNC_ACCEPT else ev["aux"]
+    return (ev["tick"], ev["kind"], ev["actor"], ev["subject"], aux)
+
+
+def _assert_states_equal(ref, out, where, skip=("trace",)):
+    for name in ref.__dataclass_fields__:
+        if name in skip:
+            continue
+        a, b = getattr(ref, name), getattr(out, name)
+        if a is None and b is None:
+            continue
+        assert np.array_equal(np.asarray(a), np.asarray(b)), (
+            f"state.{name} ({where})"
+        )
+
+
+def test_spmd_tracer_off_is_a_pure_observer():
+    """Arming the per-shard recorder must not perturb the trajectory: the
+    traced d=4 run matches the untraced d=4 run on every non-trace state
+    leaf and every trace key (the recorder only ADDS trace_overflow)."""
+    n, d, T = 256, 4, 35
+    p = certify_params(n)
+    mesh = make_mesh(jax.devices()[:d])
+    cfg = ShardConfig(d=d)
+    sched = _sched(n, n // 2)
+
+    off, off_tr = run_sparse_ticks_spmd(
+        p, cfg, mesh, init_sparse_full_view(n, p.slot_budget, seed=3),
+        sched, T, collect=True,
+    )
+    jax.block_until_ready(off)
+    on, on_tr = run_sparse_ticks_spmd(
+        p, cfg, mesh,
+        init_sparse_full_view(n, p.slot_budget, seed=3,
+                              trace_capacity=8192, trace_shards=d),
+        sched, T, collect=True,
+    )
+    jax.block_until_ready(on)
+
+    assert off.trace is None
+    assert on.trace is not None
+    _assert_states_equal(off, on, "tracer on/off")
+    assert set(on_tr) - set(off_tr) == {"trace_overflow"}
+    for k in off_tr:
+        assert np.array_equal(np.asarray(off_tr[k]), np.asarray(on_tr[k])), (
+            f"trace {k} perturbed by tracing"
+        )
+    assert not np.asarray(on_tr["trace_overflow"]).any()
+
+
+def test_spmd_trace_d1_bit_equal_to_single_device_ring():
+    """At d=1 the sharded recorder IS the single-device recorder: every
+    ring leaf bit-equal (via shard_local_ring's squeeze), and the merged
+    decode equal to ring_events row-for-row (modulo the shard column)."""
+    n, T, cap = 256, 35, 16384
+    p = certify_params(n)
+    sched = _sched(n, n // 2)
+
+    ref, ref_tr = run_sparse_ticks(
+        p, init_sparse_full_view(n, p.slot_budget, seed=3, trace_capacity=cap),
+        sched, T, collect=True,
+    )
+    jax.block_until_ready(ref)
+    out, out_tr = run_sparse_ticks_spmd(
+        p, ShardConfig(d=1), make_mesh(jax.devices()[:1]),
+        init_sparse_full_view(n, p.slot_budget, seed=3, trace_capacity=cap,
+                              trace_shards=1),
+        sched, T, collect=True,
+    )
+    jax.block_until_ready(out)
+
+    _assert_states_equal(ref, out, "d=1")
+    for k in ref_tr:
+        assert np.array_equal(np.asarray(ref_tr[k]), np.asarray(out_tr[k])), (
+            f"trace {k} (d=1)"
+        )
+    loc = shard_local_ring(out.trace)
+    for f in dataclasses.fields(ref.trace):
+        x = np.asarray(getattr(ref.trace, f.name))
+        y = np.asarray(getattr(loc, f.name))
+        assert np.array_equal(x, y), f"ring.{f.name} (d=1)"
+
+    mref = ring_events(ref.trace)
+    m1 = merge_shard_rings(out.trace)
+    assert len(mref) == len(m1)
+    for a, b in zip(mref, m1):
+        bb = dict(b)
+        assert bb.pop("shard") == 0
+        assert a == bb
+
+
+def test_spmd_trace_d4_merged_forensics(tmp_path):
+    """Fast-tier forensics pin (n=256, d=4): the merged log carries the
+    single-device event SET, C6 holds, every DEAD verdict resolves —
+    including at least one cross-shard chain — and a severed cause ref
+    fails the CLI with exit 1."""
+    n, d, T = 256, 4, 35
+    p = certify_params(n)
+    sched = _sched(n, n // 2)
+
+    ref, _ = run_sparse_ticks(
+        p,
+        init_sparse_full_view(n, p.slot_budget, seed=3, trace_capacity=16384),
+        sched, T, collect=True,
+    )
+    jax.block_until_ready(ref)
+    out, _ = run_sparse_ticks_spmd(
+        p, ShardConfig(d=d), make_mesh(jax.devices()[:d]),
+        init_sparse_full_view(n, p.slot_budget, seed=3, trace_capacity=8192,
+                              trace_shards=d),
+        sched, T, collect=True,
+    )
+    jax.block_until_ready(out)
+    assert ring_overflow(ref.trace) == 0
+    assert ring_overflow(out.trace) == 0
+
+    mref = ring_events(ref.trace)
+    merged = merge_shard_rings(out.trace)
+    assert sorted(_event_key(e) for e in mref) == sorted(
+        _event_key(e) for e in merged
+    )
+    assert {e["shard"] for e in merged} == set(range(d))
+
+    assert check_c6(merged) == []
+    deads = [e for e in merged if e["kind"] == TK_VERDICT_DEAD]
+    assert deads, "scenario produced no DEAD verdicts"
+    cross = []
+    for ev in deads:
+        explained = explain_verdict(merged, ev)
+        assert explained["complete"], explained["violations"]
+        assert explained["chain"][-1]["kind"] == TK_PROBE_SENT
+        if any(c["shard"] != ev["shard"] for c in explained["chain"]):
+            cross.append(ev)
+    # The kill at member n//2 is observed by probers on every shard, so
+    # the merged order must thread at least one cross-shard chain.
+    assert cross, "no cross-shard cause chain exercised"
+
+    good = tmp_path / "merged.jsonl"
+    write_events_jsonl(str(good), merged)
+    assert explain_main([str(good), "--quiet"]) == 0
+
+    bad = [dict(e) for e in merged]
+    bad[cross[0]["i"]]["cause"] = -1
+    bad_path = tmp_path / "tampered.jsonl"
+    write_events_jsonl(str(bad_path), bad)
+    assert explain_main([str(bad_path), "--quiet"]) == 1
+
+
+@pytest.mark.slow
+def test_spmd_trace_d8_n2048_every_dead_resolves(tmp_path):
+    """The acceptance rung: n=2048 over 8 shards, scheduled faults. The
+    traced run stays bit-identical to the single-device oracle on every
+    state leaf and trace key, the merged log carries the same event SET,
+    zero events are lost, the exchange still runs exactly 3 rounds (the
+    recorder adds no collectives), and tools/trace_explain.py resolves
+    every DEAD verdict on the merged file — while a tampered cross-shard
+    cause reference fails it loudly (exit 1)."""
+    assert len(jax.devices()) >= 8
+    n, d, T = 2048, 8, 35
+    p = certify_params(n)
+    sched = _sched(n, 1500)
+    assert exchange_rounds_per_tick() == 3
+
+    ref, ref_tr = run_sparse_ticks(
+        p,
+        init_sparse_full_view(n, p.slot_budget, seed=3, trace_capacity=1 << 19),
+        sched, T, collect=True,
+    )
+    jax.block_until_ready(ref)
+    out, out_tr = run_sparse_ticks_spmd(
+        p, ShardConfig(d=d), make_mesh(jax.devices()[:d]),
+        init_sparse_full_view(n, p.slot_budget, seed=3,
+                              trace_capacity=1 << 16, trace_shards=d),
+        sched, T, collect=True,
+    )
+    jax.block_until_ready(out)
+
+    _assert_states_equal(ref, out, "d=8")
+    for k in ref_tr:
+        assert np.array_equal(np.asarray(ref_tr[k]), np.asarray(out_tr[k])), (
+            f"trace {k} (d=8)"
+        )
+    assert ring_overflow(ref.trace) == 0
+    assert ring_overflow(out.trace) == 0
+
+    mref = ring_events(ref.trace)
+    merged = merge_shard_rings(out.trace)
+    assert sorted(_event_key(e) for e in mref) == sorted(
+        _event_key(e) for e in merged
+    )
+    assert {e["shard"] for e in merged} == set(range(d))
+
+    # Forensics on the merged log: C6 clean, every DEAD chain complete.
+    assert check_c6(merged) == []
+    deads = [e for e in merged if e["kind"] == TK_VERDICT_DEAD]
+    assert deads, "scenario produced no DEAD verdicts"
+    cross = []
+    for ev in deads:
+        explained = explain_verdict(merged, ev)
+        assert explained["complete"], explained["violations"]
+        assert explained["chain"][-1]["kind"] == TK_PROBE_SENT
+        if any(c["shard"] != ev["shard"] for c in explained["chain"]):
+            cross.append(ev)
+    # Verdicts about a subject owned by another shard walk cross-shard
+    # chains in the merged order — at n=2048/d=8 the scenario must
+    # produce at least one (kill at member 1500 is observed everywhere).
+    assert cross, "no cross-shard cause chain exercised"
+
+    good = tmp_path / "merged.jsonl"
+    write_events_jsonl(str(good), merged)
+    assert explain_main([str(good), "--quiet"]) == 0
+
+    # Tamper a cross-shard chain: sever the first cross-shard verdict's
+    # origin — the CLI must fail the merged file, same as single-device.
+    bad = [dict(e) for e in merged]
+    bad[cross[0]["i"]]["cause"] = -1
+    bad_path = tmp_path / "tampered.jsonl"
+    write_events_jsonl(str(bad_path), bad)
+    assert explain_main([str(bad_path), "--quiet"]) == 1
+
+
+def test_spmd_trace_validation():
+    """The engine rejects the three misconfigurations loudly: a plain
+    TraceRing (global cursor would fork per shard), a shard-count
+    mismatch, and the Pallas core (no expiry mask for verdict events)."""
+    n, d = 128, 2
+    mesh = make_mesh(jax.devices()[:d])
+    cfg = ShardConfig(d=d)
+    p = certify_params(n)
+
+    plain = init_sparse_full_view(n, p.slot_budget, trace_capacity=256)
+    with pytest.raises(ValueError, match="SHARDED flight recorder"):
+        run_sparse_ticks_spmd(p, cfg, mesh, plain, FaultPlan.uniform(), 2)
+
+    wrong_d = init_sparse_full_view(
+        n, p.slot_budget, trace_capacity=256, trace_shards=4
+    )
+    with pytest.raises(ValueError, match="4 per-shard"):
+        run_sparse_ticks_spmd(p, cfg, mesh, wrong_d, FaultPlan.uniform(), 2)
+
+    p_pallas = dataclasses.replace(p, pallas_core=True)
+    ok = init_sparse_full_view(
+        n, p.slot_budget, trace_capacity=256, trace_shards=d
+    )
+    with pytest.raises(ValueError, match="XLA tick core"):
+        run_sparse_ticks_spmd(p_pallas, cfg, mesh, ok, FaultPlan.uniform(), 2)

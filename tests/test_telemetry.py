@@ -1,0 +1,198 @@
+"""Live telemetry plane (obs/slo.py + serve/telemetry.py, PR 17).
+
+The serve bridge now tracks ingest→verdict latency in a rolling window
+(RollingSLOTracker) and publishes it two ways while the session runs: a
+``serve/metrics`` request_response qualifier on the session's own
+Transport, and a Prometheus text-format endpoint reusing obs/export.py's
+``prometheus_text``. Both render the SAME ``live_metrics()`` row, and the
+close-time summary flows through the same tracker — so a scrape taken at
+close bit-matches ``summary_row()`` on the same window. These tests pin
+the window math against offline recompute, the per-shard ring-occupancy
+gauges on launch spans, and the live loopback (poll + scrape) contract.
+"""
+
+import asyncio
+
+import pytest
+
+from scalecube_cluster_tpu.cluster_api.config import TransportConfig
+from scalecube_cluster_tpu.obs.latency import percentile_summary
+from scalecube_cluster_tpu.obs.slo import RollingSLOTracker
+from scalecube_cluster_tpu.obs.trace import chrome_trace
+from scalecube_cluster_tpu.serve import EV_KILL, ServeBridge, ServeEvent
+from scalecube_cluster_tpu.serve.telemetry import (
+    METRICS_QUALIFIER,
+    MetricsResponder,
+    PrometheusEndpoint,
+)
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    init_sparse_full_view,
+)
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.transport.tcp import TcpTransport
+
+N, S = 16, 64
+
+
+def _params():
+    return SparseParams.for_n(N, slot_budget=S)
+
+
+def test_rolling_slo_tracker_window_math():
+    """rolling() is exactly percentile_summary over the last W launches
+    (events/s over the window's own exec time); session() covers the full
+    session — the two disagree once the window has slid."""
+    t = RollingSLOTracker(window=4)
+    lats = [5.0, 9.0, 1.0, 30.0, 2.0, 8.0, 4.0]
+    for i, ms in enumerate(lats):
+        t.record(ms, n_events=i + 1, exec_s=0.01 * (i + 1), backpressure=i % 2)
+    assert len(t) == len(lats)
+    assert t.latencies_ms == lats
+
+    roll = t.rolling()
+    assert roll["window"] == 4
+    assert roll["launches"] == 4
+    assert roll["latency"] == percentile_summary(lats[-4:])
+    assert roll["events"] == sum(i + 1 for i in range(3, 7))
+    win_exec = sum(0.01 * (i + 1) for i in range(3, 7))
+    assert roll["events_per_sec"] == pytest.approx(roll["events"] / win_exec)
+    assert roll["backpressure"] == sum(i % 2 for i in range(3, 7))
+
+    sess = t.session()
+    assert sess["launches"] == len(lats)
+    assert sess["latency"] == percentile_summary(lats)
+    assert sess["latency"] != roll["latency"]
+
+    empty = RollingSLOTracker()
+    assert empty.rolling()["latency"] == {"count": 0}
+    assert empty.session()["latency"] == {"count": 0}
+    with pytest.raises(ValueError):
+        RollingSLOTracker(window=0)
+
+
+def test_replay_rolling_slo_and_ring_occupancy():
+    """Replay with the flight recorder armed: the rolling window matches
+    offline recompute, live_metrics() carries the window percentiles and
+    per-shard ring occupancy, every launch span gains an occupancy gauge,
+    and chrome_trace renders them as Perfetto counter tracks."""
+    bridge = ServeBridge(
+        _params(),
+        init_sparse_full_view(N, S, seed=0, trace_capacity=512),
+        batch_ticks=4, capacity=2, slo_window=3,
+    )
+    bridge.run_replay([ServeEvent(EV_KILL, 2, tick=1)], 24)  # 6 launches
+    lats = bridge.slo.latencies_ms
+    assert len(lats) == 6
+
+    roll = bridge.slo.rolling()
+    assert roll["latency"] == percentile_summary(lats[-3:])
+
+    live = bridge.live_metrics()
+    assert live["kind"] == "serve_live"
+    assert live["window"] == 3
+    assert live["window_launches"] == 3
+    assert live["latency_ms_p95"] == roll["latency"]["p95"]
+    assert live["trace_occupancy_shard0"] > 0
+    assert live["trace_overflow_shard0"] == 0
+
+    assert all("ring_occupancy" in sp for sp in bridge.spans)
+    counters = [
+        e for e in chrome_trace(launch_spans=bridge.spans)["traceEvents"]
+        if e.get("ph") == "C"
+    ]
+    assert len(counters) == 6
+
+    # Satellite: close-time percentiles come from the SAME tracker over
+    # the FULL session, not the window — dedupe regression pin.
+    summary = bridge.close()
+    full = percentile_summary(lats)
+    assert summary["latency_ms_p50"] == full["p50"]
+    assert summary["latency_ms_p99"] == full["p99"]
+    assert summary["batches"] == 6
+
+
+def test_live_metrics_untraced_has_no_occupancy_keys():
+    bridge = ServeBridge(
+        _params(), init_sparse_full_view(N, S, seed=0), batch_ticks=4,
+        capacity=2,
+    )
+    bridge.run_replay([], 8)
+    live = bridge.live_metrics()
+    assert not any(k.startswith("trace_occupancy") for k in live)
+    bridge.close()
+
+
+@pytest.mark.asyncio
+async def test_live_metrics_poll_and_prometheus_scrape():
+    """Live loopback: while a run_live session settles, a second transport
+    polls ``serve/metrics`` via request_response and an HTTP client
+    scrapes the Prometheus endpoint — both must agree with the close-time
+    summary on the same (un-slid) window."""
+    br = ServeBridge(
+        _params(), init_sparse_full_view(N, S, seed=1), batch_ticks=4,
+        capacity=2,
+    )
+    server = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    client = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    responder = MetricsResponder(br, server)
+    responder.start()
+    prom = PrometheusEndpoint(br)
+    await prom.start()
+    try:
+        live = asyncio.ensure_future(
+            br.run_live(server, n_batches=3, settle_s=0.1)
+        )
+        await asyncio.sleep(0.05)  # pump subscribed before the client writes
+        await client.send(
+            server.address,
+            Message.create(
+                qualifier="serve/event",
+                data={"kind": "kill", "node": 3, "tick": 1},
+                sender=client.address,
+            ),
+        )
+        await asyncio.wait_for(live, timeout=60)
+
+        req = Message.create(
+            qualifier=METRICS_QUALIFIER, correlation_id="m1",
+            sender=client.address,
+        )
+        resp = await client.request_response(server.address, req, timeout=5)
+        row = resp.data
+        assert row["kind"] == "serve_live"
+        assert row["batches"] == 3
+        assert row["window_launches"] == 3
+
+        # Default window (64) hasn't slid at 3 launches, so the rolling
+        # percentiles ARE the session percentiles the summary reports.
+        summ = br.summary_row()
+        for k in ("latency_ms_p50", "latency_ms_p95", "latency_ms_p99",
+                  "latency_ms_mean"):
+            assert row[k] == summ[k], (k, row[k], summ[k])
+
+        reader, writer = await asyncio.open_connection("127.0.0.1", prom.port)
+        writer.write(b"GET /metrics HTTP/1.0\r\nHost: x\r\n\r\n")
+        await writer.drain()
+        raw = await reader.read()
+        writer.close()
+        text = raw.decode()
+        assert text.startswith("HTTP/1.0 200 OK"), text[:80]
+        head, body = text.split("\r\n\r\n", 1)
+        assert "text/plain; version=0.0.4" in head
+        lines = [
+            ln for ln in body.splitlines()
+            if ln.startswith("scalecube_serve_live_latency_ms_p95")
+        ]
+        assert lines, body[:400]
+        line = lines[0]
+        assert float(line.rsplit(" ", 1)[1]) == pytest.approx(
+            summ["latency_ms_p95"], abs=1e-9
+        )
+        assert responder.polls_served == 1
+        assert prom.scrapes_served == 1
+    finally:
+        await responder.stop()
+        await prom.stop()
+        await client.stop()
+        await server.stop()

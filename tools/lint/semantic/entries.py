@@ -155,7 +155,7 @@ def _build_run_ticks_pallas():
     )
 
 
-def _sparse_inputs(pallas_core, schedule=False, trace_capacity=0):
+def _sparse_inputs(pallas_core, schedule=False, trace_capacity=0, trace_shards=0):
     from scalecube_cluster_tpu.sim.faults import FaultPlan
     from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
     from scalecube_cluster_tpu.sim.sparse import SparseParams, init_sparse_full_view
@@ -166,6 +166,7 @@ def _sparse_inputs(pallas_core, schedule=False, trace_capacity=0):
         slot_budget=S,
         user_gossip_slots=params.base.user_gossip_slots,
         trace_capacity=trace_capacity,
+        trace_shards=trace_shards,
     )
     if schedule:
         plan = (
@@ -241,7 +242,7 @@ def _build_run_rapid_ticks_geo():
     )
 
 
-def _build_run_sparse_ticks_spmd(schedule=False, pallas=False):
+def _build_run_sparse_ticks_spmd(schedule=False, pallas=False, traced=False):
     # The explicit-SPMD shard_map engine (parallel/spmd.py). The census
     # environment is single-device, so the probe mesh is d=1 over
     # devices[:1] — every collective (all_gather / all_to_all / psum) still
@@ -258,7 +259,16 @@ def _build_run_sparse_ticks_spmd(schedule=False, pallas=False):
         run_sparse_ticks_spmd,
     )
 
-    params, state, plan = _sparse_inputs(pallas, schedule=schedule)
+    # traced=True arms the per-shard flight recorder (obs/tracer.py
+    # ShardTraceRing, PR 17): the [d, R] ring joins the carry pytree — a
+    # distinct treedef, hence a distinct executable to census. The probe
+    # mesh is d=1, so the ring has one shard row here; the emission code
+    # and the trace_overflow psum rider are shard-count-generic.
+    params, state, plan = _sparse_inputs(
+        pallas, schedule=schedule,
+        trace_capacity=256 if traced else 0,
+        trace_shards=1 if traced else 0,
+    )
     mesh = make_mesh(jax.devices()[:1])
     return (
         run_sparse_ticks_spmd,
@@ -556,6 +566,10 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
     EntrySpec(
         "parallel.spmd.run_sparse_ticks_spmd[pallas]",
         lambda: _build_run_sparse_ticks_spmd(pallas=True),
+    ),
+    EntrySpec(
+        "parallel.spmd.run_sparse_ticks_spmd[traced]",
+        lambda: _build_run_sparse_ticks_spmd(True, traced=True),
     ),
     EntrySpec(
         "ops.pallas_sparse.run_sparse_core_persistent",

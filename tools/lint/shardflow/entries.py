@@ -117,7 +117,7 @@ def _replicated_plan_pspecs(plan):
     return jax.tree_util.tree_map(lambda _: P(), plan)
 
 
-def _sparse_inputs():
+def _sparse_inputs(trace_capacity=0):
     from scalecube_cluster_tpu.sim.faults import FaultPlan
     from scalecube_cluster_tpu.sim.sparse import (
         SparseParams,
@@ -126,12 +126,13 @@ def _sparse_inputs():
 
     params = SparseParams.for_n(N, slot_budget=S)
     state = init_sparse_full_view(
-        N, slot_budget=S, user_gossip_slots=params.base.user_gossip_slots
+        N, slot_budget=S, user_gossip_slots=params.base.user_gossip_slots,
+        trace_capacity=trace_capacity,
     )
     return params, state, FaultPlan.uniform()
 
 
-def _build_run_sparse_ticks(two_d: bool):
+def _build_run_sparse_ticks(two_d: bool, traced: bool = False):
     import jax
 
     from scalecube_cluster_tpu.parallel.mesh import (
@@ -141,7 +142,14 @@ def _build_run_sparse_ticks(two_d: bool):
     )
     from scalecube_cluster_tpu.sim.sparse import run_sparse_ticks
 
-    params, state, plan = _sparse_inputs()
+    # traced=True arms the single-device flight recorder on the GSPMD twin
+    # (PR 17): the plain TraceRing replicates — sparse_state_pspecs maps
+    # every ring leaf to P() — so the partitioner keeps emission local per
+    # replica and propagation must infer ZERO extra cross-shard movement
+    # for it (the census pins exactly that).
+    params, state, plan = _sparse_inputs(
+        trace_capacity=256 if traced else 0
+    )
     mesh = (
         make_mesh2d((D, D)) if two_d else make_mesh(jax.devices()[:D])
     )
@@ -265,6 +273,10 @@ SHARDFLOW_ENTRY_SPECS: tuple[ShardflowEntrySpec, ...] = (
     ShardflowEntrySpec(
         "sim.sparse.run_sparse_ticks[gspmd2d,2x2]",
         lambda: _build_run_sparse_ticks(True),
+    ),
+    ShardflowEntrySpec(
+        "sim.sparse.run_sparse_ticks[gspmd1d,traced,d2]",
+        lambda: _build_run_sparse_ticks(False, traced=True),
     ),
     ShardflowEntrySpec(
         "sim.ensemble.run_ensemble_sparse_ticks[gspmd,2x2]",

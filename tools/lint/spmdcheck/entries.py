@@ -54,7 +54,8 @@ class SpmdEntrySpec:
     meta: dict = field(default_factory=dict)
 
 
-def _spmd_inputs(schedule=False, record_latency=False, pallas=False):
+def _spmd_inputs(schedule=False, record_latency=False, pallas=False,
+                 trace_shards=0):
     from scalecube_cluster_tpu.sim.faults import FaultPlan
     from scalecube_cluster_tpu.sim.schedule import ScheduleBuilder
     from scalecube_cluster_tpu.sim.sparse import (
@@ -68,6 +69,8 @@ def _spmd_inputs(schedule=False, record_latency=False, pallas=False):
         slot_budget=S,
         user_gossip_slots=params.base.user_gossip_slots,
         record_latency=record_latency,
+        trace_capacity=256 if trace_shards else 0,
+        trace_shards=trace_shards,
     )
     if schedule:
         plan = (
@@ -84,7 +87,8 @@ def _spmd_inputs(schedule=False, record_latency=False, pallas=False):
 
 
 def _build_run_sparse_ticks_spmd(
-    schedule=False, record_latency=False, pallas=False, geo=False
+    schedule=False, record_latency=False, pallas=False, geo=False,
+    traced=False,
 ):
     import jax
 
@@ -98,8 +102,14 @@ def _build_run_sparse_ticks_spmd(
     # (round 7). The three cross-shard collectives are OUTSIDE the
     # pallas_call, so S1/S2 see identical exchange structure — the point
     # of censusing this twin is pinning exactly that invariant.
+    # traced=True arms the PER-SHARD flight recorder (obs/tracer.py
+    # ShardTraceRing, PR 17): each shard records into its own [capacity]
+    # ring row and only the scalar trace_overflow rides the EXISTING
+    # metrics psum — censusing this twin pins that the recorder adds ZERO
+    # collectives and leaves the exchange payload untouched (S2/S4).
     params, state, plan = _spmd_inputs(
-        schedule=schedule, record_latency=record_latency, pallas=pallas
+        schedule=schedule, record_latency=record_latency, pallas=pallas,
+        trace_shards=D if traced else 0,
     )
     if geo:
         # A LinkWorld-bearing schedule (sim/topology.py). The whole plan
@@ -215,6 +225,10 @@ SPMD_ENTRY_SPECS: tuple[SpmdEntrySpec, ...] = (
     SpmdEntrySpec(
         "parallel.spmd.run_sparse_ticks_spmd[geo,d2]",
         lambda: _build_run_sparse_ticks_spmd(geo=True),
+    ),
+    SpmdEntrySpec(
+        "parallel.spmd.run_sparse_ticks_spmd[traced,d2]",
+        lambda: _build_run_sparse_ticks_spmd(True, traced=True),
     ),
     SpmdEntrySpec(
         "parallel.spmd.run_ensemble_sparse_ticks_spmd[2x2]",

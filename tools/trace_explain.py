@@ -1,7 +1,13 @@
 """Verdict explainer: walk flight-recorder cause chains and check C6.
 
 Input is the event JSONL written by ``obs.trace.write_events_jsonl`` (one
-decoded ring event per line). For every DEAD verdict — optionally filtered
+decoded ring event per line) — either a single-device ring decode
+(``ring_events``) or a merged multi-shard log (``merge_shard_rings``, whose
+events carry a ``shard`` column: the RECORDING shard, shown per link so a
+chain that crosses shards is visible as such). Cause references in a merged
+log are merged-order positions, so the same strictly-backwards walk checks
+cross-shard chains with no special casing — a tampered cross-shard ref
+fails exactly like a tampered local one. For every DEAD verdict — optionally filtered
 by ``--subject`` / ``--tick`` — the tool walks the ``cause`` chain back to
 the originating probe:
 
@@ -257,9 +263,14 @@ def format_chain(explained: dict) -> str:
         )
     lines = [head]
     for ev in explained["chain"]:
+        # Merged multi-shard logs (obs/trace.py::merge_shard_rings) carry
+        # the RECORDING shard per event; a chain that crosses shards shows
+        # it link by link. Plain single-device logs have no shard column.
+        shard = f" shard={ev['shard']}" if "shard" in ev else ""
         lines.append(
             f"  [{ev['i']:>5}] tick {ev['tick']:>5}  {ev['kind_name']:<14} "
-            f"actor={ev['actor']} subject={ev['subject']} cause={ev['cause']}"
+            f"actor={ev['actor']} subject={ev['subject']} "
+            f"cause={ev['cause']}{shard}"
         )
     for bad in explained["violations"]:
         lines.append(f"  VIOLATION: {bad}")
