@@ -60,7 +60,12 @@ percentiles — plus the probe attempt land in bench_history.jsonl), or
 serve/load.py: a seeded fleet of honest + adversarial loopback-TCP
 producers with churn drives one live session; the ``kind="load"`` row —
 events/s, backpressure pauses, rejections, conservation verdicts — plus
-the probe attempt land in bench_history.jsonl), or ``python bench.py
+the probe attempt land in bench_history.jsonl), or ``python bench.py --grow [n0] [tiers]`` (the
+elastic-membership rung, serve/bridge.py + sim/checkpoint.py: one serving
+session grows from n0 live members to a full ``2*n0 * 2**tiers`` through
+``tiers`` auto-promotions under wire-form joins; the ``kind="grow"`` row —
+joins/s admission rate, per-promotion wall-time, certified ``dropped: 0``
+— plus the probe attempt land in bench_history.jsonl), or ``python bench.py
 --tracer-overhead [n]`` (the flight-recorder cost rung: the same churny
 sparse trajectory run tracer-off and tracer-on; the ``kind="bench_tracer"``
 row carries the on/off wall-time ratio, tracer-on ns_per_member, and the
@@ -617,6 +622,75 @@ def _measure_serve(
     ]
     bridge.run_replay(events, total_ticks)
     return bridge.close()
+
+
+def _measure_grow(n0: int = 64, tiers: int = 2, burst: int = 24) -> dict:
+    """The ``--grow [n0] [tiers]`` rung: one elastic serving session grows
+    from ``n0`` live members (in a ``2*n0`` allocation, the first tier of
+    the doubling ladder) to a full ``2*n0 * 2**tiers`` members through
+    ``tiers`` checkpoint-based geometry promotions (serve/bridge.py
+    ``auto_promote``) — the defaults are the certified 64 -> 512 session of
+    tests/test_elastic.py as a priced rung. Joins arrive in wire form (node
+    omitted — the bridge's admission allocator assigns capacity rows). The
+    row prices the two costs elasticity adds to serving: steady-state
+    admission (joins/s ingested-to-activated, launches riding the elastic
+    executable) and the promotion wall-time itself (drain + pack_cold
+    checkpoint round-trip + re-init at the doubled tier + parked-join
+    replay + recompile at the new geometry, from the per-promotion
+    ``wall_ms`` stamps). The admission conservation ledger is asserted at
+    the end — ``dropped`` in the row is a certified 0, not an observation
+    — so a growth session that sheds or strands a join fails the bench
+    instead of flattering it."""
+    from scalecube_cluster_tpu.serve import ServeBridge
+    from scalecube_cluster_tpu.serve.ingest import event_from_obj
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import (
+        SparseParams,
+        init_sparse_full_view,
+    )
+
+    n_alloc0 = 2 * n0
+    n_top = n_alloc0 * (2**tiers)
+    params = SparseParams.for_n(n_alloc0, slot_budget=_rung_slot_budget(n_top))
+    state = init_sparse_full_view(n0, params.slot_budget, n_alloc=n_alloc0)
+    bridge = ServeBridge(
+        params, state, plan=FaultPlan.uniform(), batch_ticks=8,
+        capacity=max(burst, 8), collect=False, auto_promote=True,
+    )
+    n_joins = n_top - n0
+    t0 = time.perf_counter()
+    sent = 0
+    while sent < n_joins or bridge.batcher.deferred_joins:
+        for _ in range(min(burst, n_joins - sent)):
+            bridge.push(event_from_obj({"kind": "join"}))
+        sent += min(burst, n_joins - sent)
+        bridge.step_batch()
+    dt = time.perf_counter() - t0
+    led = bridge.batcher.assert_join_conservation()
+    assert led["placed"] == n_joins and led["shed"] == 0, led
+    promo_ms = [
+        r["wall_ms"] for r in bridge.rows if r.get("kind") == "promotion"
+    ]
+    assert len(promo_ms) == tiers, (len(promo_ms), tiers)
+    summary = bridge.close()
+    return {
+        "metric": "joins_admitted_per_sec",
+        "value": round(n_joins / dt, 1),
+        "unit": "joins/s",
+        "n0": n0,
+        "tiers": tiers,
+        "n_top": n_top,
+        "n_live": summary["n_live"],
+        "joins_total": n_joins,
+        "dropped": led["shed"] + led["deferred"],  # certified 0 above
+        "promotions": tiers,
+        "promotion_wall_ms": [round(ms, 1) for ms in promo_ms],
+        "promotion_wall_ms_mean": round(sum(promo_ms) / len(promo_ms), 1),
+        "batches": summary["batches"],
+        "ticks": summary["ticks"],
+        "wall_s": round(dt, 2),
+        "engine": "sparse-elastic",
+    }
 
 
 def _measure_load(producers: int = 32, n_members: int = 1024) -> dict:
@@ -1317,6 +1391,66 @@ if __name__ == "__main__":
                     "n_members": n_arg,
                     "tracer_overhead": out["tracer_overhead"],
                     "ns_per_member": out["ns_per_member"],
+                },
+            )
+        try:
+            append_jsonl(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "artifacts",
+                    "bench_history.jsonl",
+                ),
+                [row],
+            )
+        except Exception:
+            pass
+        print(jsonl_line(row), flush=True)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--grow":
+        try:
+            from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+            enable_repo_jax_cache()
+        except Exception:
+            pass
+        from scalecube_cluster_tpu.obs.export import (
+            append_jsonl,
+            jsonl_line,
+            make_row,
+            run_metadata,
+        )
+
+        n_arg = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+        tiers_arg = int(sys.argv[3]) if len(sys.argv) > 3 else 2
+        # One recorded backend probe first (the ladder driver's discipline:
+        # outage budget must leave evidence in bench_history.jsonl).
+        t_probe = time.monotonic()
+        probe_err = _probe_once()
+        _record_probe_attempt(1, probe_err, time.monotonic() - t_probe)
+        if probe_err is not None:
+            row = make_row(
+                "grow",
+                {"error": probe_err, "n0": n_arg, "tiers": tiers_arg,
+                 **_self_evidence()},
+                run_metadata(seed=0),
+            )
+        else:
+            out = _measure_grow(n_arg, tiers_arg)
+            row = make_row("grow", out, run_metadata(seed=0))
+            # The probe history is the long-lived per-round record: the
+            # admission-rate and promotion-cost trends belong in the same
+            # timeline as outages, so elasticity regressions read off one
+            # file.
+            _record_probe_attempt(
+                2,
+                None,
+                time.monotonic() - t_probe,
+                extra={
+                    "scenario": "grow",
+                    "n0": n_arg,
+                    "tiers": tiers_arg,
+                    "n_top": out["n_top"],
+                    "joins_per_sec": out["value"],
+                    "promotion_wall_ms_mean": out["promotion_wall_ms_mean"],
                 },
             )
         try:

@@ -29,6 +29,16 @@ additionally certified against the Z1-Z3 per-zone graceful-degradation
 invariants. Geo CHAOS-REPRO digests hash the zone assignment and every
 [Z, Z] matrix, so one line still pins the whole world.
 
+``--grow`` runs the growth-under-chaos matrix instead
+(testlib/chaos.py::grow_matrix): every trial is one elastic serve session
+growing ``n//2`` live members to a full ``n * 2**tiers`` through
+checkpoint-based geometry promotions, with wire joins racing kill/restart
+churn and every promotion taken mid-brownout (a 2-zone LinkWorld latency
+segment). Certified per inter-promotion segment (C1-C6 at that segment's
+geometry) plus the admission conservation ledger and the elastic
+live x live heal; the CHAOS-REPRO line carries the tier ladder
+(``ladder=32->64->128``). ``--tiers`` sets the ladder depth.
+
 ``--out FILE`` appends each trial as schema-versioned JSONL (obs/export.py),
 so soak results can be committed/diffed like the experiment grid's.
 """
@@ -61,6 +71,20 @@ def main(argv=None) -> int:
         action="store_true",
         help="geo matrix: LinkWorld timelines (split2/brownout3/oneway) "
         "with Z1-Z3 zone certification on the SWIM engines",
+    )
+    ap.add_argument(
+        "--grow",
+        action="store_true",
+        help="growth-under-chaos matrix: elastic serve sessions climbing "
+        "the n_alloc doubling ladder under join/kill races with "
+        "mid-brownout promotions (testlib/chaos.py::grow_matrix)",
+    )
+    ap.add_argument(
+        "--tiers",
+        type=int,
+        default=None,
+        help="promotions per grow trial (--grow only; default "
+        "testlib.chaos.GROW_TIERS)",
     )
     ap.add_argument(
         "--swim-engine",
@@ -125,6 +149,43 @@ def main(argv=None) -> int:
         print(
             json.dumps(
                 {"races": len(rows), "violations": len(failures)}
+            )
+        )
+        return len(failures)
+
+    if args.grow:
+        from scalecube_cluster_tpu.testlib.chaos import GROW_TIERS, grow_matrix
+
+        tiers = args.tiers if args.tiers is not None else GROW_TIERS
+
+        def emit_grow(r: dict) -> None:
+            if r["ok"]:
+                ladder = "->".join(str(x) for x in r["ladder"])
+                print(
+                    f"ok seed={r['seed']} ladder={ladder} "
+                    f"digest={r['digest']} n_live={r['n_live']} "
+                    f"joins={r['joins_placed']} "
+                    f"promo_ms={r['promotion_wall_ms']} "
+                    f"conv={r['final_convergence']:.3f}"
+                )
+            else:
+                print(f"FAIL {r['reproducer']} :: {r['error']}")
+            sys.stdout.flush()
+
+        results = grow_matrix(seeds, args.n, tiers=tiers, on_result=emit_grow)
+        failures = [r for r in results if not r["ok"]]
+        if args.out:
+            meta = run_metadata(n=args.n)
+            append_jsonl(
+                args.out, [make_row("chaos_grow", r, meta) for r in results]
+            )
+        print(
+            json.dumps(
+                {
+                    "trials": len(results),
+                    "violations": len(failures),
+                    "reproducers": [r["reproducer"] for r in failures],
+                }
             )
         )
         return len(failures)

@@ -69,6 +69,27 @@ from __future__ import annotations
 #   join_confirms      join-confirm messages newly latched at a seed — the
 #                      certificate that gates the joiner's stable_add cut
 #                      (Rapid fallback only; constant 0 elsewhere)
+#   joins_admitted     capacity rows activated by a join this tick (elastic
+#                      membership, the 4-tuple events path of
+#                      sim/sparse.py::sparse_tick; fixed-shape engines have
+#                      no capacity rows and emit constant 0)
+#   joins_deferred     joins parked for the next geometry promotion because
+#                      every capacity row is taken — a GAUGE (currently
+#                      parked, serve/ingest.py::EventBatcher.deferred_joins)
+#                      stamped by the elastic bridge over the engines'
+#                      constant-0 slot; deferred is never dropped (the
+#                      admission conservation ledger, join_ledger())
+#   promotions         geometry promotions the serving session has taken
+#                      (ServeBridge.promote, the n_alloc doubling ladder);
+#                      host accounting like serve_batches — engines emit
+#                      constant 0
+#   n_live             members whose identity has ever been live — a GAUGE
+#                      (sum of the elastic live_mask; the per-tick elastic
+#                      metrics emit it so growth is visible per tick, and
+#                      the bridge stamps the session-end value over the
+#                      meaningless tick-sum; fixed-shape engines emit
+#                      constant 0, NOT n — the slot reads "elastic
+#                      occupancy", absent when the cluster cannot grow)
 SHARED_COUNTERS: tuple[str, ...] = (
     "pings",
     "ping_reqs",
@@ -94,6 +115,10 @@ SHARED_COUNTERS: tuple[str, ...] = (
     "fallback_commits",
     "join_requests",
     "join_confirms",
+    "joins_admitted",
+    "joins_deferred",
+    "promotions",
+    "n_live",
 )
 
 # Emitted by the sparse engine only — they measure the compact working-set

@@ -156,6 +156,25 @@ def init_shard_trace_rings(n: int, capacity: int, d: int) -> ShardTraceRing:
     )
 
 
+def pad_trace_ring(ring: TraceRing, n_new: int) -> TraceRing:
+    """Grow the member axis of a ring's causal registers to ``n_new`` rows
+    (elastic geometry promotion): the event log, cursor and overflow carry
+    VERBATIM — ring positions are stable, so recorded cause chains (e.g. a
+    join's REQ → ACK links) survive the promotion — and the new capacity
+    rows start with empty registers (-1, never probed / no open episode)."""
+    n_old = int(ring.last_miss.shape[0])
+    if n_new < n_old:
+        raise ValueError(f"pad_trace_ring: n_new={n_new} < n_old={n_old}")
+    if n_new == n_old:
+        return ring
+    return ring.replace(
+        last_miss=jnp.full((n_new,), -1, jnp.int32).at[:n_old].set(
+            ring.last_miss
+        ),
+        origin=jnp.full((n_new,), -1, jnp.int32).at[:n_old].set(ring.origin),
+    )
+
+
 def shard_local_ring(rings: ShardTraceRing) -> TraceRing:
     """Inside shard_map: squeeze this shard's ``[1, ...]`` slice into a
     plain :class:`TraceRing` so the single-device emission code runs

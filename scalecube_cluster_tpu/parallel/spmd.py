@@ -1136,6 +1136,13 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         "ingest_rejected": jnp.zeros((), jnp.int32),
         "ingest_backpressure": jnp.zeros((), jnp.int32),
         "serve_batches": jnp.zeros((), jnp.int32),
+        # Elastic-membership counters (capacity-tiered clusters,
+        # sim/sparse.py elastic path + serve/bridge.py): this engine has no
+        # capacity rows, so the schema slots are constant zero.
+        "joins_admitted": jnp.zeros((), jnp.int32),
+        "joins_deferred": jnp.zeros((), jnp.int32),
+        "promotions": jnp.zeros((), jnp.int32),
+        "n_live": jnp.zeros((), jnp.int32),
     }
     if tracing:
         # Summed over shards — equals the oracle's single-ring counter at

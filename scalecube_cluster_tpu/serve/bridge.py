@@ -25,6 +25,7 @@ about the same launches.
 from __future__ import annotations
 
 import asyncio
+import io
 import time
 
 import jax
@@ -33,9 +34,15 @@ import numpy as np
 from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS
 from scalecube_cluster_tpu.obs.export import append_jsonl, make_row, run_metadata
 from scalecube_cluster_tpu.obs.slo import RollingSLOTracker
-from scalecube_cluster_tpu.obs.trace import trace_occupancy
-from scalecube_cluster_tpu.serve.engine import run_serve_batch
+from scalecube_cluster_tpu.obs.trace import TK_JOIN_ACK, TK_JOIN_REQ, trace_occupancy
+from scalecube_cluster_tpu.obs.tracer import pad_trace_ring, trace_host_event
+from scalecube_cluster_tpu.serve.engine import run_serve_batch, run_serve_batch_elastic
 from scalecube_cluster_tpu.serve.ingest import EventBatcher, ServeEvent, TcpEventSource
+from scalecube_cluster_tpu.sim.checkpoint import (
+    load_sparse_checkpoint,
+    promote_sparse_state,
+    save_sparse_checkpoint,
+)
 from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.knobs import Knobs
 from scalecube_cluster_tpu.sim.sparse import (
@@ -76,6 +83,8 @@ class ServeBridge:
         low_watermark: int | None = None,
         overflow_policy: str = "defer",
         slo_window: int = 64,
+        legacy_join: bool | None = None,
+        auto_promote: bool = False,
     ):
         self.params = params
         self.state = state
@@ -84,6 +93,30 @@ class ServeBridge:
         self.collect = collect
         self.export_path = export_path
         g_slots = int(state.useen.shape[1])
+        # Elastic sessions (capacity-tiered state, live_mask attached) route
+        # wire joins to ADMISSION — an unused capacity row per join,
+        # activated in-scan by run_serve_batch_elastic — instead of the
+        # fixed-shape restart alias. ``legacy_join=None`` resolves from the
+        # state's shape; pass True explicitly to replay a pre-elastic trace
+        # byte-compatibly on an elastic state.
+        self.elastic = state.live_mask is not None
+        if legacy_join is None:
+            legacy_join = not self.elastic
+        #: Geometry promotions taken this session (the n_alloc doubling
+        #: ladder); stamped over the engines' constant-zero counter slot.
+        self.promotions = 0
+        #: ``auto_promote=True``: a launch boundary that finds joins parked
+        #: for capacity promotes immediately (doubling) and replays them —
+        #: the self-growing session. Off, the caller drives promote().
+        self.auto_promote = auto_promote
+        if self.elastic:
+            # Monotone next-free-row allocator: the bridge owns admission
+            # order, assigning capacity rows upward from the first masked
+            # row. Bridge sessions activate rows only through admission, so
+            # a host mirror (no device round-trip per join) stays exact.
+            lm = np.asarray(jax.device_get(state.live_mask))
+            free = np.flatnonzero(~lm)
+            self._next_row = int(free[0]) if free.size else int(lm.shape[0])
         # Bounded-queue default: a serving session must degrade by CHOICE
         # (defer = lossless backpressure to producers; shed-oldest = bounded
         # latency, shed counted), never by unbounded deque growth.
@@ -96,6 +129,8 @@ class ServeBridge:
             max_pending=max_pending,
             low_watermark=low_watermark,
             overflow_policy=overflow_policy,
+            legacy_join=legacy_join,
+            admit=self._admit_join if self.elastic else None,
         )
         self.meta = (
             meta
@@ -131,6 +166,98 @@ class ServeBridge:
         """Enqueue one event (trace replay / programmatic producers)."""
         self.batcher.push(ev)
 
+    def _admit_join(self, ev: ServeEvent) -> int | None:
+        """Admission allocator the batcher calls per EV_JOIN push (elastic
+        sessions): assign the next unused capacity row, or None to park the
+        join for the next geometry promotion.
+
+        Flight-recorder cause chain: the first attempt emits a host
+        TK_JOIN_REQ (its ring position stamped on the event, so a parked
+        join keeps the link across promotions); admission emits TK_JOIN_ACK
+        with ``cause=req``, and parks the ack's position in the ring's
+        ``origin[row]`` causal register — the in-scan TK_JOIN_EV the
+        activation emits picks it up as ITS cause, completing
+        request → ack → admit, and the joiner's first TK_SYNC_ACCEPT chains
+        off the view the admit seeded (tests/test_elastic.py walks it).
+        """
+        ring = self.state.trace
+        if ring is not None and ev.req_pos is None:
+            ev.req_pos = int(jax.device_get(ring.cursor))
+            ring = trace_host_event(
+                ring, TK_JOIN_REQ, int(jax.device_get(self.state.tick)), -1, -1
+            )
+        if self._next_row >= self.params.base.n:
+            if ring is not None:
+                self.state = self.state.replace(trace=ring)
+            return None
+        row = self._next_row
+        self._next_row += 1
+        if ring is not None:
+            ack_pos = int(jax.device_get(ring.cursor))
+            ring = trace_host_event(
+                ring,
+                TK_JOIN_ACK,
+                int(jax.device_get(self.state.tick)),
+                -1,
+                row,
+                cause=-1 if ev.req_pos is None else ev.req_pos,
+            )
+            ring = ring.replace(origin=ring.origin.at[row].set(ack_pos))
+            self.state = self.state.replace(trace=ring)
+        return row
+
+    def promote(self, n_alloc_new: int | None = None) -> dict:
+        """Online geometry promotion: re-home the session at the next
+        capacity tier and replay every join parked for it.
+
+        Checkpoint-based — the state round-trips through
+        save_sparse_checkpoint(``pack_cold=True``) on an in-memory buffer,
+        then sim/checkpoint.py::promote_sparse_state embeds it bit-exactly
+        into ``n_alloc_new`` rows (default: the doubling ladder) — so every
+        promotion exercises the same persistence path a crash-restart
+        would, and live rows resume bit-identical. The launch pipeline is
+        drained by construction (step_batch blocks in _finish_launch before
+        any promotion decision), and the bridge object — transport
+        sessions, SLO tracker, export rows — carries across the recompile:
+        only ``params``/``state`` (and the batcher's width) re-home. The
+        flight recorder's ring pads in place (positions stable), so
+        recorded join cause chains survive.
+
+        Emits a ``kind="promotion"`` row; returns it.
+        """
+        if not self.elastic:
+            raise RuntimeError("promote() needs an elastic session (live_mask)")
+        n_old = self.params.base.n
+        n_new = 2 * n_old if n_alloc_new is None else int(n_alloc_new)
+        t0 = time.monotonic()
+        trace = self.state.trace
+        buf = io.BytesIO()
+        save_sparse_checkpoint(
+            buf, self.state.replace(trace=None), self.params, pack_cold=True
+        )
+        buf.seek(0)
+        state_l, params_l = load_sparse_checkpoint(buf)
+        params_new, state_new = promote_sparse_state(params_l, state_l, n_new)
+        if trace is not None:
+            state_new = state_new.replace(trace=pad_trace_ring(trace, n_new))
+        self.params = params_new
+        self.state = state_new
+        self.batcher.n = n_new
+        self.promotions += 1
+        replayed = self.batcher.replay_deferred_joins()
+        payload = {
+            "n_from": n_old,
+            "n_to": n_new,
+            "promotion": self.promotions,
+            "base_tick": int(jax.device_get(self.state.tick)),
+            "joins_replayed": replayed,
+            "joins_still_deferred": len(self.batcher.deferred_joins),
+            "wall_ms": (time.monotonic() - t0) * 1000.0,
+        }
+        row = make_row("promotion", payload, self.meta)
+        self.rows.append(row)
+        return row
+
     @property
     def ingest_rejected(self) -> int:
         """Malformed-payload rejections across every live source this session."""
@@ -147,7 +274,8 @@ class ServeBridge:
 
     def _execute(self, batch_dev, stats: dict):
         """Dispatch one launch (returns before the device finishes)."""
-        self.state, traces = run_serve_batch(
+        runner = run_serve_batch_elastic if self.elastic else run_serve_batch
+        self.state, traces = runner(
             self.params,
             self.state,
             self.plan,
@@ -218,6 +346,12 @@ class ServeBridge:
             for k in ("kills_fired", "restarts_fired", "gossip_fired",
                       "verdicts_dead", "verdicts_alive"):
                 payload[k] = int(np.sum(traces[k]))
+            if "joins_fired" in traces:
+                payload["joins_fired"] = int(np.sum(traces["joins_fired"]))
+        if self.elastic:
+            # The admission ledger is exact at EVERY launch boundary — a
+            # dropped join fails the session here, not at certification.
+            self.batcher.assert_join_conservation()
         row = make_row("serve_batch", payload, self.meta)
         self.rows.append(row)
         return traces
@@ -229,6 +363,11 @@ class ServeBridge:
         live mode uses it directly so each launch sees the freshest traffic.
         Returns the launch's device-fetched traces (collected mode).
         """
+        if self.elastic and self.auto_promote and self.batcher.deferred_joins:
+            # Capacity ran out since the last launch: grow BEFORE stepping,
+            # so the parked joins ride this very batch (deferred, never
+            # dropped — the self-growing session's steady state).
+            self.promote()
         base = int(jax.device_get(self.state.tick))
         batch_dev, stats = self._assemble(base)
         stats["base_tick"] = base
@@ -335,6 +474,17 @@ class ServeBridge:
         totals["serve_batches"] = self.serve_batches
         totals["ingest_rejected"] = self.ingest_rejected
         totals["ingest_backpressure"] = self.batcher.backpressure_total
+        # Elastic host accounting over the constant-zero schema slots:
+        # joins_admitted keeps the trace sum (in-scan activations — the
+        # device's own count of rows it actually woke); the rest are host
+        # state. joins_deferred and n_live are GAUGES (currently parked /
+        # currently live), window-additive like events_pending, not sums.
+        totals["promotions"] = self.promotions
+        totals["joins_deferred"] = len(self.batcher.deferred_joins)
+        if self.elastic:
+            totals["n_live"] = int(
+                np.asarray(jax.device_get(self.state.live_mask)).sum()
+            )
         return totals
 
     def live_metrics(self) -> dict:
@@ -362,6 +512,15 @@ class ServeBridge:
             "latency_ms_p99": lat.get("p99", 0.0),
             "latency_ms_mean": lat.get("mean", 0.0),
         }
+        if self.elastic:
+            # Growth gauges for the live plane: current tier, occupancy,
+            # and the admission backlog a scrape should alarm on.
+            payload["n_alloc"] = self.params.base.n
+            payload["n_live"] = int(
+                np.asarray(jax.device_get(self.state.live_mask)).sum()
+            )
+            payload["promotions"] = self.promotions
+            payload["joins_deferred"] = len(self.batcher.deferred_joins)
         if self.state.trace is not None:
             for occ in trace_occupancy(self.state.trace):
                 payload[f"trace_occupancy_shard{occ['shard']}"] = occ["cursor"]
@@ -393,6 +552,13 @@ class ServeBridge:
             "latency_ms_p99": lat.get("p99", 0.0),
             "latency_ms_mean": lat.get("mean", 0.0),
         }
+        if self.elastic:
+            payload["n_alloc"] = self.params.base.n
+            payload["n_live"] = int(
+                np.asarray(jax.device_get(self.state.live_mask)).sum()
+            )
+            payload["promotions"] = self.promotions
+            payload["join_ledger"] = self.batcher.join_ledger()
         if self.collect:
             payload["counters"] = self.counters()
         return make_row("serve", payload, self.meta)

@@ -18,7 +18,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from scalecube_cluster_tpu.serve.events import EventBatch, event_masks, event_masks_rapid
+from scalecube_cluster_tpu.serve.events import (
+    EventBatch,
+    event_masks,
+    event_masks_elastic,
+    event_masks_rapid,
+)
 from scalecube_cluster_tpu.sim.faults import FaultPlan, plan_any_faults
 from scalecube_cluster_tpu.sim.knobs import Knobs
 from scalecube_cluster_tpu.sim.rapid import (
@@ -77,6 +82,60 @@ def run_serve_batch(
             metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
             metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
             metrics["gossip_fired"] = jnp.sum(gossip_m, dtype=jnp.int32)
+            metrics["ingest_overflow"] = deferred
+        return new_state, metrics
+
+    return lax.scan(
+        step, state, (batch.node, batch.kind, batch.arg, batch.deferred)
+    )
+
+
+@partial(jax.jit, static_argnums=(0,), static_argnames=("collect",), donate_argnums=(1,))
+def run_serve_batch_elastic(
+    params: SparseParams,
+    state: SparseState,
+    plan: FaultPlan,
+    batch: EventBatch,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Elastic flavor of :func:`run_serve_batch`: the EV_JOIN lane routes to
+    sparse_tick's 4-tuple events path, so live ``join`` traffic activates
+    masked capacity rows in-scan (wire-rate admission) instead of aliasing
+    to restart. Requires an elastic state (``state.live_mask`` attached —
+    init_sparse_full_view ``n_alloc=``); trace extras add ``joins_fired``
+    next to ``gossip_fired``.
+
+    A separate executable from :func:`run_serve_batch` by design: the
+    4-tuple events path is a different traced structure, and keeping the
+    legacy entry untouched is what pins fixed-shape serve sessions
+    bit-identical to pre-elastic builds (the zero-recompile contract is
+    per-entry — one cache line each, tests/test_serve.py).
+    """
+    n = params.base.n
+    g_slots = state.useen.shape[1]
+    dirty = plan_any_faults(plan)
+
+    def step(carry, xs):
+        node, kind, arg, deferred = xs
+        kill_m, restart_m, gossip_m, join_m = event_masks_elastic(
+            node, kind, arg, n, g_slots
+        )
+        new_state, metrics = sparse_tick(
+            params,
+            carry,
+            plan,
+            collect=collect,
+            events=(kill_m, restart_m, gossip_m, join_m),
+            knobs=knobs,
+        )
+        if collect:
+            metrics = dict(metrics)
+            metrics["plan_dirty"] = dirty
+            metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
+            metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+            metrics["gossip_fired"] = jnp.sum(gossip_m, dtype=jnp.int32)
+            metrics["joins_fired"] = jnp.sum(join_m, dtype=jnp.int32)
             metrics["ingest_overflow"] = deferred
         return new_state, metrics
 

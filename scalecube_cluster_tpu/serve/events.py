@@ -109,6 +109,32 @@ def event_masks(
     return kill, restart, gossip
 
 
+def event_masks_elastic(
+    node: jax.Array,
+    kind: jax.Array,
+    arg: jax.Array,
+    n: int,
+    g_slots: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Resolve one batch row for the elastic sparse engine:
+    ``(kill [N], restart [N], gossip [N, G], join [N])``.
+
+    The four lanes of sim/sparse.py::sparse_tick's 4-tuple events path —
+    :func:`event_masks` plus the EV_JOIN lane. A join cell activates a
+    masked capacity row in-scan (apply_events_sparse ``join_mask``): real
+    admission semantics for live ``join`` traffic, replacing the SWIM
+    restart alias (serve/ingest.py ``legacy_join``). Cell-for-cell match
+    with a schedule's ``(t, node, EV_JOIN)`` events yields the same mask
+    values and a bit-identical trajectory — the elastic replay-parity leg
+    (tests/test_elastic.py).
+    """
+    kill, restart, gossip = event_masks(node, kind, arg, n, g_slots)
+    fire = node >= 0
+    safe = jnp.clip(node, 0, n - 1)
+    join = jnp.zeros((n,), bool).at[safe].max(fire & (kind == EV_JOIN))
+    return kill, restart, gossip, join
+
+
 def event_masks_rapid(
     node: jax.Array,
     kind: jax.Array,

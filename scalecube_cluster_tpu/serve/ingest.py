@@ -14,11 +14,30 @@ skipped)::
   it fires the real seed-routed join handshake — request → seed ack with a
   view digest → confirm certificate counted in the next view change
   (sim/rapid.py §4) — giving live ``join`` traffic real admission
-  semantics. SWIM sessions (the default engine) have no join protocol, so
-  the batcher normalizes EV_JOIN to EV_RESTART at push — the historical
-  alias (a join is a fresh identity at a bumped epoch, exactly what an
-  in-scan restart applies), byte-for-byte compatible with pre-join traces.
-- ``node`` — member index in ``[0, n)``.
+  semantics. For SWIM sessions the routing depends on the session's shape:
+
+  - **Elastic sessions** (capacity-tiered state, ``live_mask`` attached;
+    the bridge wires an ``admit`` callback and ``legacy_join=False``):
+    a ``join`` is wire-rate ADMISSION — the batcher asks the bridge's
+    allocator for an unused capacity row, rewrites ``node`` to it, and the
+    elastic engine activates the row in-scan (serve/engine.py::
+    run_serve_batch_elastic). ``node`` may be omitted (or -1): "assign me
+    an identity" — the normal elastic wire form. When every capacity row
+    is taken the join is parked in ``deferred_joins`` — deferred to the
+    next geometry promotion, never dropped — under the conservation
+    contract ``joins_requested == joins_admitted + len(deferred_joins)``
+    (:meth:`EventBatcher.join_ledger`).
+  - **Fixed-shape sessions** (``legacy_join=True``, the default): SWIM has
+    no join protocol, so the batcher normalizes EV_JOIN to EV_RESTART at
+    push — the historical alias (a join is a fresh identity at a bumped
+    epoch, exactly what an in-scan restart applies), byte-for-byte
+    compatible with pre-join traces. TRACE-FORMAT NOTE: elastic sessions
+    therefore change what a recorded ``join`` line replays to — real
+    admission (a TK_JOIN_EV on an assigned row) instead of a restart of
+    the named node; replaying a pre-elastic trace bit-exactly requires a
+    fixed-shape session (or ``legacy_join=True`` explicitly).
+- ``node`` — member index in ``[0, n)``; optional (or -1) for elastic
+  ``join`` events, where admission assigns the row.
 - ``tick`` — optional GLOBAL tick (1-based, the schedule convention) the
   event should fire at; omitted means "as soon as possible" (the earliest
   tick of the next batch with free capacity). Events whose tick already
@@ -111,6 +130,10 @@ class ServeEvent:
     arg: int = 0
     tick: int | None = None
     t_ingest: float | None = None
+    #: Flight-recorder position of this join's TK_JOIN_REQ host event,
+    #: stamped by the elastic bridge at first admission attempt so a join
+    #: that parks for a promotion keeps its request → ack cause link.
+    req_pos: int | None = None
 
 
 def event_from_obj(obj: dict) -> ServeEvent:
@@ -124,11 +147,15 @@ def event_from_obj(obj: dict) -> ServeEvent:
         )
     kind = KIND_ALIASES[kind_name]
     if "node" not in obj:
-        raise ValueError("serve event missing 'node'")
+        if kind != EV_JOIN:
+            raise ValueError("serve event missing 'node'")
+        node = -1  # elastic wire form: admission assigns a capacity row
+    else:
+        node = int(obj["node"])
     tick = obj.get("tick")
     return ServeEvent(
         kind=kind,
-        node=int(obj["node"]),
+        node=node,
         arg=int(obj.get("slot", 0)) if kind == EV_GOSSIP else 0,
         tick=None if tick is None else int(tick),
     )
@@ -201,6 +228,16 @@ class EventBatcher:
     and REJECTS gossip events (Rapid carries no user-gossip plane — a
     gossip cell would be silently inert in the tick, so it is refused at
     validation like any other out-of-contract payload).
+
+    ``legacy_join`` / ``admit`` select the elastic admission plane (module
+    docstring): with an ``admit`` allocator wired, EV_JOIN requests a
+    capacity row at push — assigned rows ride the queue as normal events,
+    exhausted capacity parks the join in ``deferred_joins`` until
+    :meth:`replay_deferred_joins` (after a geometry promotion). With
+    ``legacy_join=False`` and no allocator, EV_JOIN rides intact with its
+    explicit node (scheduled-style elastic activation). The default —
+    ``legacy_join=True``, no allocator — is byte-compatible with every
+    pre-elastic session.
     """
 
     def __init__(
@@ -214,6 +251,8 @@ class EventBatcher:
         low_watermark: int | None = None,
         overflow_policy: str = "defer",
         engine: str = "swim",
+        legacy_join: bool = True,
+        admit=None,
     ):
         if n_ticks < 1 or capacity < 1:
             raise ValueError("need n_ticks >= 1 and capacity >= 1")
@@ -241,6 +280,22 @@ class EventBatcher:
             )
         self.overflow_policy = overflow_policy
         self.engine = engine
+        #: ``True`` (default) keeps the historical SWIM join->restart alias;
+        #: ``False`` lets EV_JOIN ride to the device intact (elastic
+        #: sessions — the bridge resolves this from the state's shape).
+        self.legacy_join = bool(legacy_join)
+        #: Elastic admission allocator: ``admit(ev) -> row | None`` assigns
+        #: an unused capacity row (None = capacity exhausted, park the join
+        #: until promotion). Wired by serve/bridge.py on elastic sessions.
+        self.admit = admit
+        #: Joins parked for the next geometry promotion — deferred, never
+        #: dropped (:meth:`replay_deferred_joins` re-runs admission).
+        self.deferred_joins: deque[ServeEvent] = deque()
+        #: Admission ledger (host accounting; join_ledger() snapshots it).
+        self.joins_requested = 0
+        self.joins_admitted = 0
+        self.joins_placed = 0  # admitted joins that reached a batch row
+        self.joins_shed = 0  # admitted joins lost to shed-oldest (counted)
         self._pending: deque[ServeEvent] = deque()
         #: Session totals (host accounting; the bridge stamps them into rows).
         self.pushed_total = 0
@@ -272,7 +327,8 @@ class EventBatcher:
         never queue room or a pause cycle.
         """
         if not 0 <= ev.node < self.n:
-            raise ValueError(f"event node {ev.node} outside [0, {self.n})")
+            if not (ev.kind == EV_JOIN and ev.node == -1 and self.admit is not None):
+                raise ValueError(f"event node {ev.node} outside [0, {self.n})")
         if ev.kind == EV_GOSSIP and not 0 <= ev.arg < self.g_slots:
             raise ValueError(
                 f"gossip slot {ev.arg} outside [0, {self.g_slots})"
@@ -298,26 +354,112 @@ class EventBatcher:
         this one.
         """
         self.validate(ev)
-        if self.engine == "swim" and ev.kind == EV_JOIN:
-            # Historical alias: SWIM has no join protocol, so a join lands as
-            # the restart event it always was — pre-join replay traces stay
-            # byte-compatible (tests/test_serve.py::test_trace_format_parsing).
-            ev.kind = EV_RESTART
         if self.is_full:
+            # Fullness resolves BEFORE admission: a defer-policy refusal must
+            # leave no trace (no ledger count, no allocated row to leak) so
+            # the caller's retry is idempotent. A join that would merely be
+            # parked (allocator full) pays the same backpressure — refusing
+            # early is conservative and keeps this path single-outcome.
             if self.overflow_policy == "shed-oldest":
-                self._pending.popleft()
+                victim = self._pending.popleft()
                 self.shed_total += 1
+                if victim.kind == EV_JOIN:
+                    self.joins_shed += 1
             else:
                 raise BatcherFull(
                     f"{len(self._pending)} events pending >= "
                     f"max_pending={self.max_pending} (policy=defer)"
                 )
+        if ev.kind == EV_JOIN:
+            if self.admit is not None:
+                # Wire-rate admission (elastic sessions): ask the bridge's
+                # allocator for an unused capacity row. Counted BEFORE the
+                # outcome so the ledger is total: every request is admitted
+                # (rides the queue as a normal event from here) or parked
+                # for the next promotion — never dropped.
+                self.joins_requested += 1
+                row = self.admit(ev)
+                if row is None:
+                    self.deferred_joins.append(ev)
+                    return
+                ev.node = int(row)
+                self.joins_admitted += 1
+            elif self.engine == "swim" and self.legacy_join:
+                # Historical alias: fixed-shape SWIM has no join protocol, so
+                # a join lands as the restart event it always was — pre-join
+                # replay traces stay byte-compatible
+                # (tests/test_serve.py::test_trace_format_parsing).
+                ev.kind = EV_RESTART
+            # else: EV_JOIN rides intact with its explicit node — the Rapid
+            # handshake, or a scheduled-style elastic activation.
         if stamp and ev.t_ingest is None:
             ev.t_ingest = time.monotonic()
         self._pending.append(ev)
         self.pushed_total += 1
         if len(self._pending) > self.peak_pending:
             self.peak_pending = len(self._pending)
+
+    def join_ledger(self) -> dict:
+        """Snapshot of the admission conservation ledger.
+
+        Invariant (checked by :meth:`assert_join_conservation`, asserted at
+        every batch boundary by the elastic bridge)::
+
+            requested == placed + pending + deferred + shed
+
+        — every join request has been served to the device, is admitted and
+        riding the queue, is parked for the next geometry promotion, or was
+        explicitly counted out by the shed-oldest policy; never silently
+        lost. The PR-12 ``pushed == served + pending + shed`` contract
+        covers admitted joins like any other event; this ledger extends it
+        upstream of admission.
+        """
+        pending_joins = sum(1 for e in self._pending if e.kind == EV_JOIN)
+        return {
+            "requested": self.joins_requested,
+            "admitted": self.joins_admitted,
+            "placed": self.joins_placed,
+            "pending": pending_joins,
+            "deferred": len(self.deferred_joins),
+            "shed": self.joins_shed,
+        }
+
+    def assert_join_conservation(self) -> dict:
+        """Raise ``AssertionError`` unless the admission ledger is exact;
+        returns the :meth:`join_ledger` snapshot on success."""
+        led = self.join_ledger()
+        total = led["placed"] + led["pending"] + led["deferred"] + led["shed"]
+        assert led["requested"] == total, (
+            f"join conservation violated: requested={led['requested']} != "
+            f"placed+pending+deferred+shed={total} ({led})"
+        )
+        assert led["admitted"] == led["placed"] + led["pending"] + led["shed"], led
+        return led
+
+    def replay_deferred_joins(self) -> int:
+        """Re-run admission for parked joins (call after a geometry
+        promotion opened capacity). Returns how many were admitted; joins
+        the allocator still cannot place stay parked, FIFO order preserved.
+        """
+        parked, self.deferred_joins = self.deferred_joins, deque()
+        admitted = 0
+        while parked:
+            ev = parked.popleft()
+            # Un-count, then re-push through the full admission path so the
+            # ledger sees one request per join regardless of replay count.
+            self.joins_requested -= 1
+            before = self.joins_admitted
+            try:
+                self.push(ev, stamp=False)
+            except BatcherFull:
+                # Queue backpressure mid-replay: restore the request count
+                # and park everything untried — the next replay retries.
+                self.joins_requested += 1
+                parked.appendleft(ev)
+                self.deferred_joins.extend(parked)
+                break
+            admitted += self.joins_admitted - before
+        return admitted
 
     async def wait_room(self) -> None:
         """Block until the queue drains to ``low_watermark`` (no-op when
@@ -364,6 +506,8 @@ class EventBatcher:
             batch.arg[row, fill[row]] = ev.arg
             fill[row] += 1
             placed += 1
+            if ev.kind == EV_JOIN:
+                self.joins_placed += 1
             if ev.t_ingest is not None:
                 oldest = ev.t_ingest if oldest is None else min(oldest, ev.t_ingest)
         self._pending = keep

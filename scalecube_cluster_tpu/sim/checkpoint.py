@@ -28,6 +28,13 @@ _FIELDS = [f.name for f in dataclasses.fields(SimState)]
 _SPARSE_MAGIC = "__sparse_params__"
 
 
+def _is_fileobj(path) -> bool:
+    """In-memory checkpoint targets (e.g. ``io.BytesIO`` — the online
+    geometry-promotion path, serve/bridge.py) skip all path normalization:
+    np.savez / np.load take file objects directly."""
+    return hasattr(path, "read") or hasattr(path, "write")
+
+
 def _normalize(path: str | Path) -> Path:
     """np.savez appends '.npz' to suffix-less paths; keep load symmetric."""
     path = Path(path)
@@ -113,8 +120,9 @@ def save_sparse_checkpoint(path: str | Path, state, params, *, pack_cold=False) 
     from scalecube_cluster_tpu.sim.sparse import SparseState
     from scalecube_cluster_tpu.sim.state import AGE_STALE
 
-    path = _normalize(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
+    if not _is_fileobj(path):
+        path = _normalize(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
     arrays = {
         f.name: np.asarray(jax.device_get(getattr(state, f.name)))
         for f in dataclasses.fields(SparseState)
@@ -146,10 +154,12 @@ def save_sparse_checkpoint(path: str | Path, state, params, *, pack_cold=False) 
 
 
 def load_sparse_checkpoint(path: str | Path):
-    """Load a sparse-engine snapshot → ``(SparseState, SparseParams)``."""
+    """Load a sparse-engine snapshot → ``(SparseState, SparseParams)``.
+    ``path`` may be a file object (e.g. ``io.BytesIO`` — the in-memory
+    promotion round-trip)."""
     from scalecube_cluster_tpu.sim.sparse import SparseParams, SparseState
 
-    with np.load(_normalize(path)) as data:
+    with np.load(path if _is_fileobj(path) else _normalize(path)) as data:
         if _SPARSE_MAGIC not in data:
             raise ValueError(f"{path} is not a sparse-engine checkpoint")
         raw = json.loads(bytes(data[_SPARSE_MAGIC]).decode())
@@ -173,3 +183,110 @@ def load_sparse_checkpoint(path: str | Path):
         arrays.setdefault("uage", jax.numpy.zeros((n, g), jax.numpy.int32))
         state = SparseState(**arrays)
     return state, params
+
+
+def promote_sparse_state(params, state, n_alloc_new: int):
+    """Geometry promotion (elastic membership): embed an ``n_old``-row
+    sparse state into a fresh ``n_alloc_new``-row allocation, BIT-EXACT on
+    the old rows — every view cell, slab cell, counter plane, the slot
+    tables, tick and rng carry verbatim into the ``[:n_old]`` corner.
+
+    The new capacity rows are the init-time masked form: UNKNOWN along both
+    view axes, dead, stale/zero working planes, ``live_mask`` False. The
+    slot machinery is capacity-axis-free (``slot_subj`` [S] keeps its
+    budget; ``subj_slot`` pads -1), so in-flight suspicion countdowns and
+    tombstone ages survive untouched. ``wb_valid`` drops to False — the
+    carried pin mask was derived on the old viewer axis and must be
+    recomputed (bit-identically) after the geometry change. The flight
+    recorder's event log carries verbatim (ring positions are stable, so
+    recorded join cause chains survive); its causal registers pad empty.
+
+    Protocol constants carry unchanged (``dataclasses.replace(base,
+    n=...)``): the tier ladder keeps cadences and fan-out stable so
+    inter-tier trace segments stay directly comparable — callers wanting
+    n-rescaled constants build their own params for the next tier.
+
+    Returns ``(params_new, state_new)``. Typical online use
+    (serve/bridge.py::ServeBridge.promote) round-trips through
+    :func:`save_sparse_checkpoint`/:func:`load_sparse_checkpoint` on an
+    in-memory buffer first, so promotion exercises the same persistence
+    path a crash-restart would.
+    """
+    import jax.numpy as jnp
+
+    from scalecube_cluster_tpu.obs.tracer import TraceRing, pad_trace_ring
+    from scalecube_cluster_tpu.ops.delivery import GROUP
+    from scalecube_cluster_tpu.sim.state import AGE_STALE
+    from scalecube_cluster_tpu.ops.merge import UNKNOWN_KEY
+
+    n_old = params.base.n
+    if n_alloc_new <= n_old:
+        raise ValueError(
+            f"promotion must grow: n_alloc_new={n_alloc_new} <= n={n_old}"
+        )
+    if n_alloc_new % GROUP != 0:
+        raise ValueError(
+            f"n_alloc_new={n_alloc_new} must be a multiple of {GROUP} "
+            "(delivery group width)"
+        )
+    if state.trace is not None and not isinstance(state.trace, TraceRing):
+        raise ValueError(
+            "promote_sparse_state: sharded trace rings are the explicit-SPMD "
+            "engine's layout; promote with a single ring or trace=None"
+        )
+
+    def grow1(x, fill):
+        return jnp.full((n_alloc_new,), fill, x.dtype).at[:n_old].set(x)
+
+    def grow_rows(x, fill):
+        out = jnp.full((n_alloc_new,) + x.shape[1:], fill, x.dtype)
+        return out.at[:n_old].set(x)
+
+    live_old = (
+        state.live_mask
+        if state.live_mask is not None
+        else jnp.ones((n_old,), bool)
+    )
+    state_new = state.replace(
+        view_T=(
+            jnp.full((n_alloc_new, n_alloc_new), UNKNOWN_KEY, jnp.int32)
+            .at[:n_old, :n_old]
+            .set(state.view_T)
+        ),
+        slot_subj=state.slot_subj,
+        subj_slot=grow1(state.subj_slot, -1),
+        slab=grow_rows(state.slab, UNKNOWN_KEY),
+        age=grow_rows(state.age, AGE_STALE),
+        susp=grow_rows(state.susp, 0),
+        inc_self=grow1(state.inc_self, 0),
+        epoch=grow1(state.epoch, 0),
+        alive=grow1(state.alive, False),
+        useen=grow_rows(state.useen, False),
+        uage=grow_rows(state.uage, 0),
+        uinf_ids=grow_rows(state.uinf_ids, -1),
+        uptr=grow_rows(state.uptr, 0),
+        lat_first_suspect=(
+            None
+            if state.lat_first_suspect is None
+            else grow1(state.lat_first_suspect, -1)
+        ),
+        lat_first_dead=(
+            None
+            if state.lat_first_dead is None
+            else grow1(state.lat_first_dead, -1)
+        ),
+        wb_valid=(
+            None
+            if state.wb_valid is None
+            else jnp.zeros((), bool)
+        ),
+        trace=(
+            None
+            if state.trace is None
+            else pad_trace_ring(state.trace, n_alloc_new)
+        ),
+        live_mask=grow1(live_old, False),
+    )
+    return dataclasses.replace(
+        params, base=dataclasses.replace(params.base, n=n_alloc_new)
+    ), state_new

@@ -139,6 +139,7 @@ from scalecube_cluster_tpu.obs.tracer import (
     TK_VOTE,
     TraceRing,
     init_trace_ring,
+    pad_trace_ring,
     trace_emit,
     trace_reset_members,
 )
@@ -321,6 +322,13 @@ class RapidState:
     #: is the structure gate: the pytree, the compiled tick and every
     #: trajectory stay bit-identical to the pre-fallback engine.
     fb: FallbackState | None = None
+    #: Elastic membership (capacity-tiered clusters): True for rows whose
+    #: identity has ever been live; False rows are pre-allocated capacity
+    #: (dead singletons, outside every live member's view) that a scheduled
+    #: join activates in-scan. None (the default) is the fixed-shape
+    #: cluster — pytree and compiled tick bit-identical to pre-elastic
+    #: builds (same structure gate as ``trace``/``fb``).
+    live_mask: jax.Array | None = None  # [N] bool
 
     def replace(self, **changes) -> "RapidState":
         return dataclasses.replace(self, **changes)
@@ -376,6 +384,7 @@ def init_rapid_full_view(
     seed: int = 0,
     trace_capacity: int = 0,
     fallback: bool = False,
+    n_live: int | None = None,
 ) -> RapidState:
     """Post-bootstrap steady state: every member holds configuration 0 =
     the full membership (the Rapid seed view), no alarms pending.
@@ -384,10 +393,34 @@ def init_rapid_full_view(
     (obs/tracer.py); 0 keeps the state pytree identical to pre-recorder
     builds. ``fallback=True`` attaches the classic-Paxos fallback + join
     handshake plane (:class:`FallbackState`); False keeps the pre-fallback
-    pytree and compiled tick bit-identical."""
+    pytree and compiled tick bit-identical.
+
+    ``n_live`` (elastic membership): start only the first ``n_live`` of the
+    ``params.n`` allocated rows live — configuration 0 is the live cohort,
+    and the remaining rows are dead capacity a scheduled join activates
+    in-scan. A subject's detecting observers are its ring SUCCESSORS
+    (:func:`observer_matrix`), so grow DOWNWARD from row ``params.n - 1``:
+    the top row's observers wrap to the live rows 0..k-1, and each joiner
+    becomes the next one's observer — a joiner whose successors are all
+    dead capacity can never accumulate the H join-alarms admission needs
+    (its join parks until a promotion re-homes the ring). ``None`` (or
+    ``n_live == params.n``) is the fixed-shape init: ``live_mask`` stays
+    ``None`` and the state is bit-identical to pre-elastic builds."""
     n = params.n
+    if n_live is None or n_live == n:
+        live = None
+        mm = jnp.ones((n, n), bool)
+        alive = jnp.ones((n,), bool)
+    else:
+        if not 0 < n_live < n:
+            raise ValueError(f"n_live={n_live} outside (0, {n})")
+        live = jnp.arange(n, dtype=jnp.int32) < n_live
+        # Live members hold the live cohort as configuration 0; capacity
+        # rows are dead singletons ({self}) outside every live view.
+        mm = (live[:, None] & live[None, :]) | jnp.eye(n, dtype=bool)
+        alive = live
     return RapidState(
-        member_mask=jnp.ones((n, n), bool),
+        member_mask=mm,
         view_id=jnp.zeros((n,), jnp.int32),
         edge_fail=jnp.zeros((n, params.k), jnp.int32),
         edge_join=jnp.zeros((n, params.k), jnp.int32),
@@ -395,12 +428,94 @@ def init_rapid_full_view(
         vote_add=jnp.zeros((n, n), bool),
         voted=jnp.zeros((n,), bool),
         epoch=jnp.zeros((n,), jnp.int32),
-        alive=jnp.ones((n,), bool),
+        alive=alive,
         tick=jnp.zeros((), jnp.int32),
         rng=jax.random.PRNGKey(seed),
         trace=init_trace_ring(n, trace_capacity) if trace_capacity else None,
         fb=init_fallback_state(n) if fallback else None,
+        # Distinct buffer from ``alive`` (donating callers).
+        live_mask=None if live is None else live.copy(),
     )
+
+
+def promote_rapid_state(
+    params: RapidParams, state: RapidState, n_new: int
+) -> tuple[RapidParams, RapidState]:
+    """Geometry promotion (elastic membership): embed an ``n_old``-row Rapid
+    state into a fresh ``n_new``-row allocation, VERBATIM on the old rows.
+
+    Views, votes, epochs, view ids, tick and rng all carry bit-exactly into
+    the ``[:n_old, :n_old]`` corner; the new capacity rows are dead
+    singletons outside every view. The per-edge probe counters are the one
+    documented exception: the observer ring is a function of ``n``, so
+    promotion re-homes edge ownership — stale counts under new owners would
+    mis-attribute detections, and both planes re-arm at 0 instead (pure
+    liveness delay of at most ``high_watermark`` probe periods; safety
+    ledgers are untouched). The flight recorder's event log carries verbatim
+    (positions are stable — cause chains survive); its causal registers pad
+    with empty rows. Returns ``(params_new, state_new)``.
+    """
+    n_old = params.n
+    if n_new <= n_old:
+        raise ValueError(f"promotion must grow: n_new={n_new} <= n={n_old}")
+
+    def grow1(x, fill):
+        return jnp.full((n_new,), fill, x.dtype).at[:n_old].set(x)
+
+    def grow2(x, fill):
+        return (
+            jnp.full((n_new, n_new), fill, x.dtype)
+            .at[:n_old, :n_old]
+            .set(x)
+        )
+
+    live_old = (
+        state.live_mask
+        if state.live_mask is not None
+        else jnp.ones((n_old,), bool)
+    )
+    fb = state.fb
+    if fb is not None:
+        fb0 = init_fallback_state(n_new)
+        fb = fb0.replace(
+            wait=grow1(fb.wait, 0),
+            promised=grow1(fb.promised, 0),
+            acc_rank=grow1(fb.acc_rank, -1),
+            acc_rm=grow2(fb.acc_rm, False),
+            acc_add=grow2(fb.acc_add, False),
+            prop_rm=grow2(fb.prop_rm, False),
+            prop_add=grow2(fb.prop_add, False),
+            prop_ready=grow1(fb.prop_ready, False),
+            decided=grow1(fb.decided, False),
+            join_phase=grow1(fb.join_phase, 0),
+            # Old rows keep their seed candidate (still a valid member id);
+            # new rows take the fresh init's ring-successor default.
+            join_seed=fb0.join_seed.at[:n_old].set(fb.join_seed),
+            join_tries=grow1(fb.join_tries, 0),
+            join_digest=grow1(fb.join_digest, 0),
+            join_ok=grow2(fb.join_ok, False),
+        )
+    state_new = RapidState(
+        member_mask=grow2(state.member_mask, False) | jnp.eye(n_new, dtype=bool),
+        view_id=grow1(state.view_id, 0),
+        edge_fail=jnp.zeros((n_new, params.k), jnp.int32),
+        edge_join=jnp.zeros((n_new, params.k), jnp.int32),
+        vote_rm=grow2(state.vote_rm, False),
+        vote_add=grow2(state.vote_add, False),
+        voted=grow1(state.voted, False),
+        epoch=grow1(state.epoch, 0),
+        alive=grow1(state.alive, False),
+        tick=state.tick,
+        rng=state.rng,
+        trace=(
+            pad_trace_ring(state.trace, n_new)
+            if state.trace is not None
+            else None
+        ),
+        fb=fb,
+        live_mask=grow1(live_old, False),
+    )
+    return dataclasses.replace(params, n=n_new), state_new
 
 
 def apply_events_rapid(
@@ -439,13 +554,29 @@ def apply_events_rapid(
             st.epoch,
         )
         row = fresh[:, None]
-        if join_mask is None:
-            mm = jnp.where(row, True, st.member_mask)
+        if st.live_mask is None:
+            boot = jnp.ones((n,), bool)
         else:
-            # Restarts keep the bootstrap full view; protocol joins start
-            # as a singleton {self} and re-enter through the handshake.
-            mm = jnp.where(restart_mask[:, None], True, st.member_mask)
+            # Elastic cluster: the "bootstrap" a restarted member reloads is
+            # the ever-live cohort, not the full allocation — capacity rows
+            # that never joined must stay outside every view (R-ledgers).
+            boot = st.live_mask | fresh
+        if join_mask is None:
+            mm = jnp.where(row, boot[None, :], st.member_mask)
+        elif st.fb is not None:
+            # Restarts keep the bootstrap view; protocol joins start as a
+            # singleton {self} and re-enter through the handshake.
+            mm = jnp.where(restart_mask[:, None], boot[None, :], st.member_mask)
             mm = jnp.where(join_mask[:, None], jnp.eye(n, dtype=bool), mm)
+        else:
+            # Elastic capacity activation without the handshake plane: the
+            # scheduled join IS the control plane's admission, so the joiner
+            # bootstraps the ever-live cohort view like a restart (it
+            # catches up through view sync; the cluster admits it through
+            # the edge-join alarm pipeline). A singleton {self} start would
+            # be a degenerate one-member configuration claiming its own
+            # majority — exactly the split-brain shape R3 exists to reject.
+            mm = jnp.where(row, boot[None, :], st.member_mask)
         reset_edges = fresh[obs] | fresh[:, None]
         st = st.replace(
             alive=(st.alive & ~kill_mask) | fresh,
@@ -458,6 +589,8 @@ def apply_events_rapid(
             vote_add=jnp.where(row, False, st.vote_add),
             voted=st.voted & ~fresh,
         )
+        if st.live_mask is not None:
+            st = st.replace(live_mask=st.live_mask | fresh)
         if st.fb is not None:
             fb = st.fb
             touched = kill_mask | fresh
@@ -1172,6 +1305,18 @@ def rapid_tick(
         # Monotonicity gauges (inc_max has no Rapid analog: constant 0).
         "inc_max": zero,
         "epoch_max": jnp.max(state.epoch),
+        # Elastic-membership counters: scheduled joins are counted by the
+        # scan driver (joins_fired); the in-tick admission slot and the
+        # host-side deferral/promotion slots stay constant zero here, and
+        # the live-member gauge is live only on capacity-tiered states.
+        "joins_admitted": zero,
+        "joins_deferred": zero,
+        "promotions": zero,
+        "n_live": (
+            jnp.sum(state.live_mask, dtype=jnp.int32)
+            if state.live_mask is not None
+            else zero
+        ),
         # Consistency plane, per member — the R1-R4 certifier's input.
         "view_id": vid3,
         "view_digest": view_digest(mm3),
@@ -1201,9 +1346,12 @@ def scan_rapid_ticks(
         join_m = None
         if scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
             t = carry.tick + 1  # the global tick about to execute
-            if carry.fb is not None:
-                # Join-aware resolution: same plan, plus the EV_JOIN lane.
-                # The fb-None path keeps the exact legacy resolve_tick call
+            if carry.fb is not None or carry.live_mask is not None:
+                # Join-aware resolution: same plan, plus the EV_JOIN lane
+                # (handshake joins with the fallback plane attached; elastic
+                # capacity activations with a live_mask attached — both are
+                # trace-time constants by pytree structure). The gate-off
+                # path keeps the exact legacy resolve_tick call
                 # (bit-identical graph, pinned by the PR-6 golden).
                 plan_t = plan_at(plan, t)
                 kill_m, restart_m, join_m = rapid_events_at(

@@ -373,11 +373,15 @@ class ScheduleBuilder:
         bumped epoch, view = {self}, and — on Rapid engines with
         ``fallback=True`` — the seed-routed join handshake armed. Models a
         process that must *re-enter through the join protocol* rather than a
-        restart that keeps the bootstrap view. Engines without a join
-        protocol (SWIM, Rapid with ``fallback=False``) resolve events through
-        :func:`events_at` and silently skip kind-3 slots; schedule joins only
-        against the join-aware Rapid path. Joins spend the same EPOCH_MAX
-        budget as restarts."""
+        restart that keeps the bootstrap view. Join-aware paths: the Rapid
+        handshake above, elastic Rapid (``init_rapid_full_view(...,
+        n_live=)``, where a join activates a masked capacity row), and
+        elastic sparse (``init_sparse_full_view(..., n_alloc=)``, in-scan
+        admission of ``node`` into unused capacity). Engines without a join
+        protocol (dense SWIM, fixed-shape sparse, Rapid with
+        ``fallback=False``) resolve events through :func:`events_at` and
+        silently skip kind-3 slots; schedule joins only against a join-aware
+        path. Joins spend the same EPOCH_MAX budget as restarts."""
         self._events.append((int(tick), int(node), EV_JOIN))
         return self
 

@@ -109,6 +109,7 @@ from scalecube_cluster_tpu.sim.knobs import Knobs, edge_live, suspicion_fill
 from scalecube_cluster_tpu.sim.params import SimParams
 from scalecube_cluster_tpu.obs.tracer import (
     TK_GOSSIP_EDGE,
+    TK_JOIN_EV,
     TK_KILL,
     TK_PROBE_MISSED,
     TK_PROBE_SENT,
@@ -128,7 +129,9 @@ from scalecube_cluster_tpu.obs.tracer import (
 from scalecube_cluster_tpu.obs.trace import DEAD_VIA_EXPIRY, DEAD_VIA_GOSSIP
 from scalecube_cluster_tpu.sim.schedule import (
     FaultSchedule,
+    plan_at,
     plan_dirty_at,
+    rapid_events_at,
     resolve_tick,
 )
 from scalecube_cluster_tpu.sim.state import AGE_STALE
@@ -370,6 +373,14 @@ class SparseState:
     # XLA tick core (sparse_tick raises under pallas_core, and the SPMD
     # engine rejects it in _validate).
     trace: TraceRing | ShardTraceRing | None = None
+    # Elastic membership (capacity-tiered clusters): True for rows whose
+    # identity has ever been live; False rows are pre-allocated capacity —
+    # dead, all-UNKNOWN in every view, invisible to FD/SYNC/gossip until a
+    # scheduled/served join activates them in-scan. None (the default) is
+    # the fixed-shape cluster: the pytree — and every compiled executable —
+    # stays bit-identical to pre-elastic builds (same structure-gating as
+    # the recorder arrays above).
+    live_mask: jax.Array | None = None  # [N] bool
 
     def replace(self, **changes) -> "SparseState":
         return dataclasses.replace(self, **changes)
@@ -384,6 +395,7 @@ def init_sparse_full_view(
     record_latency: bool = False,
     trace_capacity: int = 0,
     trace_shards: int = 0,
+    n_alloc: int | None = None,
 ) -> SparseState:
     """Post-join steady state, nothing active: the common 100k starting point.
 
@@ -404,37 +416,74 @@ def init_sparse_full_view(
     events each, the explicit-SPMD engine's layout (parallel/spmd.py;
     ``trace_shards`` must equal the engine's ``ShardConfig.d``). Only that
     engine accepts it: sparse_tick rejects a ShardTraceRing.
+
+    ``n_alloc`` (elastic membership): allocate ``n_alloc >= n`` member rows
+    but start only the first ``n`` live — the rest are pre-allocated
+    capacity (dead, all-UNKNOWN in every view, masked out of FD/SYNC/gossip
+    by the same rules that make any dead unknown identity inert) that a
+    scheduled or served ``join`` activates in-scan without a recompile.
+    ``None`` (or ``n_alloc == n``) is the fixed-shape init: ``live_mask``
+    stays ``None`` and the state is bit-identical — same pytree structure,
+    same executables — to pre-elastic builds. The caller's ``SparseParams``
+    must be built for ``n_alloc`` (that is the traced member axis).
     """
+    if n_alloc is None or n_alloc == n:
+        na = n
+        live = None
+        view_T = jnp.full((na, na), encode_key(0, 0), jnp.int32)
+        alive = jnp.ones((na,), bool)
+    else:
+        if n_alloc < n:
+            raise ValueError(f"n_alloc={n_alloc} < n_live={n}")
+        if n_alloc % GROUP != 0:
+            raise ValueError(
+                f"n_alloc={n_alloc} must be a multiple of {GROUP} "
+                "(structured fan-out group)"
+            )
+        na = n_alloc
+        live = jnp.arange(na, dtype=jnp.int32) < n
+        # Live members know each other ALIVE@inc0 (the full-view steady
+        # state); capacity rows are UNKNOWN along BOTH axes — nobody knows
+        # them, they know nobody.
+        view_T = jnp.where(
+            live[:, None] & live[None, :],
+            jnp.asarray(encode_key(0, 0), jnp.int32),
+            jnp.asarray(UNKNOWN_KEY, jnp.int32),
+        )
+        alive = live
     return SparseState(
-        view_T=jnp.full((n, n), encode_key(0, 0), jnp.int32),
+        view_T=view_T,
         slot_subj=jnp.full((slot_budget,), -1, jnp.int32),
-        subj_slot=jnp.full((n,), -1, jnp.int32),
-        slab=jnp.full((n, slot_budget), UNKNOWN_KEY, jnp.int32),
-        age=jnp.full((n, slot_budget), AGE_STALE, jnp.int8),
-        susp=jnp.zeros((n, slot_budget), jnp.int16),
-        inc_self=jnp.zeros((n,), jnp.int32),
-        epoch=jnp.zeros((n,), jnp.int32),
-        alive=jnp.ones((n,), bool),
-        useen=jnp.zeros((n, user_gossip_slots), bool),
-        uage=jnp.zeros((n, user_gossip_slots), jnp.int32),
-        uinf_ids=jnp.full((n, user_gossip_slots, infected_k), -1, jnp.int32),
-        uptr=jnp.zeros((n, user_gossip_slots), jnp.int32),
+        subj_slot=jnp.full((na,), -1, jnp.int32),
+        slab=jnp.full((na, slot_budget), UNKNOWN_KEY, jnp.int32),
+        age=jnp.full((na, slot_budget), AGE_STALE, jnp.int8),
+        susp=jnp.zeros((na, slot_budget), jnp.int16),
+        inc_self=jnp.zeros((na,), jnp.int32),
+        epoch=jnp.zeros((na,), jnp.int32),
+        alive=alive,
+        useen=jnp.zeros((na, user_gossip_slots), bool),
+        uage=jnp.zeros((na, user_gossip_slots), jnp.int32),
+        uinf_ids=jnp.full((na, user_gossip_slots, infected_k), -1, jnp.int32),
+        uptr=jnp.zeros((na, user_gossip_slots), jnp.int32),
         tick=jnp.zeros((), jnp.int32),
         rng=jax.random.PRNGKey(seed),
         lat_first_suspect=(
-            jnp.full((n,), -1, jnp.int32) if record_latency else None
+            jnp.full((na,), -1, jnp.int32) if record_latency else None
         ),
         lat_first_dead=(
-            jnp.full((n,), -1, jnp.int32) if record_latency else None
+            jnp.full((na,), -1, jnp.int32) if record_latency else None
         ),
         wb_pinned=jnp.zeros((slot_budget,), bool),
         wb_valid=jnp.zeros((), bool),
         trace=(
-            init_shard_trace_rings(n, trace_capacity, trace_shards)
+            init_shard_trace_rings(na, trace_capacity, trace_shards)
             if trace_capacity and trace_shards
-            else init_trace_ring(n, trace_capacity) if trace_capacity
+            else init_trace_ring(na, trace_capacity) if trace_capacity
             else None
         ),
+        # Distinct buffer from ``alive`` (same values at init): the donating
+        # runners reject one buffer appearing as two donated leaves.
+        live_mask=None if live is None else live.copy(),
     )
 
 
@@ -657,6 +706,7 @@ def apply_events_sparse(
     kill_mask: jax.Array,
     restart_mask: jax.Array,
     gossip_mask: jax.Array | None = None,
+    join_mask: jax.Array | None = None,
 ) -> SparseState:
     """In-scan scheduled kill/restart for the sparse engine (sim/schedule.py).
 
@@ -684,39 +734,55 @@ def apply_events_sparse(
     would (pure metadata arrays — no write-back invalidation needed, no
     RNG). Passing ``None`` keeps the scheduled-events graph byte-identical
     to before the serve bridge existed.
+
+    ``join_mask`` ([N] bool, optional — elastic membership) activates
+    pre-allocated capacity rows as NEW identities: the same cold-row wipe
+    and epoch bump as a restart (a join of a never-lived row bumps epoch
+    0→1 — epochs are identity generations, and generation 0 is reserved
+    for the init-time cohort), plus ``live_mask``. The joiner announces
+    itself through the same step-3 slot path as a restart; the cluster
+    learns it via that young ALIVE self-record riding normal gossip, and
+    the joiner seeds its own view via the existing SYNC intro rule
+    (:func:`sync_accept` — an ALIVE record may introduce an unknown
+    identity). ``None`` keeps the 2-/3-tuple graphs byte-identical.
     """
     n = state.alive.shape[0]
-    any_ev = jnp.any(kill_mask | restart_mask)
+    fresh_mask = (
+        restart_mask if join_mask is None else restart_mask | join_mask
+    )
+    any_ev = jnp.any(kill_mask | fresh_mask)
     if gossip_mask is not None:
         any_ev = any_ev | jnp.any(gossip_mask)
 
     def apply(state: SparseState) -> SparseState:
         new_epoch = jnp.where(
-            restart_mask, jnp.minimum(state.epoch + 1, EPOCH_MAX), state.epoch
+            fresh_mask, jnp.minimum(state.epoch + 1, EPOCH_MAX), state.epoch
         )
         uinf_ids = state.uinf_ids
         if uinf_ids.shape[2] > 0:
             # A restarted sender is a new identity: scrub it from every
             # suppression ring, and clear the node's own rings.
-            hit = (uinf_ids >= 0) & restart_mask[jnp.clip(uinf_ids, 0, n - 1)]
+            hit = (uinf_ids >= 0) & fresh_mask[jnp.clip(uinf_ids, 0, n - 1)]
             uinf_ids = jnp.where(hit, -1, uinf_ids)
-            uinf_ids = jnp.where(restart_mask[:, None, None], -1, uinf_ids)
+            uinf_ids = jnp.where(fresh_mask[:, None, None], -1, uinf_ids)
         st = state.replace(
-            alive=(state.alive & ~kill_mask) | restart_mask,
+            alive=(state.alive & ~kill_mask) | fresh_mask,
             epoch=new_epoch,
-            inc_self=jnp.where(restart_mask, 0, state.inc_self),
+            inc_self=jnp.where(fresh_mask, 0, state.inc_self),
             # The restarted node's working row restarts cold: nothing young,
             # no armed timers (its pre-crash countdowns died with it).
             age=jnp.where(
-                restart_mask[:, None], jnp.asarray(AGE_STALE, jnp.int8), state.age
+                fresh_mask[:, None], jnp.asarray(AGE_STALE, jnp.int8), state.age
             ),
             susp=jnp.where(
-                restart_mask[:, None], jnp.asarray(0, jnp.int16), state.susp
+                fresh_mask[:, None], jnp.asarray(0, jnp.int16), state.susp
             ),
-            useen=jnp.where(restart_mask[:, None], False, state.useen),
-            uptr=jnp.where(restart_mask[:, None], 0, state.uptr),
+            useen=jnp.where(fresh_mask[:, None], False, state.useen),
+            uptr=jnp.where(fresh_mask[:, None], 0, state.uptr),
             uinf_ids=uinf_ids,
         )
+        if join_mask is not None and st.live_mask is not None:
+            st = st.replace(live_mask=st.live_mask | join_mask)
         if gossip_mask is not None:
             # After the restart wipe, matching the host-side op order
             # (kill/restart, then spreadGossip) between tick calls.
@@ -727,9 +793,9 @@ def apply_events_sparse(
         if st.lat_first_suspect is not None:
             st = st.replace(
                 lat_first_suspect=jnp.where(
-                    restart_mask, -1, st.lat_first_suspect
+                    fresh_mask, -1, st.lat_first_suspect
                 ),
-                lat_first_dead=jnp.where(restart_mask, -1, st.lat_first_dead),
+                lat_first_dead=jnp.where(fresh_mask, -1, st.lat_first_dead),
             )
         if st.wb_valid is not None:
             # alive/age/susp changed: the carried pin mask is stale
@@ -748,7 +814,18 @@ def apply_events_sparse(
             ring, _ = trace_emit(
                 ring, TK_RESTART, restart_mask, t_ev, -1, col_ev
             )
-            ring = trace_reset_members(ring, restart_mask)
+            if join_mask is not None:
+                # Join cause chain (REQ → ACK → this admit → first SYNC):
+                # the serving bridge stamps the joiner's TK_JOIN_ACK ring
+                # position into ``origin`` at admission time, so the in-scan
+                # admit event links back to the wire handshake. Scheduled
+                # joins (no handshake) carry cause -1 — origin is gathered
+                # BEFORE the reset below clears the fresh identities.
+                ring, _ = trace_emit(
+                    ring, TK_JOIN_EV, join_mask, t_ev, -1, col_ev,
+                    cause=ring.origin,
+                )
+            ring = trace_reset_members(ring, fresh_mask)
             if gossip_mask is not None:
                 g = gossip_mask.shape[1]
                 ring, _ = trace_emit(
@@ -1090,15 +1167,18 @@ def sparse_tick(
 
     ``events`` is ``None`` (no scheduled events — the default graph, traced
     structure unchanged), a ``(kill_mask, restart_mask)`` pair of [N]
-    bools from sim/schedule.py::events_at, or a
+    bools from sim/schedule.py::events_at, a
     ``(kill_mask, restart_mask, gossip_mask)`` triple (the serving bridge's
-    [N, G] user-gossip injections, serve/events.py) — applied before the
-    tick body (:func:`apply_events_sparse`); a restarted node additionally
-    requests its own slot through the step-3 activation path and announces
-    its bumped-epoch identity there. The tuple arity is pytree structure,
-    so each form keeps its own cached executable and the 2-tuple graph is
-    unchanged by the 3-tuple's existence. Events consume no RNG, so an
-    event-free scheduled tick is bit-identical to the fixed-plan tick.
+    [N, G] user-gossip injections, serve/events.py), or a
+    ``(kill_mask, restart_mask, gossip_mask, join_mask)`` 4-tuple (elastic
+    membership — ``gossip_mask`` may itself be ``None`` there) — applied
+    before the tick body (:func:`apply_events_sparse`); a restarted OR
+    joining node additionally requests its own slot through the step-3
+    activation path and announces its (bumped-epoch) identity there. The
+    tuple arity is pytree structure, so each form keeps its own cached
+    executable and the 2-tuple graph is unchanged by the 3-/4-tuple's
+    existence. Events consume no RNG, so an event-free scheduled tick is
+    bit-identical to the fixed-plan tick.
 
     ``knobs`` (sim/knobs.py) threads per-run protocol scalars as traced
     data — identity knobs are bit-identical to ``knobs=None``; the ensemble
@@ -1115,8 +1195,14 @@ def sparse_tick(
         )
     if events is not None:
         gossip_m = events[2] if len(events) > 2 else None
-        state = apply_events_sparse(state, events[0], events[1], gossip_m)
-        restart_m = events[1]
+        join_m = events[3] if len(events) > 3 else None
+        state = apply_events_sparse(
+            state, events[0], events[1], gossip_m, join_m
+        )
+        # Restarts AND joins both announce fresh identities via step 3.
+        fresh_m = events[1] if join_m is None else events[1] | join_m
+    else:
+        join_m = None
     t = state.tick + 1
     (rng_next, k_tgt, k_ping, k_relay, k_gsel, k_glink, k_ssel, k_slink) = (
         jax.random.split(state.rng, 8)
@@ -1247,16 +1333,32 @@ def sparse_tick(
         )
         req = req | self_threat_pre
     if events is not None:
-        # A restarted node must announce its new identity: request its own
-        # subject's slot so the post-load announce below has a cell to
-        # write. May lose the alloc_cap race under contention — the next
+        # A restarted/joined node must announce its new identity: request
+        # its own subject's slot so the post-load announce below has a cell
+        # to write. May lose the alloc_cap race under contention — the next
         # FD/SYNC touch re-requests (the chaos sampler caps restarts per
         # tick at alloc_cap so scheduled restarts always land).
-        req = req | restart_m
+        req = req | fresh_m
     req = req & (subj_slot < 0)
     # Rank requests; grant the first alloc_cap into the first free slots.
     cap = params.alloc_cap
-    req_rank = jnp.cumsum(req.astype(jnp.int32)) - 1  # rank among requests
+    if events is not None and join_m is not None:
+        # Elastic runs: fresh activations (join/restart) outrank organic
+        # FD/SYNC/sweep requests. The self-announce below fires only on the
+        # event tick — a join that loses the grant race to a coincident
+        # sweep never announces and its identity is silently dropped (the
+        # row stays invisible forever: nobody probes or SYNCs an unknown
+        # subject). Legacy runs (no join lane) keep the flat ranking, so
+        # fixed-shape trajectories stay bit-identical.
+        fresh_req = req & fresh_m
+        n_fresh_req = jnp.sum(fresh_req.astype(jnp.int32))
+        rank_fresh = jnp.cumsum(fresh_req.astype(jnp.int32)) - 1
+        rank_rest = (
+            jnp.cumsum((req & ~fresh_m).astype(jnp.int32)) - 1 + n_fresh_req
+        )
+        req_rank = jnp.where(fresh_req, rank_fresh, rank_rest)
+    else:
+        req_rank = jnp.cumsum(req.astype(jnp.int32)) - 1  # rank among requests
     granted = req & (req_rank < cap)
     free_slots = jnp.flatnonzero(slot_subj < 0, size=cap, fill_value=S - 1)
     n_free = jnp.sum(slot_subj < 0)
@@ -1293,7 +1395,7 @@ def sparse_tick(
     active = slot_subj >= 0
 
     if events is not None:
-        # Restart self-announce: the restarted node writes its bumped-epoch
+        # Restart/join self-announce: the fresh node writes its bumped-epoch
         # ALIVE key into its own row's own-subject cell, young (age 0) so it
         # gossips out this very tick — the sparse twin of the fresh
         # self-record a dense restart seeds. Placed BEFORE the slab0
@@ -1301,7 +1403,7 @@ def sparse_tick(
         # verdict, so it must not count as verdicts_alive (dense parity —
         # events there apply before sim_tick entirely).
         r_slot = subj_slot[col]
-        r_fire = restart_m & (r_slot >= 0)
+        r_fire = fresh_m & (r_slot >= 0)
         r_safe = jnp.where(r_fire, r_slot, 0)
         r_key = encode_key(
             jnp.full((n,), _ALIVE, jnp.int32),
@@ -1905,6 +2007,22 @@ def sparse_tick(
         "ingest_rejected": jnp.zeros((), jnp.int32),
         "ingest_backpressure": jnp.zeros((), jnp.int32),
         "serve_batches": jnp.zeros((), jnp.int32),
+        # Elastic-membership counters (capacity-tiered clusters): in-scan
+        # join activations and the live-member gauge. Deferral and
+        # promotion are HOST phenomena (serve/bridge.py stamps them); the
+        # tick's slots stay constant zero so the schema is uniform.
+        "joins_admitted": (
+            jnp.sum(join_m, dtype=jnp.int32)
+            if join_m is not None
+            else jnp.zeros((), jnp.int32)
+        ),
+        "joins_deferred": jnp.zeros((), jnp.int32),
+        "promotions": jnp.zeros((), jnp.int32),
+        "n_live": (
+            jnp.sum(new_state.live_mask, dtype=jnp.int32)
+            if new_state.live_mask is not None
+            else jnp.zeros((), jnp.int32)
+        ),
     }
     if ring is not None:
         # Lossless ring accounting (emitted == recorded + overflow): the
@@ -1926,6 +2044,10 @@ def scan_sparse_ticks(
     ensemble engine (sim/ensemble.py) vmaps directly, so donation lives only
     on the outer jit (never jit-in-jit)."""
     scheduled = isinstance(plan, FaultSchedule)
+    # Elastic states (live_mask attached — trace-time constant by pytree
+    # structure) consume the schedule's EV_JOIN lane too: joins activate
+    # capacity rows in-scan. Fixed-shape states keep the 2-tuple graph.
+    elastic = state.live_mask is not None
 
     def step(carry, _):
         if not scheduled:  # tpulint: disable=R1 -- trace-time constant (isinstance on the plan's pytree type), not a traced value
@@ -1934,13 +2056,22 @@ def scan_sparse_ticks(
         # Event ingestion, split from the tick core (sim/schedule.py): the
         # schedule is one producer of per-tick event masks; the serving
         # bridge (serve/engine.py) feeds the same contract from live traffic.
-        plan_t, (kill_m, restart_m) = resolve_tick(plan, t, params.base.n)
+        if elastic:
+            plan_t = plan_at(plan, t)
+            kill_m, restart_m, join_m = rapid_events_at(
+                plan, t, params.base.n
+            )
+            events = (kill_m, restart_m, None, join_m)
+        else:
+            plan_t, (kill_m, restart_m) = resolve_tick(plan, t, params.base.n)
+            join_m = None
+            events = (kill_m, restart_m)
         new_state, metrics = sparse_tick(
             params,
             carry,
             plan_t,
             collect=collect,
-            events=(kill_m, restart_m),
+            events=events,
             knobs=knobs,
         )
         if collect:
@@ -1948,6 +2079,8 @@ def scan_sparse_ticks(
             metrics["plan_dirty"] = plan_dirty_at(plan, t)
             metrics["kills_fired"] = jnp.sum(kill_m, dtype=jnp.int32)
             metrics["restarts_fired"] = jnp.sum(restart_m, dtype=jnp.int32)
+            if join_m is not None:
+                metrics["joins_fired"] = jnp.sum(join_m, dtype=jnp.int32)
             if plan.link_world is not None:
                 metrics.update(
                     zone_tick_metrics(

@@ -852,5 +852,12 @@ def sim_tick(
         "ingest_rejected": jnp.zeros((), jnp.int32),
         "ingest_backpressure": jnp.zeros((), jnp.int32),
         "serve_batches": jnp.zeros((), jnp.int32),
+        # Elastic-membership counters (capacity-tiered clusters,
+        # sim/sparse.py elastic path + serve/bridge.py): this engine has no
+        # capacity rows, so the schema slots are constant zero.
+        "joins_admitted": jnp.zeros((), jnp.int32),
+        "joins_deferred": jnp.zeros((), jnp.int32),
+        "promotions": jnp.zeros((), jnp.int32),
+        "n_live": jnp.zeros((), jnp.int32),
     }
     return new_state, metrics

@@ -677,3 +677,207 @@ def geo_chaos_matrix(
             if on_result is not None:
                 on_result(r)
     return results
+
+
+# ----------------------------------------------------------------- grow
+# Growth-under-chaos: elastic membership (sim/sparse.py capacity tiers +
+# serve/bridge.py admission/promotion) soaked under the chaos disciplines —
+# wire joins racing scripted kill/restart churn, and every geometry
+# promotion taken MID-BROWNOUT (a 2-zone LinkWorld latency segment drawn
+# from the geo band). A grow trial is still a pure function of
+# ``(seed, n, tiers)``; its CHAOS-REPRO line carries the tier ladder.
+
+#: Capacity-doubling promotions per grow trial (the default ladder depth).
+GROW_TIERS = 2
+
+
+def grow_ladder(n_alloc0: int, tiers: int) -> list[int]:
+    """The n_alloc doubling ladder a grow trial climbs."""
+    return [n_alloc0 * (2**i) for i in range(tiers + 1)]
+
+
+def grow_reproducer(seed: int, n: int, tiers: int, digest: str) -> str:
+    """The one-line stamp of a grow trial — the ladder replaces the engine
+    field (there is only one elastic engine) so a failure names every
+    geometry it crossed."""
+    ladder = "->".join(str(x) for x in grow_ladder(n, tiers))
+    return (
+        f"CHAOS-REPRO seed={seed} n={n} engine=grow "
+        f"ladder={ladder} digest={digest}"
+    )
+
+
+def grow_trial(seed: int, n: int, tiers: int = GROW_TIERS) -> dict:
+    """One seeded growth-under-chaos trial: a serve session starts with
+    ``n//2`` live members in an ``n``-row allocation and grows to a full
+    ``n * 2**tiers`` through ``tiers`` checkpoint-based promotions, while
+
+    - wire-form joins (node omitted — bridge admission assigns capacity
+      rows) race seeded kill/restart pairs on the founding cohort, and
+    - a 2-zone WAN brownout (latency drawn from the geo band, no loss)
+      covers the capacity-exhaustion window, so every promotion happens
+      mid-brownout and the parked joins replay into a degraded cluster.
+
+    Certifies, per inter-promotion segment, the C1-C6 trace invariants at
+    that segment's geometry; across the whole session the admission
+    conservation ledger (requested == placed, nothing shed or stranded),
+    the ladder itself (exactly ``tiers`` promotions), and a full
+    live x live heal after a clean settle tail (the elastic C7: capacity
+    rows are UNKNOWN by contract, so the fixed-shape convergence measure
+    would never read 1.0). Never raises — violations come back as
+    ``ok=False`` rows with the reproducer line, like every chaos trial."""
+    import hashlib
+
+    from scalecube_cluster_tpu.serve.bridge import ServeBridge
+    from scalecube_cluster_tpu.serve.ingest import event_from_obj
+    from scalecube_cluster_tpu.sim.sparse import effective_view
+    from scalecube_cluster_tpu.sim.topology import LinkWorld
+
+    params = chaos_params(n)
+    n_live0 = n // 2
+    n_top = n * (2**tiers)
+    n_joins = n_top - n_live0
+    burst = max(4, n // 4)
+    join_iters = -(-n_joins // burst)
+
+    rng = np.random.default_rng(seed)
+    lat_ms = float(rng.uniform(GEO_BROWNOUT_LO_MS, GEO_BROWNOUT_HI_MS))
+    #: Launch index the brownout opens at — at or before the first
+    #: capacity exhaustion (free capacity n//2, burst n//4), so promotions
+    #: always land inside the degraded window.
+    brown_start = int(rng.integers(1, 3))
+    victims = rng.choice(n_live0, size=join_iters, replace=True)
+    digest = hashlib.sha1(
+        f"{seed}:{n}:{tiers}:{burst}:{lat_ms:.3f}:{brown_start}:"
+        f"{victims.tolist()}".encode()
+    ).hexdigest()[:12]
+    result = {
+        "seed": seed,
+        "n": n,
+        "tiers": tiers,
+        "ladder": grow_ladder(n, tiers),
+        "digest": digest,
+        "reproducer": grow_reproducer(seed, n, tiers, digest),
+    }
+
+    def world_plan(n_cur: int, brown: bool) -> FaultPlan:
+        # Clean vs brownout worlds share one treedef per geometry, so
+        # toggling the window never recompiles within a tier.
+        w = LinkWorld.even_zones(n_cur, 2)
+        if brown:
+            w = w.with_zone_latency(0, 1, lat_ms)
+        return FaultPlan.uniform().with_link_world(w)
+
+    sp = SparseParams(
+        base=params, slot_budget=max(64, 4 * n_top), alloc_cap=16
+    )
+    state = init_sparse_full_view(
+        n_live0,
+        slot_budget=sp.slot_budget,
+        seed=seed,
+        user_gossip_slots=params.user_gossip_slots,
+        n_alloc=n,
+    )
+    bridge = ServeBridge(
+        sp, state, plan=world_plan(n, False), batch_ticks=16, capacity=8
+    )
+
+    def seg_traces(launches: list[dict]) -> dict:
+        return {
+            k: np.concatenate([np.asarray(tr[k]) for tr in launches])
+            for k in launches[0]
+        }
+
+    segments: list[list] = []
+    current: list = []
+    promo_ms: list[float] = []
+    try:
+        sent = 0
+        for i in range(join_iters):
+            b = min(burst, n_joins - sent)
+            for _ in range(b):
+                bridge.push(event_from_obj({"kind": "join"}))
+            sent += b
+            if i >= 1:
+                v = int(victims[i])
+                bridge.push(event_from_obj({"kind": "kill", "node": v}))
+                bridge.push(event_from_obj({"kind": "restart", "node": v}))
+            if bridge.batcher.deferred_joins:
+                # Promotion is driven HERE rather than via auto_promote so
+                # the plan's LinkWorld re-homes to the new geometry before
+                # the launch (zone assignment is per-member, [n]-shaped).
+                row = bridge.promote()
+                promo_ms.append(row["wall_ms"])
+                segments.append(current)
+                current = []
+            bridge.plan = world_plan(
+                bridge.params.base.n, i >= brown_start
+            )
+            current.append(bridge.step_batch())
+        # Clean settle tail: brownout off, C7-length heal window.
+        bridge.plan = world_plan(bridge.params.base.n, False)
+        for _ in range(-(-(heal_bound(params) + 20) // 16)):
+            current.append(bridge.step_batch())
+        segments.append(current)
+
+        if bridge.promotions != tiers:
+            raise InvariantViolation(
+                "GROW-ladder",
+                f"expected {tiers} promotions, took {bridge.promotions}",
+            )
+        led = bridge.batcher.assert_join_conservation()
+        if led["placed"] != n_joins or led["shed"] or led["deferred"]:
+            raise InvariantViolation(
+                "GROW-conservation",
+                f"{n_joins} joins requested but ledger reads {led}",
+            )
+        # One certification per inter-promotion segment, each on the
+        # CUMULATIVE trace up to that boundary: live rows carry verbatim
+        # across a promotion (P1), so C6's causality horizon legitimately
+        # crosses it — a probe missed before the boundary may raise its
+        # suspicion after. Every C1-C6 check is per-tick or monotone, so
+        # each prefix run covers its newest segment at full strength.
+        ladder = grow_ladder(n, tiers)
+        flat: list = []
+        for n_seg, launches in zip(ladder, segments):
+            flat.extend(launches)
+            if launches:
+                certify_traces(chaos_params(n_seg), seg_traces(flat))
+        lm = np.asarray(jax.device_get(bridge.state.live_mask))
+        ev = np.asarray(jax.device_get(effective_view(bridge.state)))
+        known = (ev != -1) & lm[:, None] & lm[None, :]
+        conv = float(known.sum()) / float(lm.sum()) ** 2
+        if conv < 1.0:
+            raise InvariantViolation(
+                "GROW-heal",
+                f"live x live convergence {conv:.4f} after the clean tail",
+            )
+    except (InvariantViolation, AssertionError) as e:
+        inv = getattr(e, "invariant", "GROW-assert")
+        result.update(ok=False, violation=inv, error=str(e))
+        return result
+    result.update(
+        ok=True,
+        final_convergence=conv,
+        n_live=int(lm.sum()),
+        promotions=bridge.promotions,
+        joins_placed=led["placed"],
+        promotion_wall_ms=[round(ms, 1) for ms in promo_ms],
+    )
+    return result
+
+
+def grow_matrix(
+    seeds, n: int, tiers: int = GROW_TIERS, on_result=None
+) -> list[dict]:
+    """The seeded grow matrix: one :func:`grow_trial` per seed (host-driven
+    — promotions recompile per tier by design, and the per-tier executables
+    are shared across seeds). Returns every row, violations included —
+    callers assert."""
+    results = []
+    for seed in seeds:
+        r = grow_trial(int(seed), n, tiers)
+        results.append(r)
+        if on_result is not None:
+            on_result(r)
+    return results

@@ -734,3 +734,104 @@ def certify_zone_traces(
             summary["z3_checked"] = True
         summary["z3_deadline"] = deadline
     return summary
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership: geometry-promotion certification (P1-P3)
+
+
+def certify_promotion(params_old, state_old, params_new, state_new) -> dict:
+    """Certify one capacity-tier promotion (sim/checkpoint.py::
+    promote_sparse_state or ServeBridge.promote) against the bit-exact
+    resume contract:
+
+    - **P1 live-row bit-exactness** — every state leaf's ``[:n_old]`` rows
+      (and the ``[:n_old, :n_old]`` view corner) carry VERBATIM into the
+      promoted state: views, slab working set including the suspicion and
+      incarnation planes, slot tables, user-gossip planes, tick, rng. A
+      promotion must be invisible to the protocol on live rows.
+    - **P2 capacity-row inertness** — every new row is the init-time masked
+      form: UNKNOWN along both view axes, dead, stale slab lanes,
+      ``live_mask`` False. A promotion must not manufacture identities.
+    - **P3 recorder continuity** — when both states carry a flight
+      recorder, the event log and cursor carry verbatim (ring positions
+      stable, so recorded cause chains survive) and the causal registers'
+      old rows carry verbatim.
+
+    Raises :class:`InvariantViolation` at the first breach; returns a
+    summary dict on success.
+    """
+    import jax
+
+    n_old, n_new = params_old.base.n, params_new.base.n
+    if n_new <= n_old:
+        raise InvariantViolation(
+            "P1-geometry", f"promotion must grow: {n_old} -> {n_new}"
+        )
+
+    def host(x):
+        return np.asarray(jax.device_get(x))
+
+    def p1(name, a, b):
+        if not np.array_equal(a, b):
+            raise InvariantViolation(
+                "P1-live-rows", f"{name}: old rows not bit-exact across promotion"
+            )
+
+    def p2(name, ok):
+        if not ok:
+            raise InvariantViolation(
+                "P2-capacity-rows", f"{name}: new capacity rows are not inert"
+            )
+
+    so, sn = state_old, state_new
+    view_o, view_n = host(so.view_T), host(sn.view_T)
+    p1("view_T", view_o, view_n[:n_old, :n_old])
+    p2("view_T", bool(np.all(view_n[n_old:, :] == -1))
+       and bool(np.all(view_n[:, n_old:] == -1)))
+    p1("slot_subj", host(so.slot_subj), host(sn.slot_subj))
+    subj_slot_n = host(sn.subj_slot)
+    p1("subj_slot", host(so.subj_slot), subj_slot_n[:n_old])
+    p2("subj_slot", bool(np.all(subj_slot_n[n_old:] == -1)))
+    for name in ("slab", "age", "susp", "inc_self", "epoch", "alive",
+                 "useen", "uage", "uinf_ids", "uptr"):
+        p1(name, host(getattr(so, name)), host(getattr(sn, name))[:n_old])
+    alive_n = host(sn.alive)
+    p2("alive", bool(not np.any(alive_n[n_old:])))
+    lm_o = host(so.live_mask) if so.live_mask is not None else np.ones(n_old, bool)
+    lm_n = host(sn.live_mask)
+    p1("live_mask", lm_o, lm_n[:n_old])
+    p2("live_mask", bool(not np.any(lm_n[n_old:])))
+    p1("tick", host(so.tick), host(sn.tick))
+    p1("rng", host(so.rng), host(sn.rng))
+    for name in ("lat_first_suspect", "lat_first_dead"):
+        a = getattr(so, name)
+        if a is not None:
+            p1(name, host(a), host(getattr(sn, name))[:n_old])
+
+    summary = {
+        "n_old": int(n_old),
+        "n_new": int(n_new),
+        "n_live": int(lm_n.sum()),
+        "tick": int(host(sn.tick)),
+        "p3_checked": False,
+    }
+    if so.trace is not None and sn.trace is not None:
+        ro, rn = so.trace, sn.trace
+        for name in ("ev_kind", "ev_tick", "ev_actor", "ev_subject",
+                     "ev_cause", "ev_aux", "cursor", "overflow"):
+            if not np.array_equal(host(getattr(ro, name)), host(getattr(rn, name))):
+                raise InvariantViolation(
+                    "P3-recorder",
+                    f"trace {name}: event log not verbatim across promotion "
+                    "(ring positions must stay stable for cause chains)",
+                )
+        for name in ("last_miss", "origin"):
+            if not np.array_equal(host(getattr(ro, name)),
+                                  host(getattr(rn, name))[:n_old]):
+                raise InvariantViolation(
+                    "P3-recorder",
+                    f"trace {name}: old rows not carried across promotion",
+                )
+        summary["p3_checked"] = True
+    return summary

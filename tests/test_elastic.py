@@ -1,0 +1,380 @@
+"""Elastic membership: capacity-tiered clusters that grow while they run.
+
+Covers the three tentpole layers and their contracts:
+
+- **Parity pin** — ``n_alloc == n_live`` (or ``n_alloc=None``) keeps the
+  state pytree and scheduled trajectories bit-identical to fixed-shape
+  builds: elasticity is structure-gated, never a tax on non-elastic runs.
+- **Promotion** — the checkpoint-based geometry promotion
+  (sim/checkpoint.py::promote_sparse_state, driven online by
+  ServeBridge.promote) resumes bit-exactly on live rows through a REAL
+  ``pack_cold=True`` checkpoint round-trip, certified leaf-by-leaf by
+  testlib/invariants.py::certify_promotion (P1-P3) — plus negatives where
+  a tampered ``live_mask`` / view corner fails certification.
+- **Growth session** — one serve session grows across >= 2 promotions
+  under seeded kill/restart traffic: C1-C6 certified per inter-promotion
+  segment, the admission conservation ledger exact across the whole
+  session, and every join's request -> ack -> admit flight-recorder cause
+  chain surviving promotion. The ISSUE-scale 64 -> 512 session runs the
+  same harness under ``-m slow``; the tier-1 copy grows 16 -> 128.
+- **Rapid twin** — elastic Rapid growth (downward from the top row, so
+  every joiner's ring-successor observers are live) with R1-R5 certified
+  across a promotion boundary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.obs.trace import TK_JOIN_ACK, TK_JOIN_EV, TK_JOIN_REQ
+from scalecube_cluster_tpu.obs.tracer import init_trace_ring
+from scalecube_cluster_tpu.serve.bridge import ServeBridge
+from scalecube_cluster_tpu.serve.ingest import EventBatcher, event_from_obj
+from scalecube_cluster_tpu.sim.checkpoint import (
+    load_sparse_checkpoint,
+    promote_sparse_state,
+    save_sparse_checkpoint,
+)
+from scalecube_cluster_tpu.sim.rapid import (
+    RapidParams,
+    init_rapid_full_view,
+    promote_rapid_state,
+    scan_rapid_ticks,
+)
+from scalecube_cluster_tpu.sim.schedule import FaultPlan, ScheduleBuilder
+from scalecube_cluster_tpu.sim.sparse import (
+    SparseParams,
+    effective_view,
+    init_sparse_full_view,
+    scan_sparse_ticks,
+)
+from scalecube_cluster_tpu.testlib.invariants import (
+    InvariantViolation,
+    certify_promotion,
+    certify_rapid_traces,
+    certify_traces,
+)
+from tests.test_sim import small_params
+
+SLOTS = 64
+
+
+def sparse_params(n_alloc):
+    return SparseParams(
+        base=small_params(n_alloc), slot_budget=SLOTS, alloc_cap=16
+    )
+
+
+def elastic_state(n_live, n_alloc, seed=7, trace_capacity=0):
+    return init_sparse_full_view(
+        n_live, slot_budget=SLOTS, seed=seed, n_alloc=n_alloc,
+        trace_capacity=trace_capacity,
+    )
+
+
+def grow_schedule(n_alloc, joins, kills=(), restarts=()):
+    sb = ScheduleBuilder(n_alloc).add_segment(1, FaultPlan.clean(n_alloc))
+    for t, node in joins:
+        sb = sb.join(t, node)
+    for t, node in kills:
+        sb = sb.kill(t, node)
+    for t, node in restarts:
+        sb = sb.restart(t, node)
+    return sb.build(epoch0=0)
+
+
+def live_conv(state) -> float:
+    """live x live knownness fraction — the elastic convergence measure
+    (capacity rows are UNKNOWN by contract, so the fixed-shape measure
+    would never read 1.0)."""
+    lm = np.asarray(jax.device_get(state.live_mask))
+    ev = np.asarray(jax.device_get(effective_view(state)))
+    known = (ev != -1) & lm[:, None] & lm[None, :]
+    return float(known.sum()) / float(lm.sum()) ** 2
+
+
+# ------------------------------------------------------------- parity pin
+
+
+def test_fixed_shape_parity_pin():
+    """n_alloc == n_live is bit-identical to the fixed-shape init: same
+    treedef (same compiled executables), same leaves, and a scheduled
+    40-tick trajectory with kill/restart traffic stays bit-exact."""
+    n = 32
+    params = sparse_params(n)
+    s_fixed = init_sparse_full_view(n, slot_budget=SLOTS, seed=5)
+    s_alloc = init_sparse_full_view(n, slot_budget=SLOTS, seed=5, n_alloc=n)
+    assert jax.tree_util.tree_structure(s_fixed) == jax.tree_util.tree_structure(
+        s_alloc
+    )
+    for a, b in zip(jax.tree_util.tree_leaves(s_fixed),
+                    jax.tree_util.tree_leaves(s_alloc)):
+        assert bool(jnp.array_equal(a, b))
+
+    sched = grow_schedule(n, joins=[], kills=[(10, 3)], restarts=[(25, 3)])
+    f_state, f_tr = scan_sparse_ticks(params, s_fixed, sched, 40)
+    a_state, a_tr = scan_sparse_ticks(params, s_alloc, sched, 40)
+    for a, b in zip(jax.tree_util.tree_leaves(f_state),
+                    jax.tree_util.tree_leaves(a_state)):
+        assert bool(jnp.array_equal(a, b))
+    for k in f_tr:
+        assert bool(jnp.array_equal(f_tr[k], a_tr[k])), k
+
+
+def test_legacy_join_alias_is_flagged():
+    """The SWIM join->restart alias survives under legacy_join=True (the
+    fixed-shape default) and routes to admission when an allocator is
+    wired — the trace-format switch documented in serve/ingest.py."""
+    from scalecube_cluster_tpu.serve.events import EV_JOIN, EV_RESTART
+
+    legacy = EventBatcher(8, 4, 4, 4)
+    ev = event_from_obj({"kind": "join", "node": 3})
+    legacy.push(ev, stamp=False)
+    assert ev.kind == EV_RESTART  # byte-compatible alias preserved
+
+    rows = iter(range(4, 8))
+    elastic = EventBatcher(
+        8, 4, 4, 4, legacy_join=False, admit=lambda e: next(rows, None)
+    )
+    ev2 = event_from_obj({"kind": "join"})  # node omitted: elastic wire form
+    elastic.push(ev2, stamp=False)
+    assert ev2.kind == EV_JOIN and ev2.node == 4
+    for _ in range(4):
+        elastic.push(event_from_obj({"kind": "join"}), stamp=False)
+    led = elastic.assert_join_conservation()
+    assert led == {
+        "requested": 5, "admitted": 4, "placed": 0,
+        "pending": 4, "deferred": 1, "shed": 0,
+    }
+    assert elastic.replay_deferred_joins() == 0  # still no capacity
+    assert len(elastic.deferred_joins) == 1
+
+
+# ------------------------------------------------------------- promotion
+
+
+def _grown_state(trace_capacity=0):
+    """A 24-live-in-32 state with join/kill/restart history — suspicion
+    and incarnation planes populated so the round-trip exercises every
+    leaf, including the packed int16 cold lanes."""
+    n_live, n_alloc = 24, 32
+    params = sparse_params(n_alloc)
+    state = elastic_state(n_live, n_alloc, trace_capacity=trace_capacity)
+    sched = grow_schedule(
+        n_alloc,
+        joins=[(20, 24), (50, 25)],
+        kills=[(10, 3)],
+        restarts=[(60, 3)],
+    )
+    state, _ = scan_sparse_ticks(params, state, sched, 160)
+    return params, state
+
+
+def test_promotion_roundtrip_bit_exact():
+    """save(pack_cold=True) -> load -> promote certifies P1-P3, and the
+    promoted session stays protocol-clean: scheduled joins land on the new
+    capacity rows and C1-C6 certify across the boundary."""
+    params, state = _grown_state(trace_capacity=2048)
+    buf = io.BytesIO()
+    save_sparse_checkpoint(
+        buf, state.replace(trace=None), params, pack_cold=True
+    )
+    buf.seek(0)
+    state_l, params_l = load_sparse_checkpoint(buf)
+    for f in dataclasses.fields(type(state)):
+        a, b = getattr(state, f.name), getattr(state_l, f.name)
+        if f.name == "trace":
+            continue
+        if a is None:
+            assert b is None, f.name
+        else:
+            assert bool(jnp.array_equal(a, b)), f.name
+    state_l = state_l.replace(trace=state.trace)
+
+    params2, state2 = promote_sparse_state(params_l, state_l, 64)
+    summary = certify_promotion(params, state, params2, state2)
+    assert summary["n_old"] == 32 and summary["n_new"] == 64
+    assert summary["p3_checked"]
+
+    t0 = int(jax.device_get(state2.tick))
+    sched = grow_schedule(
+        64, joins=[(t0 + 20, 32), (t0 + 50, 33)], kills=[(t0 + 80, 5)],
+        restarts=[(t0 + 140, 5)],
+    )
+    state2, tr = scan_sparse_ticks(params2, state2, sched, 600)
+    assert int(jnp.sum(tr["joins_fired"])) == 2
+    assert int(jnp.sum(state2.live_mask)) == 28
+    certify_traces(params2.base, tr)
+    assert live_conv(state2) == 1.0
+
+
+def test_tampered_promotion_fails_certification():
+    params, state = _grown_state()
+    params2, state2 = promote_sparse_state(params, state, 64)
+
+    ghost = state2.replace(live_mask=state2.live_mask.at[50].set(True))
+    with pytest.raises(InvariantViolation, match="P2-capacity-rows"):
+        certify_promotion(params, state, params2, ghost)
+
+    rewritten = state2.replace(view_T=state2.view_T.at[3, 5].add(1))
+    with pytest.raises(InvariantViolation, match="P1-live-rows"):
+        certify_promotion(params, state, params2, rewritten)
+
+    with pytest.raises(ValueError, match="must grow"):
+        promote_sparse_state(params2, state2, 64)
+
+
+# -------------------------------------------------- growth serve session
+
+
+def _segment_traces(launches):
+    """Stack per-launch trace dicts into one [ticks] segment trace."""
+    keys = launches[0].keys()
+    return {k: np.concatenate([np.asarray(tr[k]) for tr in launches])
+            for k in keys}
+
+
+def _walk_join_chains(state, n_expected):
+    """Every TK_JOIN_EV must close a request -> ack -> admit cause chain."""
+    ring = state.trace
+    kinds = np.asarray(jax.device_get(ring.ev_kind))
+    causes = np.asarray(jax.device_get(ring.ev_cause))
+    cur = int(jax.device_get(ring.cursor))
+    ev_pos = np.flatnonzero(kinds[:cur] == TK_JOIN_EV)
+    assert len(ev_pos) == n_expected, (len(ev_pos), n_expected)
+    for p in ev_pos:
+        ack = causes[p]
+        assert ack >= 0 and kinds[ack] == TK_JOIN_ACK, int(p)
+        req = causes[ack]
+        assert req >= 0 and kinds[req] == TK_JOIN_REQ, int(ack)
+
+
+def _run_growth_session(n_live0, n_alloc0, tiers, rng_seed=11, burst=12):
+    """Grow one serve session to full occupancy of the top tier through
+    ``tiers`` promotions, under seeded kill/restart traffic racing the
+    joins. ``burst`` joins arrive per launch — keep it under the base
+    tier's free capacity so every tier actually serves launches.
+    Returns (bridge, per-segment launch trace lists)."""
+    params = sparse_params(n_alloc0)
+    state = elastic_state(
+        n_live0, n_alloc0, trace_capacity=64 * n_alloc0 * (2 ** tiers)
+    )
+    bridge = ServeBridge(
+        params, state, batch_ticks=8, capacity=16, auto_promote=True,
+    )
+    rng = np.random.default_rng(rng_seed)
+    n_top = n_alloc0 * (2 ** tiers)
+    n_joins = n_top - n_live0
+
+    segments, current = [], []
+    joins_sent = 0
+    # Trickle joins in so admission, capacity exhaustion, promotion and
+    # replay all happen mid-session, racing the kill/restart traffic.
+    while bridge.promotions < tiers or len(bridge.batcher.deferred_joins) or joins_sent < n_joins:
+        b = min(burst, n_joins - joins_sent)
+        for _ in range(b):
+            bridge.push(event_from_obj({"kind": "join"}))
+        joins_sent += b
+        victim = int(rng.integers(0, n_live0))
+        bridge.push(event_from_obj({"kind": "kill", "node": victim}))
+        bridge.push(event_from_obj({"kind": "restart", "node": victim}))
+        p_before = bridge.promotions
+        tr = bridge.step_batch()
+        if bridge.promotions > p_before:
+            # step_batch promoted BEFORE this launch ran, so its trace
+            # belongs to the new geometry's segment.
+            segments.append(current)
+            current = []
+        current.append(tr)
+        assert bridge.batcher.assert_join_conservation()
+    # settle: let the last admissions fire and the cluster converge.
+    for _ in range(6):
+        current.append(bridge.step_batch())
+    segments.append(current)
+    return bridge, segments
+
+
+def _certify_growth(bridge, segments, n_live0, n_alloc0, tiers):
+    assert bridge.promotions == tiers
+    n_top = n_alloc0 * (2 ** tiers)
+    assert bridge.params.base.n == n_top
+    c = bridge.counters()
+    assert c["n_live"] == n_top
+    assert c["promotions"] == tiers
+    assert c["joins_deferred"] == 0
+    led = bridge.batcher.assert_join_conservation()
+    assert led["requested"] == n_top - n_live0
+    assert led["placed"] == n_top - n_live0  # zero dropped, all served
+    assert led["deferred"] == 0 and led["shed"] == 0
+    # C1-C6 per inter-promotion segment, each certified on the CUMULATIVE
+    # trace up to its boundary: live rows carry verbatim across a promotion
+    # (P1), so C6's miss -> suspicion causality legitimately crosses it.
+    # Every C1-C6 check is per-tick or monotone, so each prefix run covers
+    # its newest segment at full strength.
+    assert len(segments) == tiers + 1
+    tier_n = [n_alloc0 * (2 ** i) for i in range(tiers + 1)]
+    flat = []
+    for n_seg, launches in zip(tier_n, segments):
+        flat.extend(launches)
+        certify_traces(small_params(n_seg), _segment_traces(flat))
+    _walk_join_chains(bridge.state, n_top - n_live0)
+
+
+def test_grow_serve_session_two_promotions():
+    """Tier-1 scale: 16 live in 32 alloc grows to a full 128 across two
+    promotions under kill/restart traffic — segments certified, ledger
+    exact, cause chains intact across both boundaries."""
+    bridge, segments = _run_growth_session(16, 32, tiers=2)
+    _certify_growth(bridge, segments, 16, 32, tiers=2)
+
+
+@pytest.mark.slow
+def test_grow_64_to_512_certified():
+    """ISSUE scale: one session grows n_live 64 -> 512 across two
+    promotions (128 -> 256 -> 512), zero dropped events, per-segment
+    certification, ledger exact, chains surviving both promotions."""
+    bridge, segments = _run_growth_session(64, 128, tiers=2, burst=24)
+    _certify_growth(bridge, segments, 64, 128, tiers=2)
+    assert live_conv(bridge.state) > 0.25  # converging; full heal is long
+
+
+# ---------------------------------------------------------- rapid twin
+
+
+def test_rapid_elastic_growth_certified():
+    """Elastic Rapid: capacity rows join DOWNWARD from the top row (their
+    ring-successor observers wrap onto live rows — a joiner above a dead
+    arc could never accumulate H join-alarms), paced so each admission
+    lands before the next join fires. R1-R5 certify across a kill, four
+    admissions, and a geometry promotion."""
+    params = RapidParams(n=32, k=8)
+    state = init_rapid_full_view(params, seed=2, n_live=24)
+    sb = ScheduleBuilder(32).add_segment(1, FaultPlan.clean(32)).kill(5, 3)
+    for i, t in enumerate([30, 60, 90, 120]):
+        sb = sb.join(t, 31 - i)
+    state, tr = scan_rapid_ticks(params, state, sb.build(epoch0=0), 160)
+    assert int(jnp.sum(tr["joins_fired"])) == 4
+    assert int(jnp.sum(state.live_mask)) == 28
+    certify_rapid_traces(params, tr)
+
+    params2, state2 = promote_rapid_state(params, state, 64)
+    assert params2.n == 64
+    mm_old = np.asarray(jax.device_get(state.member_mask))
+    mm_new = np.asarray(jax.device_get(state2.member_mask))
+    assert np.array_equal(mm_old, mm_new[:32, :32])
+    assert int(jax.device_get(state2.tick)) == int(jax.device_get(state.tick))
+
+    t0 = int(jax.device_get(state2.tick))
+    sb2 = ScheduleBuilder(64).add_segment(t0 + 1, FaultPlan.clean(64))
+    for i, t in enumerate([t0 + 15, t0 + 45]):
+        sb2 = sb2.join(t, 63 - i)
+    state2, tr2 = scan_rapid_ticks(params2, state2, sb2.build(epoch0=0), 240)
+    assert int(jnp.sum(tr2["joins_fired"])) == 2
+    assert int(jnp.sum(state2.live_mask)) == 30
+    certify_rapid_traces(params2, tr2)

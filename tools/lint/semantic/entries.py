@@ -507,6 +507,39 @@ def _build_run_serve_batch():
     )
 
 
+def _build_run_serve_batch_elastic():
+    # The elastic serve executable (serve/engine.py): same scan as
+    # run_serve_batch but over the 4-tuple events path, with the EV_JOIN
+    # lane live and a capacity-tier live_mask attached. Probed half-full
+    # (n_live = N/2 inside an n_alloc = N state) — the geometry every tier
+    # of the promotion ladder launches at; n_alloc == n_live would collapse
+    # to live_mask=None and alias this entry to run_serve_batch's treedef.
+    from scalecube_cluster_tpu.serve.engine import run_serve_batch_elastic
+    from scalecube_cluster_tpu.serve.events import empty_batch
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import SparseParams, init_sparse_full_view
+
+    params = SparseParams.for_n(N, slot_budget=S, pallas_core=False)
+    state = init_sparse_full_view(
+        N // 2,
+        slot_budget=S,
+        user_gossip_slots=params.base.user_gossip_slots,
+        n_alloc=N,
+    )
+    return (
+        run_serve_batch_elastic,
+        (params, state, FaultPlan.uniform(), empty_batch(T, 2)),
+        {"collect": True},
+        {
+            "donate_argnums": (1,),
+            "state_argnum": 1,
+            "state_out": _state_first,
+            "static_argnums": (0,),
+            "static_argnames": ("collect",),
+        },
+    )
+
+
 def _build_run_rapid_serve_batch():
     # The Rapid serving-session executable (serve/engine.py): the fallback-
     # armed rapid tick scanned over a fixed-shape EventBatch. Unlike
@@ -604,6 +637,9 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
     EntrySpec("sim.rapid.run_rapid_ticks[geo]", _build_run_rapid_ticks_geo),
     EntrySpec("sim.rapid.run_ensemble_rapid_ticks", _build_run_ensemble_rapid_ticks),
     EntrySpec("serve.engine.run_serve_batch", _build_run_serve_batch),
+    EntrySpec(
+        "serve.engine.run_serve_batch_elastic", _build_run_serve_batch_elastic
+    ),
     EntrySpec("serve.engine.run_rapid_serve_batch", _build_run_rapid_serve_batch),
 )
 
