@@ -75,7 +75,13 @@ row land in bench_history.jsonl), or ``python bench.py
 under a 2-zone 400 ms WAN brownout schedule; the ``kind="bench_geo"``
 row reports member·rounds/s, ns_per_member and the flat-world overhead
 ratio, and both the probe attempt and the row land in
-bench_history.jsonl).
+bench_history.jsonl), or ``python bench.py --fleet [B] [n]`` (the
+multi-tenant fleet control-plane rung, serve/fleet.py: B tenant universes
+multiplexed onto one vmapped serving executable, each replaying the
+--serve rung's synthetic gossip stream; the ``kind="fleet"`` row — per-
+tenant ingest→verdict p50/p95/p99, aggregate tenant·member·rounds/s, and
+the fleet-of-B vs B-solo-sessions wall ratio — plus the probe attempt
+land in bench_history.jsonl).
 """
 
 from __future__ import annotations
@@ -622,6 +628,94 @@ def _measure_serve(
     ]
     bridge.run_replay(events, total_ticks)
     return bridge.close()
+
+
+def _measure_fleet(
+    fleet_size: int = 4,
+    n_members: int = 1024,
+    batch_ticks: int = 16,
+    capacity: int = 8,
+    n_rounds: int = 8,
+) -> dict:
+    """The ``--fleet B [n]`` rung: B tenant universes multiplexed onto one
+    vmapped serving executable (serve/fleet.py), each tenant replaying the
+    same synthetic user-gossip stream the ``--serve`` rung uses,
+    ``collect=False``. The row is the FleetBridge's own ``kind="fleet"``
+    session summary — per-tenant ingest→verdict p50/p95/p99 and the
+    aggregate tenant·member·rounds/s — augmented with the fleet-of-B vs
+    B-solo-sessions wall ratio (one solo bridge timed over the same
+    per-tenant trace, scaled by B): the multiplexing dividend PERF.md's
+    "Fleet accounting" note reads directly off bench_history.jsonl."""
+    from scalecube_cluster_tpu.serve import EV_GOSSIP, FleetBridge, ServeBridge, ServeEvent
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import SparseParams, init_sparse_full_view
+
+    params = SparseParams.for_n(
+        n_members, slot_budget=_rung_slot_budget(n_members)
+    )
+    plan = FaultPlan.uniform(loss_percent=5.0)
+    total_ticks = batch_ticks * n_rounds
+    per_tick = max(capacity // 2, 1)
+
+    def tenant_stream(tenant: int):
+        return [
+            ServeEvent(
+                EV_GOSSIP,
+                (t * per_tick + j) % n_members,
+                arg=(t + j) % 4,
+                tick=t,
+                tenant=tenant,
+            )
+            for t in range(1, total_ticks + 1)
+            for j in range(per_tick)
+        ]
+
+    # Warm-up fleet session on throwaway states: pays the (params, B, k, C)
+    # compile so the timed session measures steady-state serving.
+    warm = FleetBridge(
+        params, engine="sparse", fleet_size=fleet_size,
+        batch_ticks=batch_ticks, capacity=capacity, plan=plan, collect=False,
+    )
+    warm.run_replay([], batch_ticks)
+
+    fleet = FleetBridge(
+        params, engine="sparse", fleet_size=fleet_size,
+        batch_ticks=batch_ticks, capacity=capacity, plan=plan, collect=False,
+    )
+    events = [ev for b in range(fleet_size) for ev in tenant_stream(b)]
+    t0 = time.perf_counter()
+    fleet.run_replay(events, total_ticks)
+    fleet_wall_s = time.perf_counter() - t0
+    row = fleet.close()
+
+    # Solo baseline: ONE tenant's stream through one solo session (its own
+    # executable, already warm from the fleet warmup? no — solo entry
+    # differs, pay its compile on a throwaway first).
+    solo_warm = ServeBridge(
+        params, init_sparse_full_view(n_members, params.slot_budget),
+        plan=plan, batch_ticks=batch_ticks, capacity=capacity, collect=False,
+    )
+    solo_warm.run_replay([], batch_ticks)
+    solo = ServeBridge(
+        params, init_sparse_full_view(n_members, params.slot_budget),
+        plan=plan, batch_ticks=batch_ticks, capacity=capacity, collect=False,
+    )
+    t0 = time.perf_counter()
+    solo.run_replay(
+        [ServeEvent(ev.kind, ev.node, arg=ev.arg, tick=ev.tick)
+         for ev in tenant_stream(0)],
+        total_ticks,
+    )
+    solo_wall_s = time.perf_counter() - t0
+    solo.close()
+    row["fleet_wall_s"] = round(fleet_wall_s, 4)
+    row["solo_wall_s"] = round(solo_wall_s, 4)
+    # > 1.0 means the fleet-of-B beat B sequential solo sessions.
+    row["fleet_vs_solo_ratio"] = round(
+        (fleet_size * solo_wall_s) / max(fleet_wall_s, 1e-9), 3
+    )
+    row["n_members"] = n_members
+    return row
 
 
 def _measure_grow(n0: int = 64, tiers: int = 2, burst: int = 24) -> dict:
@@ -1511,6 +1605,66 @@ if __name__ == "__main__":
                             "latency_ms_p95",
                             "conservation_ok",
                             "bounded_ok",
+                        )
+                        if k in row
+                    },
+                },
+            )
+        try:
+            append_jsonl(
+                os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "artifacts",
+                    "bench_history.jsonl",
+                ),
+                [row],
+            )
+        except Exception:
+            pass
+        print(jsonl_line(row), flush=True)
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--fleet":
+        try:
+            from scalecube_cluster_tpu.utils.jaxcache import enable_repo_jax_cache
+
+            enable_repo_jax_cache()
+        except Exception:
+            pass
+        from scalecube_cluster_tpu.obs.export import (
+            append_jsonl,
+            jsonl_line,
+            make_row,
+            run_metadata,
+        )
+
+        fleet_arg = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+        n_arg = int(sys.argv[3]) if len(sys.argv) > 3 else 1024
+        t_probe = time.monotonic()
+        probe_err = _probe_once()
+        _record_probe_attempt(1, probe_err, time.monotonic() - t_probe)
+        if probe_err is not None:
+            row = make_row(
+                "fleet",
+                {"error": probe_err, "n_members": n_arg, **_self_evidence()},
+                run_metadata(seed=0),
+            )
+        else:
+            row = _measure_fleet(fleet_arg, n_arg)
+            _record_probe_attempt(
+                2,
+                None,
+                time.monotonic() - t_probe,
+                extra={
+                    "scenario": "fleet",
+                    "n_members": n_arg,
+                    "fleet_size": fleet_arg,
+                    **{
+                        k: row[k]
+                        for k in (
+                            "tenant_member_rounds_per_sec",
+                            "events_per_sec",
+                            "fleet_wall_s",
+                            "solo_wall_s",
+                            "fleet_vs_solo_ratio",
                         )
                         if k in row
                     },

@@ -90,6 +90,21 @@ from __future__ import annotations
 #                      meaningless tick-sum; fixed-shape engines emit
 #                      constant 0, NOT n — the slot reads "elastic
 #                      occupancy", absent when the cluster cannot grow)
+#   tenants_active     tenants currently holding a universe slot in a fleet
+#                      session — a GAUGE stamped by serve/fleet.py::
+#                      FleetBridge over the engines' constant-0 slot (tick
+#                      metrics have no tenancy axis; every engine emits 0)
+#   tenants_deferred   tenants parked awaiting fleet capacity — a GAUGE
+#                      (deferred is never dropped; the fleet admission
+#                      ledger requested == placed + pending + deferred +
+#                      evicted, serve/fleet.py); engines emit constant 0
+#   tenant_evictions   tenants explicitly evicted from a fleet session
+#                      (operator action, counted in the admission ledger);
+#                      host accounting — engines emit constant 0
+#   fleet_launches     ensemble launches a fleet session completed (one
+#                      vmapped executable stepping every tenant universe;
+#                      the fleet twin of serve_batches) — host accounting,
+#                      engines emit constant 0
 SHARED_COUNTERS: tuple[str, ...] = (
     "pings",
     "ping_reqs",
@@ -119,6 +134,10 @@ SHARED_COUNTERS: tuple[str, ...] = (
     "joins_deferred",
     "promotions",
     "n_live",
+    "tenants_active",
+    "tenants_deferred",
+    "tenant_evictions",
+    "fleet_launches",
 )
 
 # Emitted by the sparse engine only — they measure the compact working-set

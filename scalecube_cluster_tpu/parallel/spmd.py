@@ -1143,6 +1143,12 @@ def _tick_spmd(params, cfg, state, plan, collect=True, events=None, knobs=None):
         "joins_deferred": jnp.zeros((), jnp.int32),
         "promotions": jnp.zeros((), jnp.int32),
         "n_live": jnp.zeros((), jnp.int32),
+        # Fleet-control-plane counters (serve/fleet.py): host accounting
+        # with no tick-level event — constant zero on every sim engine.
+        "tenants_active": jnp.zeros((), jnp.int32),
+        "tenants_deferred": jnp.zeros((), jnp.int32),
+        "tenant_evictions": jnp.zeros((), jnp.int32),
+        "fleet_launches": jnp.zeros((), jnp.int32),
     }
     if tracing:
         # Summed over shards — equals the oracle's single-ring counter at

@@ -14,10 +14,22 @@ Correctness anchor: a trace replayed through the bridge is bit-identical
 to the equivalent offline :class:`~scalecube_cluster_tpu.sim.schedule.FaultSchedule`
 run (tests/test_serve.py) — the event masks are value-equal and mask
 application consumes no RNG, so the trajectories cannot diverge.
+
+The engine-agnostic contract lives in spec.py (:class:`EngineSpec` — one
+registry entry per engine behind one launch/collect protocol), and the
+multi-tenant fleet control plane in fleet.py (:class:`FleetBridge` — B
+tenant universes per compiled call on the ensemble axis, with the same
+bit-parity anchor per tenant against its solo replay).
 """
 
 from scalecube_cluster_tpu.serve.bridge import ServeBridge
-from scalecube_cluster_tpu.serve.engine import run_rapid_serve_batch, run_serve_batch
+from scalecube_cluster_tpu.serve.engine import (
+    run_fleet_rapid_serve_batch,
+    run_fleet_serve_batch,
+    run_fleet_serve_batch_elastic,
+    run_rapid_serve_batch,
+    run_serve_batch,
+)
 from scalecube_cluster_tpu.serve.events import (
     EV_GOSSIP,
     EV_JOIN,
@@ -26,6 +38,19 @@ from scalecube_cluster_tpu.serve.events import (
     EventBatch,
     event_masks,
     event_masks_rapid,
+    stack_batches,
+)
+from scalecube_cluster_tpu.serve.fleet import (
+    FleetBridge,
+    FleetPool,
+    TenantRouter,
+    TenantSession,
+)
+from scalecube_cluster_tpu.serve.spec import (
+    ENGINE_SPECS,
+    EngineSpec,
+    register_engine_spec,
+    resolve_engine_spec,
 )
 from scalecube_cluster_tpu.serve.ingest import (
     BATCHER_ENGINES,
@@ -42,23 +67,35 @@ from scalecube_cluster_tpu.serve.ingest import (
 
 __all__ = [
     "BATCHER_ENGINES",
+    "ENGINE_SPECS",
     "EV_GOSSIP",
     "EV_JOIN",
     "EV_KILL",
     "EV_RESTART",
     "BatcherFull",
+    "EngineSpec",
     "EventBatch",
     "EventBatcher",
+    "FleetBridge",
+    "FleetPool",
     "OVERFLOW_POLICIES",
     "SERVE_QUALIFIER",
     "ServeBridge",
     "ServeEvent",
     "TcpEventSource",
+    "TenantRouter",
+    "TenantSession",
     "event_from_message",
     "event_masks",
     "event_masks_rapid",
     "load_trace",
     "parse_trace_line",
-    "run_serve_batch",
+    "register_engine_spec",
+    "resolve_engine_spec",
+    "run_fleet_rapid_serve_batch",
+    "run_fleet_serve_batch",
+    "run_fleet_serve_batch_elastic",
     "run_rapid_serve_batch",
+    "run_serve_batch",
+    "stack_batches",
 ]

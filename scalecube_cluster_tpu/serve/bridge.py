@@ -36,8 +36,8 @@ from scalecube_cluster_tpu.obs.export import append_jsonl, make_row, run_metadat
 from scalecube_cluster_tpu.obs.slo import RollingSLOTracker
 from scalecube_cluster_tpu.obs.trace import TK_JOIN_ACK, TK_JOIN_REQ, trace_occupancy
 from scalecube_cluster_tpu.obs.tracer import pad_trace_ring, trace_host_event
-from scalecube_cluster_tpu.serve.engine import run_serve_batch, run_serve_batch_elastic
 from scalecube_cluster_tpu.serve.ingest import EventBatcher, ServeEvent, TcpEventSource
+from scalecube_cluster_tpu.serve.spec import EngineSpec, resolve_engine_spec
 from scalecube_cluster_tpu.sim.checkpoint import (
     load_sparse_checkpoint,
     promote_sparse_state,
@@ -45,26 +45,28 @@ from scalecube_cluster_tpu.sim.checkpoint import (
 )
 from scalecube_cluster_tpu.sim.faults import FaultPlan
 from scalecube_cluster_tpu.sim.knobs import Knobs
-from scalecube_cluster_tpu.sim.sparse import (
-    SparseParams,
-    SparseState,
-    writeback_free,
-)
+from scalecube_cluster_tpu.sim.sparse import SparseParams, SparseState
 
 
 class ServeBridge:
-    """Digital-twin serving session over one sparse-engine state.
+    """Digital-twin serving session over one engine state.
 
     ``batch_ticks`` (k) and ``capacity`` (C) fix the launch geometry — ONE
-    compiled executable per (params, k, C) for the whole session. The state
-    is donated into every launch; callers must not hold references to it
-    across :meth:`run_replay` / :meth:`run_live`.
+    compiled executable per (engine, params, k, C) for the whole session.
+    ``engine`` selects the :class:`~scalecube_cluster_tpu.serve.spec.EngineSpec`
+    (a registry name, a spec object, or None — inferred from the state's
+    type and shape, which keeps every pre-spec sparse call site working
+    unchanged). Donating engines (sparse family) consume the state on every
+    launch; callers must not hold references to it across
+    :meth:`run_replay` / :meth:`run_live`.
 
-    With ``params.in_scan_writeback=True`` (the small/mid-n default) the
-    session is bit-identical to one offline ``run_sparse_ticks`` call over
-    the same timeline; with the big-n host-boundary mode the bridge frees
-    slots between launches exactly like ``run_sparse_chunked`` with
-    ``chunk=batch_ticks``.
+    With ``params.in_scan_writeback=True`` (the small/mid-n sparse default)
+    the session is bit-identical to one offline ``run_sparse_ticks`` call
+    over the same timeline; with the big-n host-boundary mode the bridge
+    frees slots between launches exactly like ``run_sparse_chunked`` with
+    ``chunk=batch_ticks``. ``mesh`` places the state under the engine's
+    sharding layout first (GSPMD deployment — same executable, partitioned
+    by XLA; the ``sparse-gspmd`` spec).
     """
 
     def __init__(
@@ -85,23 +87,29 @@ class ServeBridge:
         slo_window: int = 64,
         legacy_join: bool | None = None,
         auto_promote: bool = False,
+        engine: str | EngineSpec | None = None,
+        mesh=None,
     ):
+        self.spec = resolve_engine_spec(engine, state)
+        if mesh is not None:
+            state = self.spec.place(state, mesh)
         self.params = params
         self.state = state
         self.plan = plan if plan is not None else FaultPlan.uniform()
         self.knobs = knobs
         self.collect = collect
         self.export_path = export_path
-        g_slots = int(state.useen.shape[1])
+        g_slots = self.spec.g_slots_of(state)
         # Elastic sessions (capacity-tiered state, live_mask attached) route
         # wire joins to ADMISSION — an unused capacity row per join,
         # activated in-scan by run_serve_batch_elastic — instead of the
         # fixed-shape restart alias. ``legacy_join=None`` resolves from the
-        # state's shape; pass True explicitly to replay a pre-elastic trace
+        # spec (inference maps a live_mask-bearing sparse state to the
+        # elastic spec); pass True explicitly to replay a pre-elastic trace
         # byte-compatibly on an elastic state.
-        self.elastic = state.live_mask is not None
+        self.elastic = self.spec.elastic
         if legacy_join is None:
-            legacy_join = not self.elastic
+            legacy_join = not self.elastic and self.spec.batcher_engine == "swim"
         #: Geometry promotions taken this session (the n_alloc doubling
         #: ladder); stamped over the engines' constant-zero counter slot.
         self.promotions = 0
@@ -122,20 +130,19 @@ class ServeBridge:
         # latency, shed counted), never by unbounded deque growth.
         # max_pending=0 restores the unbounded PR-10 behavior.
         self.batcher = EventBatcher(
-            params.base.n,
+            self.spec.n_of(params),
             g_slots,
             batch_ticks,
             capacity,
             max_pending=max_pending,
             low_watermark=low_watermark,
             overflow_policy=overflow_policy,
+            engine=self.spec.batcher_engine,
             legacy_join=legacy_join,
             admit=self._admit_join if self.elastic else None,
         )
         self.meta = (
-            meta
-            if meta is not None
-            else run_metadata(n=params.base.n, slot_budget=params.slot_budget)
+            meta if meta is not None else run_metadata(**self.spec.meta_of(params))
         )
         self.rows: list[dict] = []
         # Launch spans for the flight-recorder trace assembler
@@ -186,7 +193,7 @@ class ServeBridge:
             ring = trace_host_event(
                 ring, TK_JOIN_REQ, int(jax.device_get(self.state.tick)), -1, -1
             )
-        if self._next_row >= self.params.base.n:
+        if self._next_row >= self.spec.n_of(self.params):
             if ring is not None:
                 self.state = self.state.replace(trace=ring)
             return None
@@ -225,9 +232,12 @@ class ServeBridge:
 
         Emits a ``kind="promotion"`` row; returns it.
         """
-        if not self.elastic:
-            raise RuntimeError("promote() needs an elastic session (live_mask)")
-        n_old = self.params.base.n
+        if not (self.elastic and self.spec.promotable):
+            raise RuntimeError(
+                "promote() needs an elastic, checkpoint-promotable session "
+                f"(engine {self.spec.name!r}, live_mask required)"
+            )
+        n_old = self.spec.n_of(self.params)
         n_new = 2 * n_old if n_alloc_new is None else int(n_alloc_new)
         t0 = time.monotonic()
         trace = self.state.trace
@@ -274,8 +284,7 @@ class ServeBridge:
 
     def _execute(self, batch_dev, stats: dict):
         """Dispatch one launch (returns before the device finishes)."""
-        runner = run_serve_batch_elastic if self.elastic else run_serve_batch
-        self.state, traces = runner(
+        self.state, traces = self.spec.runner(
             self.params,
             self.state,
             self.plan,
@@ -296,10 +305,10 @@ class ServeBridge:
         """
         traces = jax.device_get(jax.block_until_ready((self.state.tick, traces)))[1]
         t_done = time.monotonic()
-        if not self.params.in_scan_writeback:
+        if self.spec.needs_writeback(self.params):
             # Big-n host-boundary mode: free done slots between launches,
             # exactly run_sparse_chunked's cadence with chunk=batch_ticks.
-            self.state = writeback_free(self.params, self.state)
+            self.state = self.spec.writeback(self.params, self.state)
         t0 = stats.get("oldest_ingest") or stats["t_assemble"]
         lat_ms = (t_done - t0) * 1000.0
         exec_s = t_done - stats["t_assemble"]
@@ -343,11 +352,13 @@ class ServeBridge:
             for k in SHARED_COUNTERS:
                 if k in traces:
                     self._counter_totals[k] += int(np.sum(traces[k]))
+            # Engines differ in trace extras (sparse: gossip + verdicts;
+            # elastic adds joins; rapid swaps gossip for joins) — surface
+            # whichever fired-event tallies this engine collected.
             for k in ("kills_fired", "restarts_fired", "gossip_fired",
-                      "verdicts_dead", "verdicts_alive"):
-                payload[k] = int(np.sum(traces[k]))
-            if "joins_fired" in traces:
-                payload["joins_fired"] = int(np.sum(traces["joins_fired"]))
+                      "verdicts_dead", "verdicts_alive", "joins_fired"):
+                if k in traces:
+                    payload[k] = int(np.sum(traces[k]))
         if self.elastic:
             # The admission ledger is exact at EVERY launch boundary — a
             # dropped join fails the session here, not at certification.
@@ -515,7 +526,7 @@ class ServeBridge:
         if self.elastic:
             # Growth gauges for the live plane: current tier, occupancy,
             # and the admission backlog a scrape should alarm on.
-            payload["n_alloc"] = self.params.base.n
+            payload["n_alloc"] = self.spec.n_of(self.params)
             payload["n_live"] = int(
                 np.asarray(jax.device_get(self.state.live_mask)).sum()
             )
@@ -546,14 +557,14 @@ class ServeBridge:
             "peak_pending": self.batcher.peak_pending,
             "overflow_policy": self.batcher.overflow_policy,
             "events_per_sec": self.events_served / exec_s,
-            "member_rounds_per_sec": self.params.base.n * self.ticks_run / exec_s,
+            "member_rounds_per_sec": self.spec.n_of(self.params) * self.ticks_run / exec_s,
             "latency_ms_p50": lat.get("p50", 0.0),
             "latency_ms_p95": lat.get("p95", 0.0),
             "latency_ms_p99": lat.get("p99", 0.0),
             "latency_ms_mean": lat.get("mean", 0.0),
         }
         if self.elastic:
-            payload["n_alloc"] = self.params.base.n
+            payload["n_alloc"] = self.spec.n_of(self.params)
             payload["n_live"] = int(
                 np.asarray(jax.device_get(self.state.live_mask)).sum()
             )

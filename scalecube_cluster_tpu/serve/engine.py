@@ -8,6 +8,16 @@ contract, sim/schedule.py). One executable serves every launch of the same
 plan is a fixed FaultPlan, and nothing else about the call varies — the
 zero-recompile pin in tests/test_serve.py reads
 utils/jaxcache.py::jit_cache_size across a whole session to certify it.
+
+Layout mirrors sim/ensemble.py: each engine's scan body is an UNJITTED core
+(``scan_serve_batch`` / ``scan_serve_batch_elastic`` /
+``scan_rapid_serve_batch``) that the solo jit entries wrap directly and the
+fleet entries lift over a leading universe axis with ``jax.vmap`` — so a
+multi-tenant fleet launch (serve/fleet.py) steps B tenant universes in ONE
+compiled call, and universe ``b`` of the vmapped run is bit-identical to
+the solo run of the same state and batch (vmap only adds a batch dimension;
+``lax.cond`` lowers to ``select`` under vmap — the PR-5 ensemble property,
+re-certified for the serve path by tests/test_fleet.py).
 """
 
 from __future__ import annotations
@@ -35,8 +45,7 @@ from scalecube_cluster_tpu.sim.rapid import (
 from scalecube_cluster_tpu.sim.sparse import SparseParams, SparseState, sparse_tick
 
 
-@partial(jax.jit, static_argnums=(0,), static_argnames=("collect",), donate_argnums=(1,))
-def run_serve_batch(
+def scan_serve_batch(
     params: SparseParams,
     state: SparseState,
     plan: FaultPlan,
@@ -44,19 +53,17 @@ def run_serve_batch(
     collect: bool = True,
     knobs: Knobs | None = None,
 ):
-    """Step the sparse engine ``batch.n_ticks`` ticks, one batch row per tick.
+    """Unjitted scan core of :func:`run_serve_batch` (jit/vmap composition
+    point — the serve twin of sim/sparse.py::scan_sparse_ticks).
 
-    Returns ``(state, traces)`` with the scheduled runners' trace schema
-    (``plan_dirty`` / ``kills_fired`` / ``restarts_fired`` extras included,
-    computed from the fixed plan and the resolved masks) plus the serve
-    extras: ``gossip_fired`` and the per-tick ``ingest_overflow`` override —
-    the batcher's deferral counts replace the tick core's constant-zero
-    schema slot, so a collected serve trace sums to the session's true
-    host-outran-the-budget total.
-
-    The input state is DONATED exactly like run_sparse_ticks (rebind the
-    result); the batch is NOT donated — the bridge keeps the next batch's
-    transfer in flight while this one executes (double buffering).
+    Steps the sparse engine ``batch.n_ticks`` ticks, one batch row per
+    tick. Returns ``(state, traces)`` with the scheduled runners' trace
+    schema (``plan_dirty`` / ``kills_fired`` / ``restarts_fired`` extras
+    included, computed from the fixed plan and the resolved masks) plus the
+    serve extras: ``gossip_fired`` and the per-tick ``ingest_overflow``
+    override — the batcher's deferral counts replace the tick core's
+    constant-zero schema slot, so a collected serve trace sums to the
+    session's true host-outran-the-budget total.
     """
     n = params.base.n
     g_slots = state.useen.shape[1]
@@ -91,7 +98,7 @@ def run_serve_batch(
 
 
 @partial(jax.jit, static_argnums=(0,), static_argnames=("collect",), donate_argnums=(1,))
-def run_serve_batch_elastic(
+def run_serve_batch(
     params: SparseParams,
     state: SparseState,
     plan: FaultPlan,
@@ -99,18 +106,30 @@ def run_serve_batch_elastic(
     collect: bool = True,
     knobs: Knobs | None = None,
 ):
-    """Elastic flavor of :func:`run_serve_batch`: the EV_JOIN lane routes to
-    sparse_tick's 4-tuple events path, so live ``join`` traffic activates
-    masked capacity rows in-scan (wire-rate admission) instead of aliasing
-    to restart. Requires an elastic state (``state.live_mask`` attached —
-    init_sparse_full_view ``n_alloc=``); trace extras add ``joins_fired``
-    next to ``gossip_fired``.
+    """Step the sparse engine ``batch.n_ticks`` ticks, one batch row per tick
+    (:func:`scan_serve_batch`, jitted).
 
-    A separate executable from :func:`run_serve_batch` by design: the
-    4-tuple events path is a different traced structure, and keeping the
-    legacy entry untouched is what pins fixed-shape serve sessions
-    bit-identical to pre-elastic builds (the zero-recompile contract is
-    per-entry — one cache line each, tests/test_serve.py).
+    The input state is DONATED exactly like run_sparse_ticks (rebind the
+    result); the batch is NOT donated — the bridge keeps the next batch's
+    transfer in flight while this one executes (double buffering).
+    """
+    return scan_serve_batch(params, state, plan, batch, collect=collect, knobs=knobs)
+
+
+def scan_serve_batch_elastic(
+    params: SparseParams,
+    state: SparseState,
+    plan: FaultPlan,
+    batch: EventBatch,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Unjitted scan core of :func:`run_serve_batch_elastic`: the EV_JOIN
+    lane routes to sparse_tick's 4-tuple events path, so live ``join``
+    traffic activates masked capacity rows in-scan (wire-rate admission)
+    instead of aliasing to restart. Requires an elastic state
+    (``state.live_mask`` attached — init_sparse_full_view ``n_alloc=``);
+    trace extras add ``joins_fired`` next to ``gossip_fired``.
     """
     n = params.base.n
     g_slots = state.useen.shape[1]
@@ -144,8 +163,30 @@ def run_serve_batch_elastic(
     )
 
 
-@partial(jax.jit, static_argnums=(0,), static_argnames=("collect",))
-def run_rapid_serve_batch(
+@partial(jax.jit, static_argnums=(0,), static_argnames=("collect",), donate_argnums=(1,))
+def run_serve_batch_elastic(
+    params: SparseParams,
+    state: SparseState,
+    plan: FaultPlan,
+    batch: EventBatch,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Elastic flavor of :func:`run_serve_batch`
+    (:func:`scan_serve_batch_elastic`, jitted).
+
+    A separate executable from :func:`run_serve_batch` by design: the
+    4-tuple events path is a different traced structure, and keeping the
+    legacy entry untouched is what pins fixed-shape serve sessions
+    bit-identical to pre-elastic builds (the zero-recompile contract is
+    per-entry — one cache line each, tests/test_serve.py).
+    """
+    return scan_serve_batch_elastic(
+        params, state, plan, batch, collect=collect, knobs=knobs
+    )
+
+
+def scan_rapid_serve_batch(
     params: RapidParams,
     state: RapidState,
     plan: FaultPlan,
@@ -153,8 +194,7 @@ def run_rapid_serve_batch(
     collect: bool = True,
     knobs: Knobs | None = None,
 ):
-    """Rapid flavor of :func:`run_serve_batch`: step the Rapid engine
-    ``batch.n_ticks`` ticks, one batch row per tick.
+    """Unjitted scan core of :func:`run_rapid_serve_batch`.
 
     The event lanes differ from the SWIM path the way the schedule lanes do
     (sim/schedule.py::rapid_events_at vs events_at): EV_JOIN replaces the
@@ -163,11 +203,6 @@ def run_rapid_serve_batch(
     ``join_mask``, so live ``join`` traffic gets real protocol admission
     semantics instead of the SWIM restart alias. ``joins_fired`` replaces
     ``gossip_fired`` in the trace extras accordingly.
-
-    The input state is NOT donated (unlike run_serve_batch): rapid serve
-    sessions are replay/parity surfaces first (tests/test_rapid_fallback.py
-    re-runs the same state object against the scheduled twin), so keeping
-    the argument alive is worth the extra buffer.
     """
     n = params.n
     dirty = plan_any_faults(plan)
@@ -193,3 +228,106 @@ def run_rapid_serve_batch(
     return lax.scan(
         step, state, (batch.node, batch.kind, batch.arg, batch.deferred)
     )
+
+
+@partial(jax.jit, static_argnums=(0,), static_argnames=("collect",))
+def run_rapid_serve_batch(
+    params: RapidParams,
+    state: RapidState,
+    plan: FaultPlan,
+    batch: EventBatch,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Rapid flavor of :func:`run_serve_batch`
+    (:func:`scan_rapid_serve_batch`, jitted).
+
+    The input state is NOT donated (unlike run_serve_batch): rapid serve
+    sessions are replay/parity surfaces first (tests/test_rapid_fallback.py
+    re-runs the same state object against the scheduled twin), so keeping
+    the argument alive is worth the extra buffer.
+    """
+    return scan_rapid_serve_batch(
+        params, state, plan, batch, collect=collect, knobs=knobs
+    )
+
+
+# ------------------------------------------------------------ fleet entries
+#
+# The multi-tenant ensemble-serve executables (serve/fleet.py): B tenant
+# universes stack along a leading axis — states, batches, knobs — and step
+# together under jax.vmap of the unjitted scan cores, jitted once here.
+# One executable per (params, B, k, C) fleet geometry; every tenant's
+# traffic and knob point is traced data, so a whole fleet session is zero
+# recompiles after the first launch (pinned by tests/test_fleet.py). The
+# plan is SHARED across universes (closed over, broadcast by vmap) — the
+# fleet's fault environment is the pool's, not the tenant's.
+
+@partial(jax.jit, static_argnums=(0,), static_argnames=("collect",), donate_argnums=(1,))
+def run_fleet_serve_batch(
+    params: SparseParams,
+    states: SparseState,
+    plan: FaultPlan,
+    batches: EventBatch,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Step B sparse tenant universes ``k`` ticks in ONE compiled call.
+
+    ``states``/``batches`` (and ``knobs`` when given) are stacked pytrees
+    with leading axis B (sim/ensemble.py::stack_universes /
+    serve/events.py::stack_batches). The stacked state is DONATED like the
+    solo entry — the fleet bridge rebinds it every launch. Returns
+    ``(states, traces)`` with every trace leaf shaped ``[B, k, ...]``;
+    ``traces[b]`` is bit-identical to the solo run of universe ``b``.
+    """
+
+    def one(st, ba, kn):
+        return scan_serve_batch(params, st, plan, ba, collect=collect, knobs=kn)
+
+    return jax.vmap(one)(states, batches, knobs)
+
+
+@partial(jax.jit, static_argnums=(0,), static_argnames=("collect",), donate_argnums=(1,))
+def run_fleet_serve_batch_elastic(
+    params: SparseParams,
+    states: SparseState,
+    plan: FaultPlan,
+    batches: EventBatch,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Elastic fleet entry: B capacity-tiered universes (every state carries
+    a ``live_mask``; per-tenant EV_JOIN lanes activate rows in-scan). A
+    separate executable from :func:`run_fleet_serve_batch` for the same
+    reason the solo entries split — the 4-tuple events path is a different
+    traced structure, one cache line each.
+    """
+
+    def one(st, ba, kn):
+        return scan_serve_batch_elastic(
+            params, st, plan, ba, collect=collect, knobs=kn
+        )
+
+    return jax.vmap(one)(states, batches, knobs)
+
+
+@partial(jax.jit, static_argnums=(0,), static_argnames=("collect",))
+def run_fleet_rapid_serve_batch(
+    params: RapidParams,
+    states: RapidState,
+    plan: FaultPlan,
+    batches: EventBatch,
+    collect: bool = True,
+    knobs: Knobs | None = None,
+):
+    """Rapid fleet entry: B Rapid tenant universes per launch. NOT donated,
+    matching :func:`run_rapid_serve_batch` (rapid fleet sessions are
+    replay/parity surfaces)."""
+
+    def one(st, ba, kn):
+        return scan_rapid_serve_batch(
+            params, st, plan, ba, collect=collect, knobs=kn
+        )
+
+    return jax.vmap(one)(states, batches, knobs)

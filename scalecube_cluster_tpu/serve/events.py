@@ -80,6 +80,29 @@ def empty_batch(n_ticks: int, capacity: int) -> EventBatch:
     )
 
 
+def stack_batches(batches) -> EventBatch:
+    """Stack B same-geometry batches into one ``[B, k, C]`` fleet batch.
+
+    Host-side numpy, like :func:`empty_batch` — the fleet bridge
+    (serve/fleet.py) overlaps the stacked tensor's single ``device_put``
+    with the previous launch exactly as the solo bridge does per batch.
+    The per-universe batch axis is how per-tenant traffic reaches the
+    vmapped fleet entries (serve/engine.py::run_fleet_serve_batch).
+    """
+    batches = list(batches)
+    if not batches:
+        raise ValueError("stack_batches needs at least one batch")
+    geoms = {(b.n_ticks, b.capacity) for b in batches}
+    if len(geoms) != 1:
+        raise ValueError(f"batches disagree on (k, C) geometry: {sorted(geoms)}")
+    return EventBatch(
+        node=np.stack([np.asarray(b.node) for b in batches]),
+        kind=np.stack([np.asarray(b.kind) for b in batches]),
+        arg=np.stack([np.asarray(b.arg) for b in batches]),
+        deferred=np.stack([np.asarray(b.deferred) for b in batches]),
+    )
+
+
 def event_masks(
     node: jax.Array,
     kind: jax.Array,

@@ -43,6 +43,12 @@ skipped)::
   tick of the next batch with free capacity). Events whose tick already
   passed also fire ASAP — deferred, never dropped.
 - ``slot`` — user-gossip payload slot in ``[0, G)``; ``gossip`` only.
+- ``tenant`` — optional tenant id (int >= 0) for MULTI-TENANT fleet
+  sessions (serve/fleet.py): the event targets that tenant's universe of
+  the fleet, routed by :class:`~scalecube_cluster_tpu.serve.fleet.TenantRouter`.
+  Omitted means tenant 0, so every pre-fleet trace and wire producer is
+  byte-compatible — a solo session IS the one-tenant fleet. Single-session
+  batchers ignore the field (their bridge owns exactly one state).
 
 The same JSON objects ride live TCP sessions as ``Message.data`` under
 qualifier ``serve/event`` (transport/tcp.py length-framed frames), so a
@@ -134,6 +140,9 @@ class ServeEvent:
     #: stamped by the elastic bridge at first admission attempt so a join
     #: that parks for a promotion keeps its request → ack cause link.
     req_pos: int | None = None
+    #: Tenant id for fleet sessions (module docstring); 0 — the wire
+    #: default — keeps solo sessions and pre-fleet traces byte-compatible.
+    tenant: int = 0
 
 
 def event_from_obj(obj: dict) -> ServeEvent:
@@ -153,11 +162,15 @@ def event_from_obj(obj: dict) -> ServeEvent:
     else:
         node = int(obj["node"])
     tick = obj.get("tick")
+    tenant = int(obj.get("tenant", 0))
+    if tenant < 0:
+        raise ValueError(f"serve event tenant {tenant} must be >= 0")
     return ServeEvent(
         kind=kind,
         node=node,
         arg=int(obj.get("slot", 0)) if kind == EV_GOSSIP else 0,
         tick=None if tick is None else int(tick),
+        tenant=tenant,
     )
 
 

@@ -82,6 +82,19 @@ def index_universe(tree, b: int):
     return jax.tree_util.tree_map(lambda a: a[b], tree)
 
 
+def set_universe(tree, b: int, sub):
+    """Write one universe's pytree into slot ``b`` of a stacked pytree.
+
+    The admission/eviction/promotion primitive of the fleet control plane
+    (serve/fleet.py): a tenant claiming a free universe slot lands its
+    fresh (or checkpoint-promoted) state here, leaf by leaf, without
+    touching the other universes' rows. ``sub`` must share the stacked
+    tree's treedef minus the leading axis (the :func:`stack_universes`
+    contract in reverse).
+    """
+    return jax.tree_util.tree_map(lambda a, s: a.at[b].set(s), tree, sub)
+
+
 def init_ensemble_dense(
     n: int, init_seeds, user_gossip_slots: int = 4, **kw
 ) -> SimState:

@@ -1317,6 +1317,12 @@ def rapid_tick(
             if state.live_mask is not None
             else zero
         ),
+        # Fleet-control-plane counters (serve/fleet.py): host accounting
+        # with no tick-level event — constant zero on every sim engine.
+        "tenants_active": zero,
+        "tenants_deferred": zero,
+        "tenant_evictions": zero,
+        "fleet_launches": zero,
         # Consistency plane, per member — the R1-R4 certifier's input.
         "view_id": vid3,
         "view_digest": view_digest(mm3),

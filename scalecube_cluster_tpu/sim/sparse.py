@@ -2023,6 +2023,13 @@ def sparse_tick(
             if new_state.live_mask is not None
             else jnp.zeros((), jnp.int32)
         ),
+        # Fleet-control-plane counters (multi-tenant serving, serve/fleet.py):
+        # tick metrics have no tenancy axis — the FleetBridge stamps host
+        # accounting over these constant-zero schema slots.
+        "tenants_active": jnp.zeros((), jnp.int32),
+        "tenants_deferred": jnp.zeros((), jnp.int32),
+        "tenant_evictions": jnp.zeros((), jnp.int32),
+        "fleet_launches": jnp.zeros((), jnp.int32),
     }
     if ring is not None:
         # Lossless ring accounting (emitted == recorded + overflow): the

@@ -859,5 +859,11 @@ def sim_tick(
         "joins_deferred": jnp.zeros((), jnp.int32),
         "promotions": jnp.zeros((), jnp.int32),
         "n_live": jnp.zeros((), jnp.int32),
+        # Fleet-control-plane counters (serve/fleet.py): host accounting
+        # with no tick-level event — constant zero on every sim engine.
+        "tenants_active": jnp.zeros((), jnp.int32),
+        "tenants_deferred": jnp.zeros((), jnp.int32),
+        "tenant_evictions": jnp.zeros((), jnp.int32),
+        "fleet_launches": jnp.zeros((), jnp.int32),
     }
     return new_state, metrics

@@ -20,6 +20,7 @@ EXAMPLES = [
     ("scalecube_cluster_tpu.examples.membership_events", []),
     ("scalecube_cluster_tpu.examples.messaging_example", []),
     ("scalecube_cluster_tpu.examples.metadata_example", []),
+    ("scalecube_cluster_tpu.examples.serve_fleet", []),
     ("scalecube_cluster_tpu.examples.serve_load", []),
     ("scalecube_cluster_tpu.examples.serve_replay", []),
     ("scalecube_cluster_tpu.examples.soak_runner", ["--nodes", "4", "--churn-rounds", "1"]),

@@ -1,0 +1,375 @@
+"""Fleet control plane certification (serve/fleet.py).
+
+The load-bearing property is ISOLATION: a tenant's state trajectory in a
+multi-tenant fleet is bit-identical, leaf for leaf, to the same trace
+replayed through a solo ServeBridge — regardless of what every other
+tenant's traffic does. Plus: the fleet admission ledger (requested ==
+placed + pending + deferred + evicted) at every launch boundary, zero
+recompiles across fleet launches, capacity-tier promotion with zero
+dropped ticks over live TCP, and cross-tenant non-degradation under
+adversarial producers (serve/load.py::run_fleet_load).
+"""
+
+import asyncio
+
+import jax
+import jax.tree_util as jtu
+import numpy as np
+import pytest
+
+from scalecube_cluster_tpu.cluster_api.config import TransportConfig
+from scalecube_cluster_tpu.obs.counters import SHARED_COUNTERS
+from scalecube_cluster_tpu.serve.bridge import ServeBridge
+from scalecube_cluster_tpu.serve.engine import (
+    run_fleet_serve_batch,
+    run_fleet_serve_batch_elastic,
+)
+from scalecube_cluster_tpu.serve.events import EV_GOSSIP, EV_JOIN, EV_KILL, EV_RESTART
+from scalecube_cluster_tpu.serve.fleet import FleetBridge
+from scalecube_cluster_tpu.serve.ingest import SERVE_QUALIFIER, ServeEvent
+from scalecube_cluster_tpu.serve.load import run_fleet_load
+from scalecube_cluster_tpu.sim.ensemble import index_universe, stack_universes
+from scalecube_cluster_tpu.sim.knobs import make_knobs
+from scalecube_cluster_tpu.sim.params import SimParams
+from scalecube_cluster_tpu.sim.rapid import RapidParams, init_rapid_full_view
+from scalecube_cluster_tpu.sim.sparse import SparseParams, init_sparse_full_view
+from scalecube_cluster_tpu.transport.message import Message
+from scalecube_cluster_tpu.transport.tcp import TcpTransport
+from scalecube_cluster_tpu.utils.jaxcache import jit_cache_size
+
+N, S = 16, 64
+
+
+def _params():
+    return SparseParams.for_n(N, slot_budget=S)
+
+
+def _leaf_diff(a_tree, b_tree):
+    """Paths of leaves that are not bit-identical between two pytrees."""
+    bad = []
+    for (path, a), (_, b) in zip(
+        jtu.tree_flatten_with_path(a_tree)[0], jtu.tree_flatten_with_path(b_tree)[0]
+    ):
+        a = np.asarray(jax.device_get(a))
+        b = np.asarray(jax.device_get(b))
+        if a.shape != b.shape or not np.array_equal(a, b):
+            bad.append(jtu.keystr(path))
+    return bad
+
+
+#: The trace every isolation test replays for the TENANT UNDER TEST —
+#: clean ticks, a kill, a restart of the same node (the kill/restart
+#: recovery arc), and a user-gossip injection.
+VICTIM_TRACE = [
+    dict(kind=EV_KILL, node=5, tick=2),
+    dict(kind=EV_GOSSIP, node=3, arg=1, tick=4),
+    dict(kind=EV_RESTART, node=5, tick=7),
+]
+
+#: Independent traffic the neighbor tenants receive while the victim runs —
+#: different nodes, different ticks, plus unscheduled ASAP events.
+NEIGHBOR_TRACES = {
+    1: [dict(kind=EV_KILL, node=9, tick=1), dict(kind=EV_KILL, node=2, tick=3)],
+    2: [dict(kind=EV_GOSSIP, node=7, arg=0), dict(kind=EV_RESTART, node=9, tick=6)],
+    3: [dict(kind=EV_KILL, node=i) for i in range(8)],  # a noisy flood
+}
+
+
+def _events(trace, tenant):
+    return [ServeEvent(tenant=tenant, **e) for e in trace]
+
+
+def _fleet_events(victim=0):
+    evs = _events(VICTIM_TRACE, victim)
+    for t, tr in NEIGHBOR_TRACES.items():
+        evs.extend(_events(tr, t))
+    return evs
+
+
+def test_fleet_solo_parity_sparse():
+    """Tenant 0's fleet trajectory is bit-identical to its solo replay
+    while three neighbor tenants receive independent traffic."""
+    params = _params()
+    fleet = FleetBridge(params, engine="sparse", fleet_size=4, batch_ticks=4, capacity=2)
+    fleet.run_replay(_fleet_events(), n_ticks=12)
+    assert fleet.fleet_ledger()["placed"] == 4
+
+    solo = ServeBridge(
+        params, init_sparse_full_view(N, S, seed=0), batch_ticks=4, capacity=2
+    )
+    solo.run_replay(_events(VICTIM_TRACE, 0), n_ticks=12)
+    tenant0 = index_universe(fleet.base_pool.states, 0)
+    assert _leaf_diff(solo.state, tenant0) == []
+
+
+def test_fleet_solo_parity_knobbed():
+    """Per-tenant protocol knobs are traced per-universe data: a knobbed
+    tenant matches its knobbed solo run bit-for-bit, neighbors unknobbed."""
+    params = _params()
+    knobs = stack_universes(make_knobs(params.base) for _ in range(3))
+    fleet = FleetBridge(
+        params, engine="sparse", fleet_size=3, batch_ticks=4, capacity=2, knobs=knobs
+    )
+    tuned = make_knobs(params.base, suspicion_mult=2.0)
+    fleet.admit(0, knobs=tuned)
+    fleet.run_replay(_events(VICTIM_TRACE, 0) + _events(NEIGHBOR_TRACES[1], 1), 8)
+
+    solo = ServeBridge(
+        params,
+        init_sparse_full_view(N, S, seed=0),
+        batch_ticks=4,
+        capacity=2,
+        knobs=tuned,
+    )
+    solo.run_replay(_events(VICTIM_TRACE, 0), 8)
+    assert _leaf_diff(solo.state, index_universe(fleet.base_pool.states, 0)) == []
+
+
+def test_fleet_solo_parity_rapid():
+    """Rapid tenants: the consensus plane's view changes are per-universe
+    too — tenant 1 (seed 1 placeholder) matches its solo rapid session."""
+    rp = RapidParams(n=N)
+    fleet = FleetBridge(rp, engine="rapid", fleet_size=2, batch_ticks=4, capacity=2)
+    fleet.run_replay(
+        _events([dict(kind=EV_KILL, node=3, tick=2)], 0)
+        + _events([dict(kind=EV_KILL, node=7, tick=1)], 1),
+        8,
+    )
+    solo = ServeBridge(
+        rp, init_rapid_full_view(RapidParams(n=N), seed=1), batch_ticks=4, capacity=2
+    )
+    solo.run_replay([ServeEvent(kind=EV_KILL, node=7, tick=1)], 8)
+    assert _leaf_diff(solo.state, index_universe(fleet.base_pool.states, 1)) == []
+
+
+def test_fleet_zero_recompile():
+    """One executable covers every fleet launch of a pinned geometry —
+    admissions, evictions and traffic are data, not shapes."""
+    params = _params()
+    fleet = FleetBridge(params, engine="sparse", fleet_size=3, batch_ticks=3, capacity=2)
+    before = jit_cache_size(run_fleet_serve_batch)
+    fleet.admit(0)
+    fleet.run_replay([ServeEvent(kind=EV_KILL, node=1, tenant=0)], 9)
+    fleet.admit(1)
+    fleet.run_replay([ServeEvent(kind=EV_KILL, node=2, tenant=1)], 9)
+    fleet.evict(0)
+    fleet.admit(2)
+    fleet.run_replay([ServeEvent(kind=EV_GOSSIP, node=3, arg=0, tenant=2)], 9)
+    assert fleet.fleet_launches == 9
+    assert jit_cache_size(run_fleet_serve_batch) - before == 1
+
+
+def test_fleet_admission_ledger_deferred_never_dropped():
+    """Past capacity, tenants DEFER (their traffic buffering losslessly)
+    under requested == placed + pending + deferred + evicted; an eviction
+    re-offers the slot FIFO and the parked tenant's events are served."""
+    params = _params()
+    fleet = FleetBridge(params, engine="sparse", fleet_size=2, batch_ticks=4, capacity=2)
+    evs = [ServeEvent(kind=EV_KILL, node=t + 1, tenant=t) for t in range(4)]
+    fleet.run_replay(evs, 4)
+    led = fleet.assert_fleet_conservation()
+    assert led == {
+        "requested": 4, "placed": 2, "pending": 0, "deferred": 2, "evicted": 0
+    }
+    # Parked tenants' events are buffered, not dropped.
+    assert len(fleet.tenants[2].batcher) == 1
+    fleet.evict(0)
+    led = fleet.assert_fleet_conservation()
+    assert led["evicted"] == 1 and led["placed"] == 2 and led["deferred"] == 1
+    assert fleet.tenants[2].placed  # FIFO: tenant 2 claimed the freed slot
+    fleet.run_replay([], 4)
+    assert fleet.tenants[2].events_served == 1  # the parked kill landed
+    summary = fleet.close()
+    assert summary["ledger"]["evicted"] == 1
+    assert summary["counters"]["tenant_evictions"] == 1
+    assert summary["counters"]["tenants_deferred"] == 1
+
+
+def test_fleet_retune_lossless():
+    """A (k, C) retune re-pins the launch geometry mid-session: pending
+    events re-pack under the new shape and every event is still served."""
+    params = _params()
+    fleet = FleetBridge(params, engine="sparse", fleet_size=2, batch_ticks=2, capacity=1)
+    evs = [ServeEvent(kind=EV_KILL, node=i, tick=1, tenant=0) for i in range(6)]
+    fleet.run_replay(evs, 2)  # capacity-1: most of the flood defers
+    assert len(fleet.tenants[0].batcher) > 0
+    fleet.retune(4, 4)
+    fleet.run_replay([], 4)
+    assert len(fleet.tenants[0].batcher) == 0
+    assert fleet.tenants[0].events_served == 6
+    assert fleet.retunes == 1
+    assert any(r["kind"] == "retune" for r in fleet.rows)
+
+
+def test_fleet_counters_schema():
+    """Fleet counter totals live on the SHARED_COUNTERS schema: every key
+    present, the four fleet keys stamped by the host, and the engines'
+    per-tick planes carry them as constant 0 (no tenancy axis in a tick)."""
+    params = _params()
+    fleet = FleetBridge(params, engine="sparse", fleet_size=2, batch_ticks=4, capacity=2)
+    launches = fleet.run_replay(
+        [ServeEvent(kind=EV_KILL, node=1, tenant=0)], 4
+    )
+    totals = fleet.counters()
+    for key in SHARED_COUNTERS:
+        assert key in totals, key
+    assert totals["tenants_active"] == 1
+    assert totals["fleet_launches"] == 1
+    traces = launches[0][0]  # pool 0's device trace dict
+    for key in ("tenants_active", "tenants_deferred", "tenant_evictions",
+                "fleet_launches"):
+        assert key in traces
+        assert int(np.sum(traces[key])) == 0  # constant-0 schema slots
+
+
+def test_fleet_promotion_solo_parity_after_kill_restart():
+    """The promotion path composes with isolation: a tenant that took a
+    kill/restart arc, promoted to the next tier, matches the solo session
+    promoted the same way (same checkpoint path, sim/checkpoint.py)."""
+    params = _params()
+    fleet = FleetBridge(
+        params,
+        engine="sparse-elastic",
+        fleet_size=2,
+        batch_ticks=4,
+        capacity=2,
+    )
+    fleet.run_replay(
+        _events(VICTIM_TRACE, 0) + _events(NEIGHBOR_TRACES[1], 1), 8
+    )
+    fleet.promote_tenant(0, n_new=2 * N)
+    fleet.run_replay([ServeEvent(kind=EV_KILL, node=1, tenant=0)], 4)
+    led = fleet.assert_fleet_conservation()
+    assert led["pending"] == 0 and led["placed"] == 2
+    session = fleet.tenants[0]
+    assert session.promotions == 1 and session.n == 2 * N
+    # Zero dropped ticks: the promoted universe's device tick equals the
+    # host mirror — every launch the tenant was placed for stepped it.
+    st = index_universe(fleet.pools[2 * N].states, session.slot)
+    assert int(jax.device_get(st.tick)) == fleet.pools[2 * N].base_ticks[session.slot]
+
+
+@pytest.mark.asyncio
+async def test_fleet_live_tcp_promotion_zero_dropped_ticks():
+    """The acceptance scenario: a live multi-tenant TCP session (tenant
+    field on the wire) completes a per-tenant capacity promotion with zero
+    dropped ticks, the fleet ledger asserted at every launch boundary
+    (FleetBridge asserts it in _finish_round; reaching the end IS the
+    certification) and both tenants' events served."""
+    params = _params()
+    fleet = FleetBridge(
+        params,
+        engine="sparse-elastic",
+        fleet_size=2,
+        batch_ticks=4,
+        capacity=4,
+        auto_promote=True,
+    )
+    half = fleet.base_pool._placeholder(0)
+    free_rows = int(np.sum(~np.asarray(jax.device_get(half.live_mask))))
+    server = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    client = await TcpTransport.bind(TransportConfig(connect_timeout=1000))
+    try:
+        served = {"want": 0}
+
+        def done():
+            return (
+                sum(s.batcher.pushed_total for s in fleet.tenants.values())
+                >= served["want"]
+                and len(fleet.router) == 0
+                and any(s.promotions for s in fleet.tenants.values())
+            )
+
+        live = asyncio.ensure_future(
+            fleet.run_live(server, settle_s=0.02, stop_when=done)
+        )
+        await asyncio.sleep(0.05)
+
+        async def send(data):
+            await client.send(
+                server.address,
+                Message.create(
+                    qualifier=SERVE_QUALIFIER, data=data, sender=client.address
+                ),
+            )
+
+        # Tenant 1: steady background traffic during tenant 0's promotion.
+        await send({"kind": "kill", "node": 2, "tenant": 1})
+        served["want"] += 1
+        # Tenant 0: joins past its free capacity rows force a promotion.
+        for _ in range(free_rows + 3):
+            await send({"kind": "join", "tenant": 0})
+            served["want"] += 1
+        await asyncio.wait_for(live, timeout=120)
+    finally:
+        await client.stop()
+        await server.stop()
+    session = fleet.tenants[0]
+    assert session.promotions >= 1
+    assert session.n > N
+    assert len(session.batcher.deferred_joins) == 0  # every join admitted
+    session.batcher.assert_join_conservation()
+    led = fleet.assert_fleet_conservation()
+    assert led["pending"] == 0
+    # Zero dropped ticks across the migration, for BOTH tenants: device
+    # tick == the host launch accounting of each tenant's universe.
+    for tid, sess in fleet.tenants.items():
+        st = index_universe(sess.pool.states, sess.slot)
+        assert int(jax.device_get(st.tick)) == sess.pool.base_ticks[sess.slot], tid
+    # Tenant 1 was never degraded: its event served, queue drained.
+    assert fleet.tenants[1].events_served == 1
+
+
+@pytest.mark.asyncio
+async def test_fleet_load_cross_tenant_isolation():
+    """One tenant's slow-loris/garbage/reject producers cannot degrade
+    another tenant's SLO row or violate fleet conservation: the victim
+    tenants' per-tenant conservation is exact with zero shed, and the
+    hostile tenant's rejects are counted, never served."""
+    audit = await run_fleet_load(
+        n=N,
+        slot_budget=S,
+        tenants=3,
+        hostile_tenants=1,
+        hostile_producers=5,
+        events_per_producer=60,
+        batch_ticks=4,
+        capacity=16,
+        accept_idle_timeout_ms=400,
+        deadline_s=120.0,
+        seed=7,
+    )
+    assert audit["errors"] == []
+    assert audit["victims_clean"], audit["tenant_audits"]
+    assert audit["ledger"]["requested"] == (
+        audit["ledger"]["placed"]
+        + audit["ledger"]["pending"]
+        + audit["ledger"]["deferred"]
+        + audit["ledger"]["evicted"]
+    )
+    # The hostile tenant's semantic garbage was counted at the pump.
+    assert audit["row"]["rejected"] == audit["row"]["events_injected_malformed"]
+    # Victim SLO rows exist with real latencies.
+    for t in (0, 1):
+        a = audit["tenant_audits"][t]
+        assert a["conservation_ok"] and a["shed"] == 0 and a["pending"] == 0
+        assert a["served"] == a["pushed"]
+        trow = audit["fleet"].tenant_row(t)
+        assert trow["latency_ms_p99"] >= trow["latency_ms_p50"] >= 0.0
+
+
+def test_fleet_elastic_zero_recompile():
+    """The elastic fleet entry is also pinned: launches + a promotion's
+    NEW tier pool compile one executable each, never per-launch."""
+    params = _params()
+    fleet = FleetBridge(
+        params, engine="sparse-elastic", fleet_size=2, batch_ticks=3, capacity=2
+    )
+    before = jit_cache_size(run_fleet_serve_batch_elastic)
+    fleet.run_replay([ServeEvent(kind=EV_JOIN, node=-1, tenant=0)], 9)
+    fleet.run_replay([ServeEvent(kind=EV_JOIN, node=-1, tenant=0)], 9)
+    assert jit_cache_size(run_fleet_serve_batch_elastic) - before == 1
+    fleet.promote_tenant(0, n_new=2 * N)  # new tier -> one more executable
+    fleet.run_replay([], 9)
+    fleet.run_replay([], 9)
+    assert jit_cache_size(run_fleet_serve_batch_elastic) - before == 2

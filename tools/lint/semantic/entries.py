@@ -565,6 +565,106 @@ def _build_run_rapid_serve_batch():
     )
 
 
+def _build_run_fleet_serve_batch():
+    # The multi-tenant fleet executable (serve/engine.py, serve/fleet.py):
+    # vmap of the solo serve scan over a leading universe axis B. States and
+    # batches stack (sim/ensemble.py::stack_universes / serve/events.py::
+    # stack_batches); the stacked state is donated like the solo entry. The
+    # probe fleet is B=2 — the vmapped program is B-generic, and every
+    # semantic property is checked on the traced structure, not the axis
+    # size.
+    from scalecube_cluster_tpu.serve.engine import run_fleet_serve_batch
+    from scalecube_cluster_tpu.serve.events import empty_batch, stack_batches
+    from scalecube_cluster_tpu.sim.ensemble import stack_universes
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import SparseParams, init_sparse_full_view
+
+    params = SparseParams.for_n(N, slot_budget=S, pallas_core=False)
+    states = stack_universes(
+        init_sparse_full_view(
+            N, slot_budget=S,
+            user_gossip_slots=params.base.user_gossip_slots, seed=b,
+        )
+        for b in range(B)
+    )
+    batches = stack_batches([empty_batch(T, 2) for _ in range(B)])
+    return (
+        run_fleet_serve_batch,
+        (params, states, FaultPlan.uniform(), batches),
+        {"collect": True},
+        {
+            "donate_argnums": (1,),
+            "state_argnum": 1,
+            "state_out": _state_first,
+            "static_argnums": (0,),
+            "static_argnames": ("collect",),
+        },
+    )
+
+
+def _build_run_fleet_serve_batch_elastic():
+    # The elastic fleet executable: B capacity-tiered tenant universes per
+    # launch, each probed half-full (n_live = N/2 inside n_alloc = N) for
+    # the same reason the solo elastic entry is — a full state would drop
+    # the live_mask and alias this treedef to the fixed-shape fleet entry.
+    from scalecube_cluster_tpu.serve.engine import run_fleet_serve_batch_elastic
+    from scalecube_cluster_tpu.serve.events import empty_batch, stack_batches
+    from scalecube_cluster_tpu.sim.ensemble import stack_universes
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.sparse import SparseParams, init_sparse_full_view
+
+    params = SparseParams.for_n(N, slot_budget=S, pallas_core=False)
+    states = stack_universes(
+        init_sparse_full_view(
+            N // 2, slot_budget=S,
+            user_gossip_slots=params.base.user_gossip_slots,
+            n_alloc=N, seed=b,
+        )
+        for b in range(B)
+    )
+    batches = stack_batches([empty_batch(T, 2) for _ in range(B)])
+    return (
+        run_fleet_serve_batch_elastic,
+        (params, states, FaultPlan.uniform(), batches),
+        {"collect": True},
+        {
+            "donate_argnums": (1,),
+            "state_argnum": 1,
+            "state_out": _state_first,
+            "static_argnums": (0,),
+            "static_argnames": ("collect",),
+        },
+    )
+
+
+def _build_run_fleet_rapid_serve_batch():
+    # The Rapid fleet executable: B Rapid tenant universes per launch,
+    # fallback plane armed like the solo rapid serve entry. NOT donated —
+    # rapid fleet sessions are replay/parity surfaces.
+    from scalecube_cluster_tpu.serve.engine import run_fleet_rapid_serve_batch
+    from scalecube_cluster_tpu.serve.events import empty_batch, stack_batches
+    from scalecube_cluster_tpu.sim.ensemble import stack_universes
+    from scalecube_cluster_tpu.sim.faults import FaultPlan
+    from scalecube_cluster_tpu.sim.rapid import RapidParams, init_rapid_full_view
+
+    params = RapidParams(n=N)
+    states = stack_universes(
+        init_rapid_full_view(params, seed=b, fallback=True) for b in range(B)
+    )
+    batches = stack_batches([empty_batch(T, 2) for _ in range(B)])
+    return (
+        run_fleet_rapid_serve_batch,
+        (params, states, FaultPlan.uniform(), batches),
+        {"collect": True},
+        {
+            "state_argnum": 1,
+            "state_out": _state_first,
+            "static_argnums": (0,),
+            "static_argnames": ("collect",),
+        },
+    )
+
+
 ENTRY_SPECS: tuple[EntrySpec, ...] = (
     EntrySpec("sim.run.run_ticks[plan]", lambda: _build_run_ticks(False)),
     EntrySpec("sim.run.run_ticks[schedule]", lambda: _build_run_ticks(True)),
@@ -641,6 +741,15 @@ ENTRY_SPECS: tuple[EntrySpec, ...] = (
         "serve.engine.run_serve_batch_elastic", _build_run_serve_batch_elastic
     ),
     EntrySpec("serve.engine.run_rapid_serve_batch", _build_run_rapid_serve_batch),
+    EntrySpec("serve.engine.run_fleet_serve_batch", _build_run_fleet_serve_batch),
+    EntrySpec(
+        "serve.engine.run_fleet_serve_batch_elastic",
+        _build_run_fleet_serve_batch_elastic,
+    ),
+    EntrySpec(
+        "serve.engine.run_fleet_rapid_serve_batch",
+        _build_run_fleet_rapid_serve_batch,
+    ),
 )
 
 
